@@ -1,0 +1,21 @@
+"""hydragnn_tpu: a TPU-native (JAX/XLA/Pallas) multi-headed graph neural
+network framework with the capability surface of HydraGNN (+GPS support).
+
+Public API mirrors the reference (hydragnn/__init__.py:1-3):
+``run_training(config)`` / ``run_prediction(config)`` plus model IO helpers.
+"""
+
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy imports keep `import hydragnn_tpu` light (no jax init on import).
+    if name in ("run_training", "run_prediction"):
+        from . import api
+
+        return getattr(api, name)
+    if name in ("save_model", "load_existing_model"):
+        from .train import checkpoint
+
+        return getattr(checkpoint, name)
+    raise AttributeError(name)

@@ -1,0 +1,28 @@
+from .graph import Graph, GraphBatch, PadSpec, batch_graphs, batch_graphs_np, graph_batch_from_np
+from .neighbors import radius_graph, radius_graph_pbc, edge_vectors_and_lengths
+from .pipeline import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    extract_variables,
+    split_dataset,
+)
+from .synthetic import deterministic_graph_dataset
+
+__all__ = [
+    "Graph",
+    "GraphBatch",
+    "PadSpec",
+    "batch_graphs",
+    "batch_graphs_np",
+    "graph_batch_from_np",
+    "radius_graph",
+    "radius_graph_pbc",
+    "edge_vectors_and_lengths",
+    "GraphLoader",
+    "MinMax",
+    "VariablesOfInterest",
+    "extract_variables",
+    "split_dataset",
+    "deterministic_graph_dataset",
+]

@@ -1,0 +1,292 @@
+"""Static-shape graph batch containers for TPU.
+
+The reference (HydraGNN) batches graphs with PyG's ragged ``Batch`` object and
+moves it host->device every step (reference: hydragnn/train/train_validate_test.py:514).
+On TPU every array inside ``jit`` must have a static shape, so this module
+replaces the ragged batch with a *padded* batch:
+
+- all graphs in a batch are concatenated (nodes stacked, edges stacked with
+  index offsets) exactly like PyG batching,
+- the result is padded up to a fixed ``PadSpec`` (n_nodes, n_edges, n_graphs),
+- padding nodes/edges are assigned to one trailing *dummy graph* whose mask is
+  False, so segment reductions and pooling stay correct without any dynamic
+  shapes.
+
+Targets are stored per-head in a dict (graph-level heads: ``[G, d]``;
+node-level heads: ``[N, d]``) instead of the reference's packed ``data.y`` +
+``y_loc`` index table (reference: hydragnn/preprocess/graph_samples_checks_and_updates.py:493-534);
+the packing existed to ship ragged multi-task targets through PyG, which a
+static-shape design does not need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+# Names of per-node / per-edge / per-graph optional fields, used by batching.
+_NODE_FIELDS = ("x", "pos", "pe", "z")
+_EDGE_FIELDS = ("edge_attr", "edge_shifts", "rel_pe")
+
+
+@dataclasses.dataclass
+class Graph:
+    """A single host-side graph sample (numpy arrays, ragged shapes).
+
+    Mirrors the information content of a PyG ``Data`` object as produced by the
+    reference's serialized loader (hydragnn/preprocess/serialized_dataset_loader.py:110-212).
+    """
+
+    x: np.ndarray  # [n, Fx] node input features
+    pos: np.ndarray  # [n, 3] positions
+    senders: np.ndarray  # [e] int32 message source node
+    receivers: np.ndarray  # [e] int32 message destination node
+    edge_attr: Optional[np.ndarray] = None  # [e, Fe]
+    edge_shifts: Optional[np.ndarray] = None  # [e, 3] PBC cartesian shifts
+    pe: Optional[np.ndarray] = None  # [n, pe_dim] Laplacian PE
+    rel_pe: Optional[np.ndarray] = None  # [e, pe_dim] |pe_src - pe_dst|
+    z: Optional[np.ndarray] = None  # [n] int32 atomic numbers
+    graph_y: Optional[np.ndarray] = None  # [Fg] raw graph feature table
+    graph_targets: Optional[Dict[str, np.ndarray]] = None  # name -> [d]
+    node_targets: Optional[Dict[str, np.ndarray]] = None  # name -> [n, d]
+    dataset_id: int = 0
+    cell: Optional[np.ndarray] = None  # [3, 3] lattice (PBC only)
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.senders.shape[0])
+
+
+@struct.dataclass
+class GraphBatch:
+    """Device-side padded batch of graphs (a pytree of fixed-shape arrays).
+
+    Shapes: N = padded node count, E = padded edge count, G = padded graph
+    count. The last graph slot(s) are dummy graphs holding all padding nodes
+    and edges (``graph_mask`` False there).
+    """
+
+    # node-level
+    x: jnp.ndarray  # [N, Fx] float
+    pos: jnp.ndarray  # [N, 3] float
+    node_graph: jnp.ndarray  # [N] int32: graph id of each node
+    node_mask: jnp.ndarray  # [N] bool
+    # edge-level
+    senders: jnp.ndarray  # [E] int32
+    receivers: jnp.ndarray  # [E] int32
+    edge_mask: jnp.ndarray  # [E] bool
+    # graph-level
+    graph_mask: jnp.ndarray  # [G] bool
+    dataset_id: jnp.ndarray  # [G] int32
+    # optional channels
+    edge_attr: Optional[jnp.ndarray] = None  # [E, Fe]
+    edge_shifts: Optional[jnp.ndarray] = None  # [E, 3]
+    pe: Optional[jnp.ndarray] = None  # [N, pe_dim]
+    rel_pe: Optional[jnp.ndarray] = None  # [E, pe_dim]
+    z: Optional[jnp.ndarray] = None  # [N] int32
+    # targets: head name -> [G, d] (graph heads) or [N, d] (node heads)
+    graph_targets: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
+    node_targets: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
+
+    @property
+    def num_nodes(self) -> int:
+        return self.x.shape[0]
+
+    @property
+    def num_edges(self) -> int:
+        return self.senders.shape[0]
+
+    @property
+    def num_graphs(self) -> int:
+        return self.graph_mask.shape[0]
+
+    @property
+    def num_real_graphs(self) -> jnp.ndarray:
+        return jnp.sum(self.graph_mask.astype(jnp.int32))
+
+    @property
+    def nodes_per_graph(self) -> jnp.ndarray:
+        """[G] number of real nodes in each graph."""
+        seg = jnp.zeros((self.num_graphs,), jnp.int32)
+        return seg.at[self.node_graph].add(self.node_mask.astype(jnp.int32))
+
+
+@dataclasses.dataclass(frozen=True)
+class PadSpec:
+    """Static padding target for a batch. All jit specializations key on this."""
+
+    n_nodes: int
+    n_edges: int
+    n_graphs: int  # includes the +1 dummy graph slot
+
+    @staticmethod
+    def for_dataset(
+        graphs: List[Graph],
+        batch_size: int,
+        node_multiple: int = 8,
+        edge_multiple: int = 128,
+        slack: float = 1.0,
+    ) -> "PadSpec":
+        """Choose one spec covering any ``batch_size`` graphs from ``graphs``.
+
+        Uses the max graph size times batch size (exact upper bound for the
+        small molecular graphs this framework targets) rounded up to
+        TPU-friendly multiples. ``slack`` can trim (<1) toward the sum of the
+        largest-k sizes if memory is tight.
+        """
+        if not graphs:
+            raise ValueError("empty dataset")
+        n_sizes = sorted((g.num_nodes for g in graphs), reverse=True)
+        e_sizes = sorted((g.num_edges for g in graphs), reverse=True)
+        k = min(batch_size, len(n_sizes))
+        n_bound = int(sum(n_sizes[:k]) * slack) + 1
+        e_bound = int(sum(e_sizes[:k]) * slack) + 1
+        return PadSpec(
+            n_nodes=_round_up(n_bound + 1, node_multiple),
+            n_edges=_round_up(e_bound, edge_multiple),
+            n_graphs=batch_size + 1,
+        )
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+def _stack_optional(graphs: List[Graph], field: str) -> Optional[np.ndarray]:
+    vals = [getattr(g, field) for g in graphs]
+    if all(v is None for v in vals):
+        return None
+    if any(v is None for v in vals):
+        raise ValueError(f"field {field!r} present in some graphs but not all")
+    return np.concatenate([np.asarray(v) for v in vals], axis=0)
+
+
+def batch_graphs_np(
+    graphs: List[Graph],
+    spec: PadSpec,
+    np_dtype=np.float32,
+) -> Dict[str, np.ndarray]:
+    """Concatenate + pad a list of host graphs into flat numpy arrays.
+
+    Padding convention: padding nodes belong to the final (dummy) graph slot,
+    padding edges connect the final padding node to itself. Runs entirely on
+    host with numpy; ``GraphBatch`` construction from the result is a cheap
+    device put.
+    """
+    G = len(graphs)
+    n = sum(g.num_nodes for g in graphs)
+    e = sum(g.num_edges for g in graphs)
+    if G > spec.n_graphs - 1 or n > spec.n_nodes - 1 or e > spec.n_edges:
+        raise ValueError(
+            f"batch ({G} graphs, {n} nodes, {e} edges) exceeds pad spec {spec}"
+        )
+
+    out: Dict[str, np.ndarray] = {}
+
+    # node features
+    for field in _NODE_FIELDS:
+        stacked = _stack_optional(graphs, field)
+        if stacked is None:
+            continue
+        if stacked.ndim == 1:
+            stacked = stacked[:, None]
+        width = stacked.shape[1]
+        dtype = np.int32 if field == "z" else np_dtype
+        buf = np.zeros((spec.n_nodes, width), dtype)
+        buf[:n] = stacked
+        out[field] = buf if field != "z" else buf[:, 0]
+
+    # edges with node-index offsets
+    senders = np.full((spec.n_edges,), spec.n_nodes - 1, np.int32)
+    receivers = np.full((spec.n_edges,), spec.n_nodes - 1, np.int32)
+    off = 0
+    eoff = 0
+    node_graph = np.full((spec.n_nodes,), spec.n_graphs - 1, np.int32)
+    for gi, g in enumerate(graphs):
+        senders[eoff : eoff + g.num_edges] = g.senders + off
+        receivers[eoff : eoff + g.num_edges] = g.receivers + off
+        node_graph[off : off + g.num_nodes] = gi
+        off += g.num_nodes
+        eoff += g.num_edges
+    out["senders"] = senders
+    out["receivers"] = receivers
+    out["node_graph"] = node_graph
+
+    for field in _EDGE_FIELDS:
+        stacked = _stack_optional(graphs, field)
+        if stacked is None:
+            continue
+        if stacked.ndim == 1:
+            stacked = stacked[:, None]
+        buf = np.zeros((spec.n_edges, stacked.shape[1]), np_dtype)
+        buf[:e] = stacked
+        out[field] = buf
+
+    # masks
+    node_mask = np.zeros((spec.n_nodes,), bool)
+    node_mask[:n] = True
+    edge_mask = np.zeros((spec.n_edges,), bool)
+    edge_mask[:e] = True
+    graph_mask = np.zeros((spec.n_graphs,), bool)
+    graph_mask[:G] = True
+    out["node_mask"] = node_mask
+    out["edge_mask"] = edge_mask
+    out["graph_mask"] = graph_mask
+
+    dataset_id = np.zeros((spec.n_graphs,), np.int32)
+    dataset_id[:G] = [g.dataset_id for g in graphs]
+    out["dataset_id"] = dataset_id
+
+    # targets
+    gt_names = set()
+    nt_names = set()
+    for g in graphs:
+        gt_names.update((g.graph_targets or {}).keys())
+        nt_names.update((g.node_targets or {}).keys())
+    for name in sorted(gt_names):
+        vals = [np.atleast_1d(np.asarray(g.graph_targets[name], np_dtype)) for g in graphs]
+        width = vals[0].shape[-1]
+        buf = np.zeros((spec.n_graphs, width), np_dtype)
+        buf[:G] = np.stack(vals)
+        out[f"graph_targets/{name}"] = buf
+    for name in sorted(nt_names):
+        vals = np.concatenate(
+            [np.asarray(g.node_targets[name], np_dtype).reshape(g.num_nodes, -1) for g in graphs]
+        )
+        buf = np.zeros((spec.n_nodes, vals.shape[1]), np_dtype)
+        buf[:n] = vals
+        out[f"node_targets/{name}"] = buf
+
+    return out
+
+
+def graph_batch_from_np(arrs: Dict[str, np.ndarray]) -> GraphBatch:
+    """Assemble a ``GraphBatch`` pytree from ``batch_graphs_np`` output."""
+    graph_targets = {
+        k.split("/", 1)[1]: jnp.asarray(v)
+        for k, v in arrs.items()
+        if k.startswith("graph_targets/")
+    }
+    node_targets = {
+        k.split("/", 1)[1]: jnp.asarray(v)
+        for k, v in arrs.items()
+        if k.startswith("node_targets/")
+    }
+    kwargs = {
+        k: jnp.asarray(v)
+        for k, v in arrs.items()
+        if "/" not in k
+    }
+    return GraphBatch(graph_targets=graph_targets, node_targets=node_targets, **kwargs)
+
+
+def batch_graphs(graphs: List[Graph], spec: PadSpec) -> GraphBatch:
+    return graph_batch_from_np(batch_graphs_np(graphs, spec))

@@ -1,0 +1,220 @@
+"""Dataset -> model-ready pipeline: feature selection, split, minmax, loader.
+
+Covers the responsibilities of the reference's serialized loader and splitting
+utilities (hydragnn/preprocess/serialized_dataset_loader.py:110-212,
+hydragnn/preprocess/load_data.py:225-438) in a TPU-friendly way: everything
+here is host-side numpy; the output of ``GraphLoader`` is a statically padded
+``GraphBatch`` ready for ``jit``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .graph import Graph, GraphBatch, PadSpec, batch_graphs
+
+
+@dataclasses.dataclass
+class VariablesOfInterest:
+    """Selection of model inputs and per-head targets from raw feature tables.
+
+    Mirrors config ``NeuralNetwork.Variables_of_interest`` +
+    ``Dataset.{node,graph}_features`` (reference:
+    hydragnn/utils/input_config_parsing/config_utils.py:219-260).
+    """
+
+    input_node_features: Sequence[int]
+    output_names: Sequence[str]
+    output_types: Sequence[str]  # "graph" | "node"
+    output_index: Sequence[int]
+    node_feature_dims: Sequence[int]
+    graph_feature_dims: Sequence[int]
+
+    def node_feature_slice(self, idx: int) -> slice:
+        off = int(np.sum(self.node_feature_dims[:idx]))
+        return slice(off, off + self.node_feature_dims[idx])
+
+    def graph_feature_slice(self, idx: int) -> slice:
+        off = int(np.sum(self.graph_feature_dims[:idx]))
+        return slice(off, off + self.graph_feature_dims[idx])
+
+    @property
+    def input_dim(self) -> int:
+        return int(sum(self.node_feature_dims[i] for i in self.input_node_features))
+
+    def head_dims(self) -> List[int]:
+        dims = []
+        for t, i in zip(self.output_types, self.output_index):
+            dims.append(
+                self.graph_feature_dims[i] if t == "graph" else self.node_feature_dims[i]
+            )
+        return dims
+
+
+def extract_variables(graph: Graph, voi: VariablesOfInterest) -> Graph:
+    """Produce a model-ready graph: input columns + per-head target dicts."""
+    in_cols = np.concatenate(
+        [np.arange(voi.node_feature_slice(i).start, voi.node_feature_slice(i).stop)
+         for i in voi.input_node_features]
+    )
+    graph_targets: Dict[str, np.ndarray] = {}
+    node_targets: Dict[str, np.ndarray] = {}
+    for name, t, idx in zip(voi.output_names, voi.output_types, voi.output_index):
+        if t == "graph":
+            graph_targets[name] = np.asarray(graph.graph_y)[voi.graph_feature_slice(idx)]
+        else:
+            node_targets[name] = np.asarray(graph.x)[:, voi.node_feature_slice(idx)]
+    return dataclasses.replace(
+        graph,
+        x=np.asarray(graph.x)[:, in_cols],
+        graph_targets=graph_targets,
+        node_targets=node_targets,
+    )
+
+
+@dataclasses.dataclass
+class MinMax:
+    """Per-column min/max used for feature/target normalization to [0, 1].
+
+    The reference normalizes raw features in ``AbstractRawDataset.__normalize_dataset``
+    and denormalizes predictions with ``output_denormalize``
+    (hydragnn/postprocess/postprocess.py:13-26).
+    """
+
+    x_min: np.ndarray
+    x_max: np.ndarray
+    y_min: np.ndarray
+    y_max: np.ndarray
+    node_y_min: Optional[np.ndarray] = None
+    node_y_max: Optional[np.ndarray] = None
+
+    @staticmethod
+    def fit(graphs: List[Graph]) -> "MinMax":
+        xs = np.concatenate([g.x for g in graphs], axis=0)
+        x_min, x_max = xs.min(0), xs.max(0)
+        if graphs[0].graph_y is not None:
+            ys = np.stack([np.asarray(g.graph_y) for g in graphs])
+            y_min, y_max = ys.min(0), ys.max(0)
+        else:
+            y_min = y_max = np.zeros((0,), np.float32)
+        return MinMax(x_min, x_max, y_min, y_max, x_min, x_max)
+
+    def apply(self, graphs: List[Graph]) -> List[Graph]:
+        out = []
+        xr = np.where(self.x_max > self.x_min, self.x_max - self.x_min, 1.0)
+        yr = np.where(self.y_max > self.y_min, self.y_max - self.y_min, 1.0)
+        for g in graphs:
+            x = (g.x - self.x_min) / xr
+            gy = None if g.graph_y is None else (g.graph_y - self.y_min) / yr
+            out.append(dataclasses.replace(g, x=x.astype(np.float32), graph_y=gy))
+        return out
+
+    def denormalize_graph(self, y: np.ndarray, idx: slice) -> np.ndarray:
+        return y * (self.y_max[idx] - self.y_min[idx]) + self.y_min[idx]
+
+
+def split_dataset(
+    graphs: List[Graph],
+    perc_train: float,
+    seed: int = 0,
+    stratified: bool = False,
+) -> Tuple[List[Graph], List[Graph], List[Graph]]:
+    """Random train/val/test split; val and test share the remainder equally.
+
+    (reference: hydragnn/preprocess/load_data.py:329-349; the compositional
+    stratified variant lives in utils/datasets/compositional_data_splitting.py
+    and is approximated here by stratifying on the node-type multiset hash.)
+    """
+    rng = np.random.default_rng(seed)
+    idx = np.arange(len(graphs))
+    if stratified:
+        # group indices by composition signature, deal each group round-robin
+        from collections import defaultdict
+
+        groups = defaultdict(list)
+        for i, g in enumerate(graphs):
+            key = tuple(np.bincount(np.asarray(g.z, np.int64) if g.z is not None else [0]))
+            groups[key].append(i)
+        order = []
+        for key in sorted(groups):
+            sub = np.array(groups[key])
+            rng.shuffle(sub)
+            order.append(sub)
+        idx = np.concatenate(order) if order else idx
+        # interleave groups so each split sees every composition
+        idx = idx[_deal_order(len(idx))]
+    else:
+        rng.shuffle(idx)
+    n_train = int(len(idx) * perc_train)
+    n_val = (len(idx) - n_train) // 2
+    tr = [graphs[i] for i in idx[:n_train]]
+    va = [graphs[i] for i in idx[n_train : n_train + n_val]]
+    te = [graphs[i] for i in idx[n_train + n_val :]]
+    return tr, va, te
+
+
+def _deal_order(n: int) -> np.ndarray:
+    """Round-robin dealing permutation: 0, k, 2k, ..., 1, k+1, ... with k=10."""
+    k = 10
+    cols = [np.arange(s, n, k) for s in range(k)]
+    return np.concatenate(cols)
+
+
+class GraphLoader:
+    """Shuffling, statically-padded batch iterator over a list of graphs.
+
+    Replaces DataLoader+DistributedSampler (reference: load_data.py:225-326).
+    ``host_count``/``host_index`` shard samples across hosts for multi-host DP
+    (DistributedSampler semantics: each host sees 1/host_count of the samples).
+    """
+
+    def __init__(
+        self,
+        graphs: List[Graph],
+        batch_size: int,
+        spec: Optional[PadSpec] = None,
+        shuffle: bool = True,
+        seed: int = 0,
+        host_count: int = 1,
+        host_index: int = 0,
+        drop_last: bool = False,
+    ):
+        self.graphs = graphs
+        self.batch_size = batch_size
+        self.spec = spec or PadSpec.for_dataset(graphs, batch_size)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.host_count = host_count
+        self.host_index = host_index
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        """Reseed the shuffle per epoch (DistributedSampler.set_epoch analog)."""
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        n = len(self._local_indices())
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def _local_indices(self) -> np.ndarray:
+        idx = np.arange(len(self.graphs))
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        return idx[self.host_index :: self.host_count]
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        idx = self._local_indices()
+        bs = self.batch_size
+        n_full = len(idx) // bs
+        for b in range(n_full):
+            yield batch_graphs([self.graphs[i] for i in idx[b * bs : (b + 1) * bs]], self.spec)
+        rem = len(idx) - n_full * bs
+        if rem and not self.drop_last:
+            yield batch_graphs([self.graphs[i] for i in idx[n_full * bs :]], self.spec)

@@ -1,0 +1,17 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference CI strategy of exercising distributed code paths on CPU
+(reference: .github/workflows/CI.yml:57-63 runs pytest under 2-rank Gloo);
+here a single process exposes 8 XLA CPU devices so mesh/sharding code runs
+for real without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

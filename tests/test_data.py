@@ -1,0 +1,141 @@
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data import (
+    Graph,
+    PadSpec,
+    VariablesOfInterest,
+    batch_graphs,
+    deterministic_graph_dataset,
+    extract_variables,
+    radius_graph,
+    radius_graph_pbc,
+    split_dataset,
+    GraphLoader,
+    MinMax,
+)
+
+
+def _voi_single():
+    return VariablesOfInterest(
+        input_node_features=[0],
+        output_names=["sum_x_x2_x3"],
+        output_types=["graph"],
+        output_index=[0],
+        node_feature_dims=[1, 1, 1],
+        graph_feature_dims=[1],
+    )
+
+
+def pytest_synthetic_dataset_targets():
+    graphs = deterministic_graph_dataset(number_configurations=8, seed=3)
+    for g in graphs:
+        # BCC cell: even number of nodes, pos table matches
+        assert g.num_nodes % 2 == 0
+        assert g.x.shape == (g.num_nodes, 3)
+        # graph target equals sum over closed-form node outputs:
+        # out2, out3 are columns 1, 2; out1 = (out3)**(1/3)
+        out3 = g.x[:, 2]
+        out1 = np.cbrt(out3)
+        out2 = g.x[:, 1]
+        expected = out1.sum() + out2.sum() + out3.sum()
+        assert np.isclose(g.graph_y[0], expected, rtol=1e-4)
+
+
+def pytest_radius_graph_simple():
+    pos = np.array([[0, 0, 0], [1, 0, 0], [5, 0, 0]], np.float64)
+    s, r = radius_graph(pos, radius=1.5)
+    pairs = set(zip(s.tolist(), r.tolist()))
+    assert pairs == {(0, 1), (1, 0)}
+
+
+def pytest_radius_graph_max_neighbours():
+    pos = np.stack([np.arange(5), np.zeros(5), np.zeros(5)], 1).astype(np.float64)
+    s, r = radius_graph(pos, radius=4.5, max_neighbours=2)
+    # every receiver keeps only its 2 nearest senders
+    for i in range(5):
+        assert (r == i).sum() == 2
+
+
+def pytest_radius_graph_pbc_h2_like():
+    # single atom in a unit cube with full PBC: neighbors are its own images
+    pos = np.zeros((1, 3))
+    cell = np.eye(3)
+    s, r, shifts = radius_graph_pbc(pos, cell, radius=1.01)
+    assert s.size == 6  # 6 face-adjacent images
+    assert np.all(s == 0) and np.all(r == 0)
+    d = np.linalg.norm(pos[s] + shifts - pos[r], axis=1)
+    assert np.allclose(d, 1.0)
+
+
+def pytest_batching_and_padding():
+    graphs = deterministic_graph_dataset(number_configurations=6, seed=0)
+    voi = _voi_single()
+    graphs = [extract_variables(g, voi) for g in graphs]
+    spec = PadSpec.for_dataset(graphs, batch_size=4)
+    batch = batch_graphs(graphs[:4], spec)
+    n_real = sum(g.num_nodes for g in graphs[:4])
+    e_real = sum(g.num_edges for g in graphs[:4])
+    assert batch.num_nodes == spec.n_nodes
+    assert int(batch.node_mask.sum()) == n_real
+    assert int(batch.edge_mask.sum()) == e_real
+    assert int(batch.graph_mask.sum()) == 4
+    # padding nodes all live in the dummy graph slot
+    assert np.all(np.asarray(batch.node_graph)[n_real:] == spec.n_graphs - 1)
+    # per-graph node counts match
+    npg = np.asarray(batch.nodes_per_graph)
+    for i, g in enumerate(graphs[:4]):
+        assert npg[i] == g.num_nodes
+    # targets land per-graph
+    y = np.asarray(batch.graph_targets["sum_x_x2_x3"])
+    assert y.shape == (spec.n_graphs, 1)
+    assert np.isclose(y[2, 0], graphs[2].graph_targets["sum_x_x2_x3"][0])
+
+
+def pytest_extract_variables_multihead():
+    graphs = deterministic_graph_dataset(number_configurations=2, seed=1)
+    voi = VariablesOfInterest(
+        input_node_features=[0],
+        output_names=["sum_x_x2_x3", "x", "x2", "x3"],
+        output_types=["graph", "node", "node", "node"],
+        output_index=[0, 0, 1, 2],
+        node_feature_dims=[1, 1, 1],
+        graph_feature_dims=[1],
+    )
+    g = extract_variables(graphs[0], voi)
+    assert g.x.shape[1] == 1
+    assert set(g.node_targets) == {"x", "x2", "x3"}
+    assert g.node_targets["x2"].shape == (g.num_nodes, 1)
+    np.testing.assert_allclose(g.node_targets["x"][:, 0], graphs[0].x[:, 0])
+
+
+def pytest_split_and_loader():
+    graphs = deterministic_graph_dataset(number_configurations=20, seed=5)
+    voi = _voi_single()
+    graphs = [extract_variables(g, voi) for g in graphs]
+    tr, va, te = split_dataset(graphs, perc_train=0.7, seed=0)
+    assert len(tr) == 14 and len(va) == 3 and len(te) == 3
+    loader = GraphLoader(tr, batch_size=4, seed=0)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 4  # 14 -> 3 full + 1 partial
+    assert int(batches[-1].graph_mask.sum()) == 2
+    # epoch reshuffle changes order
+    loader.set_epoch(1)
+    b2 = list(loader)
+    assert not np.allclose(
+        np.asarray(batches[0].graph_targets["sum_x_x2_x3"]),
+        np.asarray(b2[0].graph_targets["sum_x_x2_x3"]),
+    )
+
+
+def pytest_minmax_normalization():
+    graphs = deterministic_graph_dataset(number_configurations=10, seed=2)
+    mm = MinMax.fit(graphs)
+    normed = mm.apply(graphs)
+    xs = np.concatenate([g.x for g in normed])
+    assert xs.min() >= -1e-6 and xs.max() <= 1 + 1e-6
+    ys = np.stack([g.graph_y for g in normed])
+    assert ys.min() >= -1e-6 and ys.max() <= 1 + 1e-6
+    # round trip
+    back = mm.denormalize_graph(np.asarray(normed[0].graph_y), slice(0, 1))
+    np.testing.assert_allclose(back, graphs[0].graph_y, rtol=1e-5)
