@@ -1,0 +1,68 @@
+"""Benchmark: training throughput (graphs/sec/chip) on the current device.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+North-star metric per BASELINE.md: OC20 S2EF graphs/sec/chip at force-MAE
+parity; until the OC20 pipeline lands, this measures the same quantity on the
+synthetic molecular workload with a production-shaped model (PNA, hidden 64,
+3 conv layers — the reference CI architecture family scaled up).
+``vs_baseline`` is vs the round-1 recorded value (RECORDED_BASELINE); 1.0
+means parity with the first measurement.
+"""
+
+import json
+import os
+import sys
+import time
+
+# graphs/sec/chip recorded at round 1 on the v5e chip; update when re-baselined
+RECORDED_BASELINE = None
+
+
+def main():
+    import jax
+
+    import __graft_entry__ as ge
+    from hydragnn_tpu.models import init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    batch_size = int(os.getenv("BENCH_BATCH_SIZE", "64"))
+    config, model, loader, batch = ge._build(
+        mpnn_type=os.getenv("BENCH_MODEL", "PNA"),
+        hidden_dim=int(os.getenv("BENCH_HIDDEN", "64")),
+        num_conv_layers=int(os.getenv("BENCH_LAYERS", "3")),
+        batch_size=batch_size,
+        num_configs=max(2 * batch_size, 128),
+    )
+    variables = init_model(model, batch, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+    step = make_train_step(model, tx)
+
+    rng = jax.random.PRNGKey(0)
+    # warmup/compile
+    state, tot, _ = step(state, batch, rng)
+    jax.block_until_ready(tot)
+
+    n_steps = int(os.getenv("BENCH_STEPS", "50"))
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        state, tot, _ = step(state, batch, jax.random.fold_in(rng, i))
+    jax.block_until_ready(tot)
+    dt = time.perf_counter() - t0
+
+    graphs_per_sec = n_steps * batch_size / dt
+    vs = graphs_per_sec / RECORDED_BASELINE if RECORDED_BASELINE else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "synthetic PNA train throughput (graphs/sec/chip)",
+                "value": round(graphs_per_sec, 2),
+                "unit": "graphs/sec/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

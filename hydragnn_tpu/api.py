@@ -1,0 +1,171 @@
+"""Public convenience API: ``run_training(config)`` / ``run_prediction(config)``.
+
+Mirrors the reference's two-call surface (hydragnn/run_training.py:48-63,
+hydragnn/run_prediction.py:34-49): accepts a config file path or dict, loads
+and splits data, completes the config from it, builds the model, trains, and
+checkpoints. The DDP/DeepSpeed wrapping steps of the reference are replaced by
+mesh sharding (hydragnn_tpu/parallel) applied inside the jitted step.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .config import (
+    get_log_name_config,
+    load_config,
+    save_config,
+    update_config,
+    voi_from_config,
+)
+from .data.graph import Graph, PadSpec
+from .data.pipeline import GraphLoader, MinMax, extract_variables, split_dataset
+from .data.synthetic import deterministic_graph_dataset
+from .models.create import create_model, init_model
+from .train.checkpoint import load_existing_model, save_model
+from .train.loop import test_model, train_validate_test
+from .train.optimizer import make_optimizer
+from .train.state import TrainState
+
+
+def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
+    """Dataset from config. Formats: 'synthetic' (deterministic BCC fixture,
+    the analog of the reference's unit_test format) and 'pickle'
+    (reference: dataset_loading_and_splitting, load_data.py:206-222)."""
+    ds = config.get("Dataset", {})
+    fmt = ds.get("format", "synthetic")
+    if fmt in ("synthetic", "unit_test"):
+        opts = ds.get("synthetic", {})
+        return deterministic_graph_dataset(
+            number_configurations=opts.get("number_configurations", 300),
+            linear_only=opts.get("linear_only", False),
+            radius=config["NeuralNetwork"]["Architecture"].get("radius", 2.0) or 2.0,
+            max_neighbours=config["NeuralNetwork"]["Architecture"].get("max_neighbours")
+            or 100,
+            seed=opts.get("seed", 97),
+        )
+    if fmt == "pickle":
+        from .data.datasets import SimplePickleDataset
+
+        return list(SimplePickleDataset(ds["path"]["total"], ds["name"]))
+    raise ValueError(f"unknown Dataset.format {fmt!r}")
+
+
+def prepare_data(
+    config: Dict[str, Any], datasets: Optional[Tuple[List[Graph], ...]] = None
+):
+    """Load -> normalize -> select variables -> split -> loaders; returns
+    (completed config, loaders, minmax)."""
+    if datasets is None:
+        raw = _load_raw_dataset(config)
+        mm = MinMax.fit(raw)
+        if config.get("Dataset", {}).get("normalize", True):
+            raw = mm.apply(raw)
+        voi = voi_from_config(config)
+        ready = [extract_variables(g, voi) for g in raw]
+        arch = config["NeuralNetwork"]["Architecture"]
+        if arch.get("global_attn_engine"):
+            # Laplacian PE + relative edge PE feed GPS (reference:
+            # serialized_dataset_loader.py:89-94,182-189)
+            from .data.lappe import add_dataset_pe
+
+            ready = add_dataset_pe(ready, int(arch.get("pe_dim") or 1))
+        trainset, valset, testset = split_dataset(
+            ready,
+            perc_train=config["NeuralNetwork"]["Training"].get("perc_train", 0.7),
+            seed=0,
+            stratified=config.get("Dataset", {}).get(
+                "compositional_stratified_splitting", False
+            ),
+        )
+    else:
+        trainset, valset, testset = datasets
+        mm = None
+
+    config = update_config(config, trainset, valset, testset)
+    batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
+    spec = PadSpec.for_dataset(trainset + valset + testset, batch_size)
+    train_loader = GraphLoader(trainset, batch_size, spec=spec, shuffle=True, seed=0)
+    val_loader = GraphLoader(valset, batch_size, spec=spec, shuffle=False)
+    test_loader = GraphLoader(testset, batch_size, spec=spec, shuffle=False)
+    return config, (train_loader, val_loader, test_loader), mm
+
+
+@functools.singledispatch
+def run_training(config, datasets=None, verbosity: Optional[int] = None):
+    raise TypeError(f"config must be a dict or str path, got {type(config)}")
+
+
+@run_training.register
+def _(config: str, datasets=None, verbosity: Optional[int] = None):
+    return run_training(load_config(config), datasets, verbosity)
+
+
+@run_training.register
+def _(config: dict, datasets=None, verbosity: Optional[int] = None):
+    """(reference: run_training.py:62-182)"""
+    config, loaders, mm = prepare_data(config, datasets)
+    train_loader, val_loader, test_loader = loaders
+    verbosity = (
+        verbosity if verbosity is not None else config["Verbosity"].get("level", 0)
+    )
+    log_name = get_log_name_config(config)
+    save_config(config, log_name)
+
+    model = create_model(config)
+    variables = init_model(model, next(iter(train_loader)), seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+
+    save_fn = lambda s: save_model(s, log_name)
+    state, hist = train_validate_test(
+        model,
+        state,
+        tx,
+        train_loader,
+        val_loader,
+        test_loader,
+        config,
+        log_name=log_name,
+        verbosity=verbosity,
+        save_fn=save_fn,
+    )
+    save_model(state, log_name)
+    return model, state, hist, config, loaders, mm
+
+
+@functools.singledispatch
+def run_prediction(config, model_state=None, datasets=None):
+    raise TypeError(f"config must be a dict or str path, got {type(config)}")
+
+
+@run_prediction.register
+def _(config: str, model_state=None, datasets=None):
+    return run_prediction(load_config(config), model_state, datasets)
+
+
+@run_prediction.register
+def _(config: dict, model_state=None, datasets=None):
+    """(reference: run_prediction.py:49-107): rebuild model, restore latest
+    checkpoint, evaluate on the test split, optionally denormalize."""
+    config, loaders, mm = prepare_data(config, datasets)
+    _, _, test_loader = loaders
+    model = create_model(config)
+    if model_state is None:
+        variables = init_model(model, next(iter(test_loader)), seed=0)
+        tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+        template = TrainState.create(variables, tx)
+        log_name = get_log_name_config(config)
+        model_state = load_existing_model(template, log_name)
+    tot, tasks, preds, trues = test_model(model, model_state, test_loader)
+    var = config["NeuralNetwork"]["Variables_of_interest"]
+    if var.get("denormalize_output") and mm is not None:
+        voi = voi_from_config(config)
+        for name, t, idx in zip(var["output_names"], var["type"], var["output_index"]):
+            if t == "graph":
+                sl = voi.graph_feature_slice(idx)
+                preds[name] = mm.denormalize_graph(preds[name], sl)
+                trues[name] = mm.denormalize_graph(trues[name], sl)
+    return tot, tasks, preds, trues

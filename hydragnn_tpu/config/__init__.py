@@ -1,0 +1,23 @@
+from .config import (
+    average_degree,
+    check_if_graph_size_variable,
+    degree_histogram,
+    get_log_name_config,
+    load_config,
+    merge_config,
+    save_config,
+    update_config,
+    voi_from_config,
+)
+
+__all__ = [
+    "average_degree",
+    "check_if_graph_size_variable",
+    "degree_histogram",
+    "get_log_name_config",
+    "load_config",
+    "merge_config",
+    "save_config",
+    "update_config",
+    "voi_from_config",
+]

@@ -1,0 +1,256 @@
+"""JSON config system: defaults, data-derived completion, merge, save.
+
+Same JSON surface as the reference (four top sections ``Verbosity``,
+``Dataset``, ``NeuralNetwork`` {Architecture, Variables_of_interest, Training},
+``Visualization``) and the same "config is completed from data" behavior
+(reference: hydragnn/utils/input_config_parsing/config_utils.py:25-161).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..data.graph import Graph
+from ..data.pipeline import VariablesOfInterest
+
+# Architecture keys defaulted to None when absent
+# (reference: config_utils.py:98-156 one-by-one ifs).
+_ARCH_NONE_DEFAULTS = (
+    "radius",
+    "radial_type",
+    "distance_transform",
+    "num_gaussians",
+    "num_filters",
+    "envelope_exponent",
+    "num_after_skip",
+    "num_before_skip",
+    "basis_emb_size",
+    "int_emb_size",
+    "out_emb_size",
+    "num_radial",
+    "num_spherical",
+    "correlation",
+    "max_ell",
+    "node_max_ell",
+    "initial_bias",
+)
+
+EQUIVARIANT_MODELS = ("EGNN", "SchNet", "PNAEq", "PAINN", "MACE")
+PNA_MODELS = ("PNA", "PNAPlus", "PNAEq")
+
+
+def merge_config(base: Dict[str, Any], overlay: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursive deep-merge; overlay wins (reference: config_utils.py:380-388)."""
+    out = copy.deepcopy(base)
+    for k, v in overlay.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = merge_config(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def degree_histogram(graphs: Sequence[Graph], max_deg: int = 64) -> List[int]:
+    """In-degree histogram over all nodes of the dataset, used by PNA scalers
+    (reference: gather_deg, graph_samples_checks_and_updates.py:433-490)."""
+    hist = np.zeros(max_deg + 1, np.int64)
+    top = 0
+    for g in graphs:
+        deg = np.bincount(g.receivers, minlength=1)
+        deg = np.concatenate([deg, np.zeros(g.num_nodes - deg.shape[0], np.int64)])
+        h = np.bincount(deg.astype(np.int64), minlength=max_deg + 1)
+        if h.shape[0] > hist.shape[0]:
+            hist = np.concatenate([hist, np.zeros(h.shape[0] - hist.shape[0], np.int64)])
+        hist[: h.shape[0]] += h
+        top = max(top, int(deg.max(initial=0)))
+    return hist[: top + 1].tolist()
+
+
+def average_degree(graphs: Sequence[Graph]) -> float:
+    """Average in-degree (MACE avg_num_neighbors, reference: model.py:253-276)."""
+    e = sum(g.num_edges for g in graphs)
+    n = sum(g.num_nodes for g in graphs)
+    return float(e) / max(n, 1)
+
+
+def check_if_graph_size_variable(*datasets: Sequence[Graph]) -> bool:
+    """(reference: graph_samples_checks_and_updates.py:32-87)"""
+    env = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
+    if env is not None:
+        return bool(int(env))
+    sizes = {g.num_nodes for ds in datasets for g in ds}
+    return len(sizes) > 1
+
+
+def voi_from_config(config: Dict[str, Any]) -> VariablesOfInterest:
+    """Build the VariablesOfInterest selector from a (completed) config."""
+    var = config["NeuralNetwork"]["Variables_of_interest"]
+    ds = config.get("Dataset", {})
+    node_dims = ds.get("node_features", {}).get("dim", [1])
+    graph_dims = ds.get("graph_features", {}).get("dim", [])
+    return VariablesOfInterest(
+        input_node_features=var["input_node_features"],
+        output_names=var["output_names"],
+        output_types=var["type"],
+        output_index=var["output_index"],
+        node_feature_dims=node_dims,
+        graph_feature_dims=graph_dims,
+    )
+
+
+def update_config(
+    config: Dict[str, Any],
+    trainset: Sequence[Graph],
+    valset: Sequence[Graph],
+    testset: Sequence[Graph],
+) -> Dict[str, Any]:
+    """Complete a user config from the data, in place of the reference's
+    ``update_config`` (config_utils.py:25-161). Returns a new dict.
+
+    Derived fields: input_dim, per-head output dims/types, PNA degree
+    histogram, MACE avg_num_neighbors, GPS defaults, edge_dim, ~20 optional
+    keys, equivariance checks.
+    """
+    config = copy.deepcopy(config)
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+    var = config["NeuralNetwork"]["Variables_of_interest"]
+
+    graph_size_variable = check_if_graph_size_variable(trainset, valset, testset)
+    arch["graph_size_variable"] = graph_size_variable
+
+    # GPS defaults (reference: config_utils.py:40-47)
+    arch.setdefault("global_attn_engine", None)
+    arch.setdefault("global_attn_type", None)
+    arch.setdefault("global_attn_heads", 0)
+    arch.setdefault("pe_dim", 0)
+
+    training.setdefault("compute_grad_energy", False)
+
+    # ---- outputs (reference: update_config_NN_outputs, config_utils.py:219-260)
+    voi = voi_from_config(config)
+    sample = trainset[0]
+    output_dim: List[int] = []
+    for t, idx in zip(voi.output_types, voi.output_index):
+        if t == "graph":
+            output_dim.append(int(voi.graph_feature_dims[idx]))
+        elif t == "node":
+            dim = int(voi.node_feature_dims[idx])
+            node_head = arch["output_heads"].get("node", {})
+            if isinstance(node_head, list):  # multibranch list form
+                node_head = node_head[0].get("architecture", {}) if node_head else {}
+            if not graph_size_variable and node_head.get("type") == "mlp_per_node":
+                dim *= sample.num_nodes
+            output_dim.append(dim)
+        else:
+            raise ValueError(f"output type {t!r} not graph or node")
+    arch["output_dim"] = output_dim
+    arch["output_type"] = list(voi.output_types)
+    arch["num_nodes"] = sample.num_nodes
+    var.setdefault("denormalize_output", False)
+
+    arch["input_dim"] = voi.input_dim
+
+    # ---- PNA degree histogram / MACE average degree
+    if arch["mpnn_type"] in PNA_MODELS:
+        deg = degree_histogram(trainset)
+        arch["pna_deg"] = deg
+        arch["max_neighbours"] = len(deg) - 1
+    else:
+        arch["pna_deg"] = None
+    if arch["mpnn_type"] == "MACE":
+        arch["avg_num_neighbors"] = average_degree(trainset)
+    else:
+        arch["avg_num_neighbors"] = None
+
+    # CGCNN keeps hidden dim = input dim without global attention
+    # (reference: config_utils.py:80-87)
+    if arch["mpnn_type"] == "CGCNN" and not arch["global_attn_engine"]:
+        arch["hidden_dim"] = arch["input_dim"]
+
+    for key in _ARCH_NONE_DEFAULTS:
+        arch.setdefault(key, None)
+
+    # ---- edge dim (reference: update_config_edge_dim, config_utils.py:190-216)
+    edge_models = ("PNAPlus", "PNAEq", "PAINN", "GPS", "CGCNN", "SchNet", "EGNN", "DimeNet", "MACE")
+    if "edge_features" in config.get("Dataset", {}) and config["Dataset"]["edge_features"]:
+        assert (
+            arch["mpnn_type"] in edge_models or arch["global_attn_engine"]
+        ), "edge features can only be used with edge-aware models"
+        arch["edge_dim"] = len(config["Dataset"]["edge_features"])
+    elif arch["mpnn_type"] == "CGCNN":
+        arch["edge_dim"] = 0
+    else:
+        arch.setdefault("edge_dim", None)
+
+    # ---- equivariance (reference: update_config_equivariance, :164-177)
+    if arch.get("equivariance"):
+        assert arch["mpnn_type"] in EQUIVARIANT_MODELS, (
+            "E(3) equivariance can only be ensured for "
+            + ", ".join(EQUIVARIANT_MODELS)
+        )
+    arch.setdefault("equivariance", False)
+
+    arch.setdefault("freeze_conv_layers", False)
+    arch.setdefault("activation_function", "relu")
+    arch.setdefault("SyncBatchNorm", False)
+    arch.setdefault("periodic_boundary_conditions", False)
+    arch.setdefault("max_neighbours", None)
+    arch.setdefault("num_conv_layers", 1)
+    training.setdefault("conv_checkpointing", False)
+    training.setdefault("loss_function_type", "mse")
+    training.setdefault("batch_size", 32)
+    training.setdefault("num_epoch", 1)
+    training.setdefault("perc_train", 0.7)
+    training.setdefault("patience", 10)
+    training.setdefault("EarlyStopping", False)
+    training.setdefault("Checkpoint", False)
+    training.setdefault("checkpoint_warmup", 0)
+    training.setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
+    training["Optimizer"].setdefault("type", "AdamW")
+    training["Optimizer"].setdefault("learning_rate", 1e-3)
+    arch.setdefault("task_weights", [1.0] * len(output_dim))
+    assert len(arch["task_weights"]) == len(output_dim), (
+        f"task_weights {arch['task_weights']} must match number of heads {len(output_dim)}"
+    )
+
+    config.setdefault("Verbosity", {"level": 0})
+    config.setdefault("Visualization", {})
+    return config
+
+
+def get_log_name_config(config: Dict[str, Any]) -> str:
+    """Human-readable run name from key hyperparameters
+    (reference: config_utils.py:314-349, abbreviated)."""
+    arch = config["NeuralNetwork"]["Architecture"]
+    training = config["NeuralNetwork"]["Training"]
+    return (
+        f"{arch['mpnn_type']}"
+        f"-r-{arch.get('radius')}"
+        f"-ncl-{arch.get('num_conv_layers')}"
+        f"-hd-{arch.get('hidden_dim')}"
+        f"-ne-{training.get('num_epoch')}"
+        f"-lr-{training.get('Optimizer', {}).get('learning_rate')}"
+        f"-bs-{training.get('batch_size')}"
+    )
+
+
+def save_config(config: Dict[str, Any], log_name: str, path: str = "./logs") -> str:
+    """Dump the completed config next to the run logs
+    (reference: config_utils.py:352-358; rank-0 gating is the caller's job)."""
+    run_dir = os.path.join(path, log_name)
+    os.makedirs(run_dir, exist_ok=True)
+    fname = os.path.join(run_dir, "config.json")
+    with open(fname, "w") as f:
+        json.dump(config, f, indent=2)
+    return fname
+
+
+def load_config(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)

@@ -7,6 +7,7 @@ from .pipeline import (
     extract_variables,
     split_dataset,
 )
+from .lappe import add_dataset_pe, add_graph_pe, laplacian_pe
 from .synthetic import deterministic_graph_dataset
 
 __all__ = [

@@ -1,0 +1,122 @@
+"""On-disk dataset classes: abstract base + per-sample pickle store.
+
+TPU analogs of the reference's dataset classes
+(hydragnn/utils/datasets/abstractbasedataset.py:6-60,
+hydragnn/utils/datasets/pickledataset.py:14-182): an abstract get/len
+interface, a per-sample pickle dataset with a metadata header, and a writer.
+Multi-host: each host writes its own contiguous index range (the analog of
+the reference's MPI-offset write, pickledataset.py:103-182).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from abc import ABC, abstractmethod
+from typing import Any, Dict, Iterator, List, Optional
+
+from .graph import Graph
+
+# Known multi-dataset ids for GFM training
+# (reference: abstractbasedataset.py:41-57 hardcoded dataset_name dict)
+DATASET_NAME_IDS = {
+    "ani1x": 0,
+    "qm7x": 1,
+    "mptrj": 2,
+    "alexandria": 3,
+    "transition1x": 4,
+    "omat24": 5,
+}
+
+
+class AbstractBaseDataset(ABC):
+    """(reference: AbstractBaseDataset, abstractbasedataset.py:6-60)"""
+
+    @abstractmethod
+    def get(self, idx: int) -> Graph:
+        ...
+
+    @abstractmethod
+    def __len__(self) -> int:
+        ...
+
+    def __getitem__(self, idx: int) -> Graph:
+        g = self.get(idx)
+        name = getattr(self, "dataset_name", None)
+        if name in DATASET_NAME_IDS and g.dataset_id == 0:
+            g.dataset_id = DATASET_NAME_IDS[name]
+        return g
+
+    def __iter__(self) -> Iterator[Graph]:
+        for i in range(len(self)):
+            yield self[i]
+
+
+class SimplePickleDataset(AbstractBaseDataset):
+    """Per-sample .pkl files + a json meta header
+    (reference: SimplePickleDataset, pickledataset.py:14-100)."""
+
+    def __init__(self, basedir: str, label: str):
+        self.basedir = basedir
+        self.label = label
+        self.dataset_name = label
+        meta_path = os.path.join(basedir, f"{label}-meta.json")
+        with open(meta_path) as f:
+            self.meta: Dict[str, Any] = json.load(f)
+        self.ntotal = int(self.meta["ntotal"])
+        self.use_subdir = bool(self.meta.get("use_subdir", False))
+
+    def _fname(self, idx: int) -> str:
+        base = self.basedir
+        if self.use_subdir:
+            base = os.path.join(base, str(idx // 1000))
+        return os.path.join(base, f"{self.label}-{idx}.pkl")
+
+    def get(self, idx: int) -> Graph:
+        with open(self._fname(idx), "rb") as f:
+            return pickle.load(f)
+
+    def __len__(self) -> int:
+        return self.ntotal
+
+    @property
+    def minmax(self) -> Optional[Dict[str, Any]]:
+        return self.meta.get("minmax")
+
+
+class SimplePickleWriter:
+    """(reference: SimplePickleWriter, pickledataset.py:103-182)"""
+
+    def __init__(
+        self,
+        graphs: List[Graph],
+        basedir: str,
+        label: str,
+        minmax: Optional[Dict[str, Any]] = None,
+        use_subdir: bool = False,
+        host_count: int = 1,
+        host_index: int = 0,
+        nglobal: Optional[int] = None,
+        offset: Optional[int] = None,
+    ):
+        os.makedirs(basedir, exist_ok=True)
+        ntotal = nglobal if nglobal is not None else len(graphs)
+        start = offset if offset is not None else 0
+        if host_index == 0:
+            meta = {
+                "ntotal": ntotal,
+                "use_subdir": use_subdir,
+                "minmax": minmax,
+                "hosts": host_count,
+            }
+            with open(os.path.join(basedir, f"{label}-meta.json"), "w") as f:
+                json.dump(meta, f)
+        for i, g in enumerate(graphs):
+            idx = start + i
+            base = basedir
+            if use_subdir:
+                base = os.path.join(basedir, str(idx // 1000))
+                os.makedirs(base, exist_ok=True)
+            with open(os.path.join(base, f"{label}-{idx}.pkl"), "wb") as f:
+                pickle.dump(g, f)

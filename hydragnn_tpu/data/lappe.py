@@ -1,0 +1,54 @@
+"""Laplacian positional encodings for GPS.
+
+(reference: hydragnn/preprocess/serialized_dataset_loader.py:89-94,182-189 —
+``AddLaplacianEigenvectorPE(k=pe_dim)`` per graph plus relative edge encoding
+``rel_pe = |pe_src - pe_dst|``.)
+
+Host-side preprocessing with numpy/scipy: eigenvectors of the symmetric
+normalized Laplacian L = I - D^-1/2 A D^-1/2, skipping the trivial constant
+mode, sign-fixed for determinism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import numpy as np
+
+from .graph import Graph
+
+
+def laplacian_pe(
+    n: int, senders: np.ndarray, receivers: np.ndarray, k: int
+) -> np.ndarray:
+    """[n, k] eigenvectors for the k smallest non-trivial eigenvalues."""
+    A = np.zeros((n, n), np.float64)
+    A[receivers, senders] = 1.0
+    A = np.maximum(A, A.T)  # symmetrize
+    deg = A.sum(1)
+    dinv = 1.0 / np.sqrt(np.maximum(deg, 1e-12))
+    L = np.eye(n) - (dinv[:, None] * A * dinv[None, :])
+    w, v = np.linalg.eigh(L)
+    order = np.argsort(w)
+    pe = v[:, order[1 : k + 1]]  # skip trivial lowest mode
+    if pe.shape[1] < k:  # tiny graphs: zero-pad missing modes
+        pe = np.concatenate([pe, np.zeros((n, k - pe.shape[1]))], axis=1)
+    # deterministic sign: first nonzero entry of each vector positive
+    for c in range(pe.shape[1]):
+        col = pe[:, c]
+        nz = np.flatnonzero(np.abs(col) > 1e-8)
+        if nz.size and col[nz[0]] < 0:
+            pe[:, c] = -col
+    return pe.astype(np.float32)
+
+
+def add_graph_pe(graph: Graph, pe_dim: int) -> Graph:
+    """Attach ``pe`` [n, pe_dim] and ``rel_pe`` [e, pe_dim] to a graph."""
+    pe = laplacian_pe(graph.num_nodes, graph.senders, graph.receivers, pe_dim)
+    rel_pe = np.abs(pe[graph.senders] - pe[graph.receivers])
+    return dataclasses.replace(graph, pe=pe, rel_pe=rel_pe)
+
+
+def add_dataset_pe(graphs: List[Graph], pe_dim: int) -> List[Graph]:
+    return [add_graph_pe(g, pe_dim) for g in graphs]

@@ -14,7 +14,14 @@ from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .graph import Graph, GraphBatch, PadSpec, batch_graphs
+from .graph import (
+    Graph,
+    GraphBatch,
+    PadSpec,
+    batch_graphs,
+    batch_graphs_np,
+    graph_batch_from_np,
+)
 
 
 @dataclasses.dataclass
@@ -181,10 +188,22 @@ class GraphLoader:
         host_count: int = 1,
         host_index: int = 0,
         drop_last: bool = False,
+        num_shards: int = 1,
     ):
+        """``num_shards`` > 1 emits *stacked* batches with a leading device
+        axis [num_shards, ...]: each shard is an independent padded batch with
+        local indices, ready for ``shard_map`` data parallelism (``spec`` then
+        describes one shard of batch_size/num_shards graphs)."""
         self.graphs = graphs
         self.batch_size = batch_size
-        self.spec = spec or PadSpec.for_dataset(graphs, batch_size)
+        self.num_shards = num_shards
+        if num_shards > 1 and batch_size % num_shards != 0:
+            raise ValueError(
+                f"batch_size {batch_size} must be divisible by num_shards "
+                f"{num_shards} (each device takes batch_size/num_shards graphs)"
+            )
+        per_shard = max(batch_size // num_shards, 1)
+        self.spec = spec or PadSpec.for_dataset(graphs, per_shard)
         self.shuffle = shuffle
         self.seed = seed
         self.host_count = host_count
@@ -214,7 +233,24 @@ class GraphLoader:
         bs = self.batch_size
         n_full = len(idx) // bs
         for b in range(n_full):
-            yield batch_graphs([self.graphs[i] for i in idx[b * bs : (b + 1) * bs]], self.spec)
+            yield self._make([self.graphs[i] for i in idx[b * bs : (b + 1) * bs]])
         rem = len(idx) - n_full * bs
         if rem and not self.drop_last:
-            yield batch_graphs([self.graphs[i] for i in idx[n_full * bs :]], self.spec)
+            yield self._make([self.graphs[i] for i in idx[n_full * bs :]])
+
+    def _make(self, graphs: List[Graph]) -> GraphBatch:
+        if self.num_shards == 1:
+            return batch_graphs(graphs, self.spec)
+        shards = [graphs[s :: self.num_shards] for s in range(self.num_shards)]
+        arrs = [batch_graphs_np(s, self.spec) for s in shards if s]
+        template = {k: np.zeros_like(v) for k, v in arrs[0].items()}
+        # padding edges must still point at the dummy node slot
+        template["senders"] = np.full_like(arrs[0]["senders"], self.spec.n_nodes - 1)
+        template["receivers"] = template["senders"].copy()
+        template["node_graph"] = np.full_like(
+            arrs[0]["node_graph"], self.spec.n_graphs - 1
+        )
+        while len(arrs) < self.num_shards:
+            arrs.append(template)
+        stacked = {k: np.stack([a[k] for a in arrs]) for k in arrs[0]}
+        return graph_batch_from_np(stacked)

@@ -1,0 +1,29 @@
+from .base import (
+    GraphHeadConfig,
+    HydraModel,
+    ModelConfig,
+    NodeHeadConfig,
+    conv_registry,
+    register_conv,
+)
+from .create import (
+    available_models,
+    create_model,
+    init_model,
+    model_config_from,
+    normalize_output_heads,
+)
+
+__all__ = [
+    "GraphHeadConfig",
+    "HydraModel",
+    "ModelConfig",
+    "NodeHeadConfig",
+    "available_models",
+    "conv_registry",
+    "create_model",
+    "init_model",
+    "model_config_from",
+    "normalize_output_heads",
+    "register_conv",
+]

@@ -1,0 +1,38 @@
+"""CGCNN (crystal graph) convolution.
+
+(reference: hydragnn/models/CGCNNStack.py:20-113 wrapping PyG ``CGConv`` with
+aggr='add', batch_norm=False; dimension-preserving, so the config pins
+hidden_dim = input_dim unless GPS is on, config_utils.py:80-87.)
+
+x_i' = x_i + sum_j sigmoid(z_ij W_f + b_f) * softplus(z_ij W_s + b_s),
+z_ij = [x_i, x_j(, e_ij)].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.segment import segment_sum
+from .base import register_conv
+
+
+class CGConv(nn.Module):
+    output_dim: int  # must equal input dim (dimension-preserving residual)
+    edge_dim: int = 0
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        parts = [inv[batch.receivers], inv[batch.senders]]
+        if self.edge_dim and batch.edge_attr is not None:
+            parts.append(batch.edge_attr)
+        z = jnp.concatenate(parts, axis=-1)
+        gate = nn.sigmoid(nn.Dense(self.output_dim)(z))
+        core = nn.softplus(nn.Dense(self.output_dim)(z))
+        agg = segment_sum(gate * core, batch.receivers, batch.num_nodes, batch.edge_mask)
+        return inv + agg, equiv
+
+
+@register_conv("CGCNN", is_edge_model=True)
+def make_cgcnn(cfg, in_dim, out_dim, last_layer):
+    return CGConv(output_dim=out_dim, edge_dim=cfg.edge_dim)

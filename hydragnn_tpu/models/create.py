@@ -1,0 +1,141 @@
+"""Model factory: completed JSON config -> ``HydraModel`` + initial variables.
+
+TPU analog of the reference factory (hydragnn/models/create.py:35-519). The
+reference's giant per-model switch with PyG ``Sequential`` arg-strings is
+replaced by the conv registry (models/base.py): each model file registers a
+constructor; everything else (heads, GPS wrapping, checkpointing) is uniform
+in ``HydraModel``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..data.graph import GraphBatch
+from .base import (
+    GraphHeadConfig,
+    HydraModel,
+    ModelConfig,
+    NodeHeadConfig,
+    conv_registry,
+)
+
+# import model files for their registry side effects
+from . import cgcnn as _cgcnn  # noqa: F401
+from . import gat as _gat  # noqa: F401
+from . import gin as _gin  # noqa: F401
+from . import mfc as _mfc  # noqa: F401
+from . import pna as _pna  # noqa: F401
+from . import sage as _sage  # noqa: F401
+
+
+def normalize_output_heads(heads: Dict[str, Any]) -> Dict[str, List[Dict[str, Any]]]:
+    """Upgrade legacy single-branch head configs to the multibranch list form
+    (reference: update_multibranch_heads, hydragnn/utils/model/model.py:152-187)."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for key, val in heads.items():
+        if isinstance(val, list):
+            out[key] = val
+        else:
+            out[key] = [{"type": "branch-0", "architecture": dict(val)}]
+    return out
+
+
+def model_config_from(config: Dict[str, Any]) -> ModelConfig:
+    """Build the frozen ModelConfig from a *completed* config dict
+    (i.e. after ``hydragnn_tpu.config.update_config``)."""
+    nn_cfg = config["NeuralNetwork"]
+    arch = nn_cfg["Architecture"]
+    training = nn_cfg["Training"]
+    var = nn_cfg["Variables_of_interest"]
+
+    heads = normalize_output_heads(arch["output_heads"])
+    graph_head = None
+    node_head = None
+    num_branches = 1
+    if "graph" in heads:
+        num_branches = len(heads["graph"])
+        a = heads["graph"][0]["architecture"]
+        graph_head = GraphHeadConfig(
+            num_sharedlayers=a.get("num_sharedlayers", 2),
+            dim_sharedlayers=a.get("dim_sharedlayers", 10),
+            num_headlayers=a.get("num_headlayers", 2),
+            dim_headlayers=tuple(a.get("dim_headlayers", (10, 10))),
+        )
+    if "node" in heads:
+        a = heads["node"][0]["architecture"]
+        node_head = NodeHeadConfig(
+            nn_type=a.get("type", "mlp"),
+            num_headlayers=a.get("num_headlayers", 2),
+            dim_headlayers=tuple(a.get("dim_headlayers", (10, 10))),
+        )
+
+    loss_type = training.get("loss_function_type", "mse")
+    return ModelConfig(
+        mpnn_type=arch["mpnn_type"],
+        input_dim=int(arch["input_dim"]),
+        hidden_dim=int(arch["hidden_dim"]),
+        num_conv_layers=int(arch["num_conv_layers"]),
+        output_names=tuple(var["output_names"]),
+        output_dim=tuple(int(d) for d in arch["output_dim"]),
+        output_type=tuple(arch["output_type"]),
+        task_weights=tuple(float(w) for w in arch["task_weights"]),
+        graph_head=graph_head,
+        node_head=node_head,
+        num_branches=num_branches,
+        activation=arch.get("activation_function", "relu"),
+        loss_function_type=loss_type,
+        global_attn_engine=arch.get("global_attn_engine") or "",
+        global_attn_type=arch.get("global_attn_type") or "",
+        global_attn_heads=int(arch.get("global_attn_heads") or 0),
+        pe_dim=int(arch.get("pe_dim") or 0),
+        edge_dim=int(arch.get("edge_dim") or 0),
+        radius=arch.get("radius"),
+        num_gaussians=arch.get("num_gaussians"),
+        num_filters=arch.get("num_filters"),
+        num_radial=arch.get("num_radial"),
+        num_spherical=arch.get("num_spherical"),
+        envelope_exponent=arch.get("envelope_exponent"),
+        radial_type=arch.get("radial_type"),
+        distance_transform=arch.get("distance_transform"),
+        basis_emb_size=arch.get("basis_emb_size"),
+        int_emb_size=arch.get("int_emb_size"),
+        out_emb_size=arch.get("out_emb_size"),
+        num_before_skip=arch.get("num_before_skip"),
+        num_after_skip=arch.get("num_after_skip"),
+        pna_deg=tuple(arch.get("pna_deg") or ()),
+        avg_num_neighbors=arch.get("avg_num_neighbors"),
+        max_ell=arch.get("max_ell"),
+        node_max_ell=arch.get("node_max_ell"),
+        correlation=arch.get("correlation"),
+        equivariance=bool(arch.get("equivariance", False)),
+        num_nodes=arch.get("num_nodes"),
+        var_output=loss_type == "GaussianNLLLoss",
+        conv_checkpointing=bool(training.get("conv_checkpointing", False)),
+        freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
+        initial_bias=arch.get("initial_bias"),
+        periodic_boundary_conditions=bool(arch.get("periodic_boundary_conditions", False)),
+        max_neighbours=arch.get("max_neighbours"),
+    )
+
+
+def create_model(config: Dict[str, Any]) -> HydraModel:
+    """Completed config dict -> flax model (reference: create_model_config,
+    create.py:35-82)."""
+    return HydraModel(cfg=model_config_from(config))
+
+
+def init_model(
+    model: HydraModel, sample_batch: GraphBatch, seed: int = 0
+) -> Dict[str, Any]:
+    """Initialize variables deterministically (reference seeds construction
+    with torch.manual_seed(0), create.py:131)."""
+    rngs = {"params": jax.random.PRNGKey(seed), "dropout": jax.random.PRNGKey(seed + 1)}
+    return model.init(rngs, sample_batch, train=False)
+
+
+def available_models() -> Tuple[str, ...]:
+    return conv_registry()

@@ -1,0 +1,59 @@
+"""GATv2 convolution.
+
+(reference: hydragnn/models/GATStack.py:20-208 wrapping PyG ``GATv2Conv``;
+factory hardcodes heads=6, negative_slope=0.05, create.py:220-222. Hidden
+layers concatenate heads (width hidden*heads); the final layer averages heads,
+GATStack._init_conv.)
+
+GATv2 attention: e_ij = a^T LeakyReLU(W_l x_i + W_r x_j (+ W_e e_ij)),
+alpha = softmax_i(e_ij), out_i = sum_j alpha_ij (W_r x_j).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.segment import segment_softmax, segment_sum
+from .base import register_conv
+
+
+class GATv2Conv(nn.Module):
+    output_dim: int
+    heads: int = 6
+    concat: bool = True
+    negative_slope: float = 0.05
+    edge_dim: int = 0
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        H, C = self.heads, self.output_dim
+        x_l = nn.Dense(H * C)(inv).reshape(-1, H, C)  # target/query side
+        x_r = nn.Dense(H * C)(inv).reshape(-1, H, C)  # source/value side
+        g = x_l[batch.receivers] + x_r[batch.senders]
+        if self.edge_dim and batch.edge_attr is not None:
+            g = g + nn.Dense(H * C)(batch.edge_attr).reshape(-1, H, C)
+        g = nn.leaky_relu(g, negative_slope=self.negative_slope)
+        att = self.param("att", nn.initializers.glorot_uniform(), (1, H, C))
+        logits = jnp.sum(g * att, axis=-1)  # [E, H]
+        alpha = segment_softmax(
+            logits, batch.receivers, batch.num_nodes, batch.edge_mask
+        )
+        msg = x_r[batch.senders] * alpha[..., None]  # [E, H, C]
+        out = segment_sum(msg, batch.receivers, batch.num_nodes, batch.edge_mask)
+        if self.concat:
+            return out.reshape(-1, H * C), equiv
+        return out.mean(axis=1), equiv
+
+
+@register_conv("GAT", is_edge_model=True)
+def make_gat(cfg, in_dim, out_dim, last_layer):
+    # last conv averages heads (concat=False), hidden convs concatenate
+    # (reference: GATStack._init_conv, GATStack.py:117-175)
+    return GATv2Conv(
+        output_dim=out_dim,
+        heads=6,
+        concat=not last_layer,
+        negative_slope=0.05,
+        edge_dim=cfg.edge_dim,
+    )

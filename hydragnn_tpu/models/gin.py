@@ -1,0 +1,36 @@
+"""GIN convolution (reference: hydragnn/models/GINStack.py:20-60).
+
+x_i' = MLP((1 + eps) * x_i + sum_{j in N(i)} x_j) with a 2-layer MLP
+(Linear-ReLU-Linear) and a *learnable* eps initialized to 100.0, matching the
+reference's ``GINConv(..., eps=100.0, train_eps=True)``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.segment import segment_sum
+from .base import register_conv
+
+
+class GINConv(nn.Module):
+    output_dim: int
+    eps_init: float = 100.0
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        eps = self.param("eps", lambda _: jnp.asarray(self.eps_init, jnp.float32))
+        agg = segment_sum(
+            inv[batch.senders], batch.receivers, batch.num_nodes, batch.edge_mask
+        )
+        h = (1.0 + eps) * inv + agg
+        h = nn.Dense(self.output_dim)(h)
+        h = nn.relu(h)
+        h = nn.Dense(self.output_dim)(h)
+        return h, equiv
+
+
+@register_conv("GIN", is_edge_model=False)
+def make_gin(cfg, in_dim, out_dim, last_layer):
+    return GINConv(output_dim=out_dim)

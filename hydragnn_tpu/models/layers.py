@@ -1,0 +1,91 @@
+"""Shared building blocks: MLP, masked batch norm, activation resolver."""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+ACTIVATIONS = {
+    "relu": nn.relu,
+    "gelu": nn.gelu,
+    "silu": nn.silu,
+    "swish": nn.silu,
+    "tanh": jnp.tanh,
+    "sigmoid": nn.sigmoid,
+    "elu": nn.elu,
+    "leaky_relu": nn.leaky_relu,
+    "softplus": nn.softplus,
+    "identity": lambda x: x,
+}
+
+
+def get_activation(name: str) -> Callable:
+    """(reference activation selection: hydragnn/utils/model/model.py and
+    loss/activation test, tests/test_loss_and_activation_functions.py)"""
+    try:
+        return ACTIVATIONS[name.lower()]
+    except KeyError:
+        raise ValueError(f"unknown activation {name!r}; known: {sorted(ACTIVATIONS)}")
+
+
+class MLP(nn.Module):
+    """Dense stack with activation between layers, none after the last
+    (matches the reference's Sequential(Linear, act, ..., Linear) head MLPs,
+    Base.py:372-392)."""
+
+    features: Sequence[int]
+    activation: str = "relu"
+    final_activation: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        act = get_activation(self.activation)
+        for i, f in enumerate(self.features):
+            x = nn.Dense(f)(x)
+            if i < len(self.features) - 1 or self.final_activation:
+                x = act(x)
+        return x
+
+
+class MaskedBatchNorm(nn.Module):
+    """BatchNorm1d over *real* nodes only.
+
+    The reference applies torch BatchNorm1d after every conv (Base.py:214,466).
+    With padded static batches the statistics must exclude padding rows, hence
+    this masked variant; running stats live in the ``batch_stats`` collection.
+    """
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jnp.ndarray] = None, train: bool = True):
+        features = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+        scale = self.param("scale", nn.initializers.ones, (features,))
+        bias = self.param("bias", nn.initializers.zeros, (features,))
+
+        if train:
+            if mask is None:
+                mean = jnp.mean(x, axis=0)
+                var = jnp.var(x, axis=0)
+            else:
+                m = mask[:, None].astype(x.dtype)
+                n = jnp.maximum(jnp.sum(m), 1.0)
+                mean = jnp.sum(x * m, axis=0) / n
+                var = jnp.sum(((x - mean) ** 2) * m, axis=0) / n
+            if not self.is_initializing():
+                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+        else:
+            mean, var = ra_mean.value, ra_var.value
+
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        return y * scale + bias

@@ -1,0 +1,83 @@
+"""Principal Neighbourhood Aggregation convolution.
+
+(reference: hydragnn/models/PNAStack.py:19-71 wrapping PyG ``PNAConv`` with
+aggregators [mean, min, max, std], scalers [identity, amplification,
+attenuation, linear], degree histogram from the dataset, pre_layers=1,
+post_layers=1, towers=1, divide_input=False.)
+
+Message: pre-MLP over [x_i, x_j(, edge)] -> aggregate 4 ways -> scale by 3
+degree scalers (+identity) -> post-MLP over [x_i, scaled] -> out.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.segment import (
+    segment_count,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_std,
+)
+from .base import register_conv
+
+
+def _avg_deg_stats(deg_hist: Tuple[int, ...]) -> Tuple[float, float]:
+    """(avg_log_deg, avg_lin_deg) from the dataset degree histogram, the
+    normalizers PyG precomputes from ``deg``."""
+    if not deg_hist:
+        return 1.0, 1.0
+    total = float(sum(deg_hist)) or 1.0
+    avg_log = sum(n * math.log(d + 1) for d, n in enumerate(deg_hist)) / total
+    avg_lin = sum(n * d for d, n in enumerate(deg_hist)) / total
+    return max(avg_log, 1e-6), max(avg_lin, 1e-6)
+
+
+class PNAConv(nn.Module):
+    output_dim: int
+    deg_hist: Tuple[int, ...]
+    edge_dim: int = 0
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        x_i = inv[batch.receivers]
+        x_j = inv[batch.senders]
+        parts = [x_i, x_j]
+        if self.edge_dim and batch.edge_attr is not None:
+            parts.append(batch.edge_attr)
+        # pre-MLP, pre_layers=1
+        f_in = inv.shape[-1]
+        msg = nn.Dense(f_in)(jnp.concatenate(parts, axis=-1))
+
+        n = batch.num_nodes
+        aggs = [
+            segment_mean(msg, batch.receivers, n, batch.edge_mask),
+            segment_min(msg, batch.receivers, n, batch.edge_mask),
+            segment_max(msg, batch.receivers, n, batch.edge_mask),
+            segment_std(msg, batch.receivers, n, batch.edge_mask),
+        ]
+        agg = jnp.concatenate(aggs, axis=-1)
+
+        avg_log, avg_lin = _avg_deg_stats(self.deg_hist)
+        deg = segment_count(batch.receivers, n, batch.edge_mask)[:, None]
+        log_deg = jnp.log(deg + 1.0)
+        amplification = log_deg / avg_log
+        attenuation = avg_log / jnp.maximum(log_deg, 1e-6)
+        linear = deg / avg_lin
+        scaled = jnp.concatenate(
+            [agg, agg * amplification, agg * attenuation, agg * linear], axis=-1
+        )
+        # post-MLP, post_layers=1, then final linear projection
+        out = nn.Dense(self.output_dim)(jnp.concatenate([inv, scaled], axis=-1))
+        out = nn.Dense(self.output_dim)(out)
+        return out, equiv
+
+
+@register_conv("PNA", is_edge_model=True)
+def make_pna(cfg, in_dim, out_dim, last_layer):
+    return PNAConv(output_dim=out_dim, deg_hist=cfg.pna_deg, edge_dim=cfg.edge_dim)

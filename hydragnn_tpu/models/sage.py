@@ -1,0 +1,31 @@
+"""GraphSAGE convolution (reference: hydragnn/models/SAGEStack.py:18-53).
+
+x_i' = W_root x_i + W_neigh mean_{j in N(i)} x_j  (PyG SAGEConv defaults:
+mean aggregation, root weight, bias on the root projection).
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..ops.segment import segment_mean
+from .base import register_conv
+
+
+class SAGEConv(nn.Module):
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        agg = segment_mean(
+            inv[batch.senders], batch.receivers, batch.num_nodes, batch.edge_mask
+        )
+        h = nn.Dense(self.output_dim, use_bias=True)(agg) + nn.Dense(
+            self.output_dim, use_bias=False
+        )(inv)
+        return h, equiv
+
+
+@register_conv("SAGE", is_edge_model=False)
+def make_sage(cfg, in_dim, out_dim, last_layer):
+    return SAGEConv(output_dim=out_dim)

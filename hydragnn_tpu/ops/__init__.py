@@ -1,0 +1,23 @@
+from .segment import (
+    masked_global_mean_pool,
+    masked_global_sum_pool,
+    segment_count,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_softmax,
+    segment_std,
+    segment_sum,
+)
+
+__all__ = [
+    "masked_global_mean_pool",
+    "masked_global_sum_pool",
+    "segment_count",
+    "segment_max",
+    "segment_mean",
+    "segment_min",
+    "segment_softmax",
+    "segment_std",
+    "segment_sum",
+]

@@ -1,0 +1,98 @@
+"""Masked segment reductions — the TPU replacement for torch_scatter.
+
+The reference's message passing relies on torch_scatter/PyG CUDA scatter
+kernels (SURVEY §2.3 item 2). On TPU the idiomatic lowering is
+``jax.ops.segment_sum`` over statically shaped arrays: XLA turns sorted
+segment reductions into efficient one-pass kernels and fuses the surrounding
+elementwise math. Padding edges/nodes are neutralized by masks rather than by
+dynamic shapes.
+
+All functions take ``num_segments`` statically so shapes stay fixed under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_messages(messages: jnp.ndarray, mask: Optional[jnp.ndarray], fill: float = 0.0):
+    if mask is None:
+        return messages
+    m = mask.reshape(mask.shape + (1,) * (messages.ndim - mask.ndim))
+    return jnp.where(m, messages, fill)
+
+
+def segment_sum(messages, segment_ids, num_segments, mask=None):
+    return jax.ops.segment_sum(
+        _mask_messages(messages, mask), segment_ids, num_segments=num_segments
+    )
+
+
+def segment_count(segment_ids, num_segments, mask=None):
+    ones = jnp.ones(segment_ids.shape[:1], jnp.float32)
+    if mask is not None:
+        ones = jnp.where(mask, ones, 0.0)
+    return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
+
+
+def segment_mean(messages, segment_ids, num_segments, mask=None, eps: float = 0.0):
+    s = segment_sum(messages, segment_ids, num_segments, mask)
+    n = segment_count(segment_ids, num_segments, mask)
+    n = jnp.maximum(n, 1.0) if eps == 0.0 else n + eps
+    return s / n.reshape(n.shape + (1,) * (s.ndim - 1))
+
+
+def segment_max(messages, segment_ids, num_segments, mask=None):
+    neg = jnp.finfo(messages.dtype).min
+    m = jax.ops.segment_max(
+        _mask_messages(messages, mask, neg), segment_ids, num_segments=num_segments
+    )
+    # segments with no (real) incoming messages -> 0, like torch_scatter 'max'
+    return jnp.where(m <= neg / 2, 0.0, m)
+
+
+def segment_min(messages, segment_ids, num_segments, mask=None):
+    pos = jnp.finfo(messages.dtype).max
+    m = jax.ops.segment_min(
+        _mask_messages(messages, mask, pos), segment_ids, num_segments=num_segments
+    )
+    return jnp.where(m >= pos / 2, 0.0, m)
+
+
+def segment_std(messages, segment_ids, num_segments, mask=None, eps: float = 1e-5):
+    """Population std per segment (PNA 'std' aggregator semantics)."""
+    mean = segment_mean(messages, segment_ids, num_segments, mask)
+    mean_sq = segment_mean(messages**2, segment_ids, num_segments, mask)
+    var = jnp.maximum(mean_sq - mean**2, 0.0)
+    return jnp.sqrt(var + eps)
+
+
+def segment_softmax(logits, segment_ids, num_segments, mask=None):
+    """Numerically stable softmax within each segment (GAT attention)."""
+    neg = jnp.finfo(logits.dtype).min
+    masked = _mask_messages(logits, mask, neg)
+    seg_max = jax.ops.segment_max(masked, segment_ids, num_segments=num_segments)
+    seg_max = jnp.where(seg_max <= neg / 2, 0.0, seg_max)
+    shifted = masked - seg_max[segment_ids]
+    exp = jnp.exp(shifted)
+    if mask is not None:
+        exp = _mask_messages(exp, mask, 0.0)
+    denom = jax.ops.segment_sum(exp, segment_ids, num_segments=num_segments)
+    return exp / jnp.maximum(denom[segment_ids], 1e-16)
+
+
+def gather(values, index):
+    """Row gather: values[index] — spelled out for symmetry with scatter."""
+    return jnp.take(values, index, axis=0)
+
+
+def masked_global_mean_pool(x, node_graph, num_graphs, node_mask):
+    """Per-graph mean over real nodes (reference: global_mean_pool, Base.py:478)."""
+    return segment_mean(x, node_graph, num_graphs, node_mask)
+
+
+def masked_global_sum_pool(x, node_graph, num_graphs, node_mask):
+    return segment_sum(x, node_graph, num_graphs, node_mask)

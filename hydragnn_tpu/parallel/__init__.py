@@ -1,0 +1,25 @@
+from .mesh import (
+    BRANCH_AXIS,
+    DATA_AXIS,
+    batch_sharding,
+    local_host_info,
+    make_mesh,
+    replicate_state,
+    replicated,
+    setup_distributed,
+    shard_batch,
+    shard_optimizer_state,
+)
+
+__all__ = [
+    "BRANCH_AXIS",
+    "DATA_AXIS",
+    "batch_sharding",
+    "local_host_info",
+    "make_mesh",
+    "replicate_state",
+    "replicated",
+    "setup_distributed",
+    "shard_batch",
+    "shard_optimizer_state",
+]

@@ -1,0 +1,33 @@
+from .checkpoint import load_existing_model, save_model
+from .loop import (
+    BestCheckpoint,
+    EarlyStopping,
+    evaluate,
+    make_eval_step,
+    make_train_step,
+    test_model,
+    train_epoch,
+    train_validate_test,
+)
+from .loss import head_loss, masked_mean, multitask_loss
+from .optimizer import ReduceLROnPlateau, make_optimizer
+from .state import TrainState
+
+__all__ = [
+    "BestCheckpoint",
+    "EarlyStopping",
+    "ReduceLROnPlateau",
+    "TrainState",
+    "evaluate",
+    "head_loss",
+    "load_existing_model",
+    "make_eval_step",
+    "make_optimizer",
+    "make_train_step",
+    "masked_mean",
+    "multitask_loss",
+    "save_model",
+    "test_model",
+    "train_epoch",
+    "train_validate_test",
+]

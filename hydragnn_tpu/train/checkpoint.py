@@ -1,0 +1,58 @@
+"""Model/optimizer checkpoint IO.
+
+Equivalent of the reference's save/load (hydragnn/utils/model/model.py:63-149):
+one file per save holding model + optimizer state, per-epoch files plus a
+``latest`` pointer. Serialization is flax msgpack over the TrainState pytree
+(device arrays -> host); restore requires a template state of the same
+structure, which ``run_prediction`` rebuilds from the saved config.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from flax import serialization
+
+from .state import TrainState
+
+
+def _run_dir(log_name: str, path: str = "./logs") -> str:
+    d = os.path.join(path, log_name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def save_model(
+    state: TrainState, log_name: str, path: str = "./logs", epoch: Optional[int] = None
+) -> str:
+    """Serialize state; per-epoch filename + 'latest' pointer file
+    (reference: model.py:63-106, HYDRAGNN_EPOCH env drives per-epoch names)."""
+    if epoch is None:
+        env = os.getenv("HYDRAGNN_EPOCH")
+        epoch = int(env) if env is not None else None
+    d = _run_dir(log_name, path)
+    suffix = f"_epoch{epoch}" if epoch is not None else ""
+    fname = os.path.join(d, f"{log_name}{suffix}.msgpack")
+    with open(fname, "wb") as f:
+        f.write(serialization.to_bytes(state))
+    latest = os.path.join(d, "latest")
+    with open(latest, "w") as f:
+        f.write(os.path.basename(fname))
+    return fname
+
+
+def load_existing_model(
+    template_state: TrainState, log_name: str, path: str = "./logs"
+) -> TrainState:
+    """Restore into a template with identical pytree structure
+    (reference: load_existing_model, model.py:128-149)."""
+    d = os.path.join(path, log_name)
+    latest = os.path.join(d, "latest")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            fname = os.path.join(d, f.read().strip())
+    else:
+        fname = os.path.join(d, f"{log_name}.msgpack")
+    with open(fname, "rb") as f:
+        return serialization.from_bytes(template_state, f.read())

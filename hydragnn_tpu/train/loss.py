@@ -1,0 +1,92 @@
+"""Masked multi-task losses.
+
+Equivalent of the reference's ``Base.loss``/``loss_hpweighted``
+(hydragnn/models/Base.py:572-580, 659-686) adapted to padded batches: every
+reduction is over *real* rows only (graph_mask / node_mask), which reproduces
+the reference's per-batch mean over ragged tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from ..data.graph import GraphBatch
+from ..models.base import ModelConfig
+
+
+def _elementwise(loss_type: str, err: jnp.ndarray) -> jnp.ndarray:
+    lt = loss_type.lower()
+    if lt == "mse":
+        return err**2
+    if lt in ("mae", "l1"):
+        return jnp.abs(err)
+    if lt == "rmse":  # reduced later; rmse applied at head level
+        return err**2
+    raise ValueError(
+        f"unknown loss_function_type {loss_type!r} (GaussianNLLLoss is handled "
+        "by multitask_loss via the variance heads)"
+    )
+
+
+def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    m = mask.reshape(mask.shape + (1,) * (values.ndim - mask.ndim)).astype(values.dtype)
+    denom = jnp.maximum(jnp.sum(m) * values.shape[-1], 1.0)
+    return jnp.sum(values * m) / denom
+
+
+def head_loss(
+    pred: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray,
+    loss_type: str,
+) -> jnp.ndarray:
+    per_elem = _elementwise(loss_type, pred - target)
+    loss = masked_mean(per_elem, mask)
+    if loss_type.lower() == "rmse":
+        loss = jnp.sqrt(loss)
+    return loss
+
+
+def gaussian_nll(
+    pred: jnp.ndarray,
+    var: jnp.ndarray,
+    target: jnp.ndarray,
+    mask: jnp.ndarray,
+    eps: float = 1e-6,
+) -> jnp.ndarray:
+    """Gaussian negative log likelihood with predicted variance
+    (torch GaussianNLLLoss semantics, full=False; reference wires the variance
+    head via var_output, Base.py:92-96 and the `headvar = out**2` split)."""
+    v = jnp.maximum(var, eps)
+    per_elem = 0.5 * (jnp.log(v) + (pred - target) ** 2 / v)
+    return masked_mean(per_elem, mask)
+
+
+def multitask_loss(
+    outputs: Dict[str, jnp.ndarray],
+    batch: GraphBatch,
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """Total weighted loss + per-task unweighted losses
+    (reference: loss_hpweighted, Base.py:659-686)."""
+    weights = cfg.normalized_task_weights
+    tot = 0.0
+    tasks: Dict[str, jnp.ndarray] = {}
+    for name, t, w in zip(cfg.output_names, cfg.output_type, weights):
+        pred = outputs[name]
+        if t == "graph":
+            target = batch.graph_targets[name]
+            mask = batch.graph_mask
+        else:
+            target = batch.node_targets[name]
+            mask = batch.node_mask
+        target = target.reshape(pred.shape)
+        if cfg.var_output:
+            task = gaussian_nll(pred, outputs[f"{name}__var"], target, mask)
+        else:
+            task = head_loss(pred, target, mask, cfg.loss_function_type)
+        tasks[name] = task
+        tot = tot + w * task
+    return tot, tasks

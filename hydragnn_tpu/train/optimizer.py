@@ -1,0 +1,79 @@
+"""Optimizer selection (optax) + ReduceLROnPlateau schedule.
+
+Replaces the reference's torch optimizer factory and DeepSpeed FusedLAMB
+(hydragnn/utils/optimizer/optimizer.py:12-113) with optax; the ZeRO
+``ZeroRedundancyOptimizer`` analog is optimizer-state sharding handled by the
+parallel layer (optimizer state inherits the parameter sharding or is sharded
+over the data axis — see hydragnn_tpu/parallel).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import optax
+
+
+def make_optimizer(opt_config: Dict[str, Any]) -> optax.GradientTransformation:
+    """(reference: select_optimizer, optimizer.py:104-113)"""
+    kind = opt_config.get("type", "AdamW")
+    lr = float(opt_config.get("learning_rate", 1e-3))
+    table = {
+        "SGD": lambda: optax.sgd(lr),
+        "Adam": lambda: optax.adam(lr),
+        "Adadelta": lambda: optax.adadelta(lr),
+        "Adagrad": lambda: optax.adagrad(lr),
+        "Adamax": lambda: optax.adamax(lr),
+        "AdamW": lambda: optax.adamw(lr),
+        "RMSprop": lambda: optax.rmsprop(lr),
+        # FusedLAMB (DeepSpeed CUDA kernel) -> optax.lamb: XLA fuses on TPU
+        "FusedLAMB": lambda: optax.lamb(lr),
+        "LAMB": lambda: optax.lamb(lr),
+    }
+    if kind not in table:
+        raise ValueError(f"unknown optimizer {kind!r}; known: {sorted(table)}")
+    # inject_hyperparams makes learning_rate runtime-adjustable so the
+    # plateau scheduler can scale it between epochs without recompiling.
+    return optax.inject_hyperparams(lambda learning_rate: _with_lr(kind, learning_rate))(
+        learning_rate=lr
+    )
+
+
+def _with_lr(kind: str, lr) -> optax.GradientTransformation:
+    return {
+        "SGD": optax.sgd,
+        "Adam": optax.adam,
+        "Adadelta": optax.adadelta,
+        "Adagrad": optax.adagrad,
+        "Adamax": optax.adamax,
+        "AdamW": optax.adamw,
+        "RMSprop": optax.rmsprop,
+        "FusedLAMB": optax.lamb,
+        "LAMB": optax.lamb,
+    }[kind](lr)
+
+
+@dataclasses.dataclass
+class ReduceLROnPlateau:
+    """Host-side plateau scheduler with torch semantics
+    (reference: run_training.py:102-104 — mode=min, factor=0.5, patience=5,
+    min_lr=1e-5; stepped on validation loss each epoch,
+    train_validate_test.py:197)."""
+
+    factor: float = 0.5
+    patience: int = 5
+    min_lr: float = 1e-5
+    best: float = float("inf")
+    bad_epochs: int = 0
+
+    def step(self, val_loss: float, current_lr: float) -> float:
+        if val_loss < self.best:
+            self.best = val_loss
+            self.bad_epochs = 0
+            return current_lr
+        self.bad_epochs += 1
+        if self.bad_epochs > self.patience:
+            self.bad_epochs = 0
+            return max(current_lr * self.factor, self.min_lr)
+        return current_lr

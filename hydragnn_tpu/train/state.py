@@ -1,0 +1,43 @@
+"""Training state pytree: params, batch stats, optimizer state, step."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import optax
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    step: int
+
+    @staticmethod
+    def create(variables: Dict[str, Any], tx: optax.GradientTransformation) -> "TrainState":
+        params = variables["params"]
+        return TrainState(
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(params),
+            step=0,
+        )
+
+    def variables(self) -> Dict[str, Any]:
+        v = {"params": self.params}
+        if self.batch_stats:
+            v["batch_stats"] = self.batch_stats
+        return v
+
+    @property
+    def learning_rate(self) -> float:
+        """Current injected learning rate (inject_hyperparams state)."""
+        return float(self.opt_state.hyperparams["learning_rate"])
+
+    def with_learning_rate(self, lr: float) -> "TrainState":
+        hp = dict(self.opt_state.hyperparams)
+        hp["learning_rate"] = jax.numpy.asarray(lr, dtype=jax.numpy.float32)
+        return self.replace(opt_state=self.opt_state._replace(hyperparams=hp))

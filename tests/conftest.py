@@ -4,14 +4,41 @@ Mirrors the reference CI strategy of exercising distributed code paths on CPU
 (reference: .github/workflows/CI.yml:57-63 runs pytest under 2-rank Gloo);
 here a single process exposes 8 XLA CPU devices so mesh/sharding code runs
 for real without TPU hardware.
+
+Environment note: this image exposes the TPU through an `axon` PJRT plugin
+registered by a sitecustomize on PYTHONPATH; once registered, JAX init hangs
+under ``JAX_PLATFORMS=cpu``. The only reliable way to get a clean CPU JAX is
+a fresh interpreter without that plugin — so on first configure this conftest
+re-execs pytest with a scrubbed environment (after suspending pytest's
+fd-level capture so the child inherits the real stdout/stderr).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+def _scrubbed_env():
+    env = dict(os.environ)
+    env["HYDRAGNN_TPU_TEST_ENV"] = "1"
+    env["PYTHONPATH"] = ":".join(
+        p for p in env.get("PYTHONPATH", "").split(":") if p and ".axon_site" not in p
+    )
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # axon sitecustomize trigger
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def pytest_configure(config):
+    if os.environ.get("HYDRAGNN_TPU_TEST_ENV") == "1":
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "pytest"] + sys.argv[1:],
+        _scrubbed_env(),
+    )

@@ -1,0 +1,61 @@
+"""Dataset class tests (reference: tests/test_datasetclass_inheritance.py:35-208)."""
+
+import numpy as np
+
+from hydragnn_tpu.data import deterministic_graph_dataset
+from hydragnn_tpu.data.datasets import (
+    DATASET_NAME_IDS,
+    SimplePickleDataset,
+    SimplePickleWriter,
+)
+
+
+def pytest_pickle_dataset_roundtrip(tmp_path):
+    graphs = deterministic_graph_dataset(number_configurations=10, seed=5)
+    SimplePickleWriter(graphs, str(tmp_path), "unit", minmax={"x_min": [0.0]})
+    ds = SimplePickleDataset(str(tmp_path), "unit")
+    assert len(ds) == 10
+    g = ds.get(3)
+    np.testing.assert_allclose(g.x, graphs[3].x)
+    np.testing.assert_allclose(g.graph_y, graphs[3].graph_y)
+    assert ds.minmax == {"x_min": [0.0]}
+    # iteration covers all samples
+    assert sum(1 for _ in ds) == 10
+
+
+def pytest_pickle_dataset_multihost_offsets(tmp_path):
+    graphs = deterministic_graph_dataset(number_configurations=8, seed=6)
+    # two "hosts" write disjoint ranges of one logical dataset
+    SimplePickleWriter(
+        graphs[:5], str(tmp_path), "multi", host_count=2, host_index=0,
+        nglobal=8, offset=0,
+    )
+    SimplePickleWriter(
+        graphs[5:], str(tmp_path), "multi", host_count=2, host_index=1,
+        nglobal=8, offset=5,
+    )
+    ds = SimplePickleDataset(str(tmp_path), "multi")
+    assert len(ds) == 8
+    np.testing.assert_allclose(ds.get(6).x, graphs[6].x)
+
+
+def pytest_known_dataset_name_ids():
+    assert DATASET_NAME_IDS["mptrj"] == 2
+    assert len(DATASET_NAME_IDS) == 6
+
+
+def pytest_pickle_format_through_api(tmp_path, monkeypatch):
+    """Dataset.format='pickle' path end-to-end."""
+    monkeypatch.chdir(tmp_path)
+    graphs = deterministic_graph_dataset(number_configurations=30, seed=5)
+    SimplePickleWriter(graphs, str(tmp_path / "ds"), "unit")
+    from tests.test_training import make_config
+
+    config = make_config("GIN", num_epoch=2)
+    config["Dataset"]["format"] = "pickle"
+    config["Dataset"]["name"] = "unit"
+    config["Dataset"]["path"] = {"total": str(tmp_path / "ds")}
+    import hydragnn_tpu
+
+    model, state, hist, cfg, loaders, mm = hydragnn_tpu.run_training(config)
+    assert np.isfinite(hist["train"][-1])

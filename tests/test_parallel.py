@@ -1,0 +1,133 @@
+"""Data-parallel mesh tests on the virtual 8-device CPU mesh
+(the reference exercises its distributed paths on CPU Gloo under mpirun,
+.github/workflows/CI.yml:63; here: real shard_map over 8 XLA CPU devices)."""
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.parallel import make_mesh, replicate_state, shard_optimizer_state
+from hydragnn_tpu.parallel.dp import make_parallel_eval_step, make_parallel_train_step
+from hydragnn_tpu.train import TrainState, make_optimizer
+
+
+def _setup(num_shards, mpnn_type="GIN", batch_size=16):
+    raw = deterministic_graph_dataset(80, seed=7)
+    mm = MinMax.fit(raw)
+    raw = mm.apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": mpnn_type,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 4,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [10, 10],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "num_epoch": 2,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
+            },
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]}, "graph_features": {"dim": [1]}},
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(tr, batch_size, seed=0, num_shards=num_shards, drop_last=True)
+    val_loader = GraphLoader(
+        va, batch_size, spec=loader.spec, shuffle=False, num_shards=num_shards
+    )
+    return config, loader, val_loader
+
+
+def pytest_mesh_construction():
+    assert len(jax.devices()) == 8, "conftest must expose 8 virtual CPU devices"
+    mesh = make_mesh(branch_size=2)
+    assert mesh.shape == {"branch": 2, "data": 4}
+    mesh = make_mesh()
+    assert mesh.shape == {"branch": 1, "data": 8}
+
+
+def pytest_dp_training_converges():
+    mesh = make_mesh()
+    config, loader, val_loader = _setup(num_shards=8)
+    model = create_model(config)
+    sample = next(iter(loader))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
+    from hydragnn_tpu.data.graph import GraphBatch
+
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = replicate_state(TrainState.create(variables, tx), mesh)
+    step = make_parallel_train_step(model, tx, mesh)
+    evalf = make_parallel_eval_step(model, mesh)
+
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for epoch in range(6):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            rng, sub = jax.random.split(rng)
+            state, tot, tasks = step(state, batch, sub)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0], f"DP training did not converge: {losses}"
+    va, _ = evalf(state, next(iter(val_loader)))
+    assert np.isfinite(float(va))
+    # params remain replicated & synchronized across all 8 devices
+    leaf = jax.tree_util.tree_leaves(state.params)[0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def pytest_zero_optimizer_state_sharding():
+    mesh = make_mesh()
+    config, loader, _ = _setup(num_shards=8)
+    model = create_model(config)
+    sample = next(iter(loader))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+    sharded = shard_optimizer_state(state.opt_state, mesh, min_size=8)
+    # at least one large moment tensor sharded over the data axis
+    shardings = [
+        leaf.sharding
+        for leaf in jax.tree_util.tree_leaves(sharded)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any(len(s.device_set) == 8 for s in shardings)
+
+
+def pytest_loader_sharded_batches_cover_all_graphs():
+    config, loader, _ = _setup(num_shards=4, batch_size=8)
+    seen = 0
+    for batch in loader:
+        gm = np.asarray(batch.graph_mask)
+        assert gm.shape[0] == 4  # leading device axis
+        seen += int(gm.sum())
+    assert seen == (len(loader.graphs) // 8) * 8

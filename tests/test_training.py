@@ -1,0 +1,202 @@
+"""End-to-end training accuracy tests on the deterministic synthetic dataset.
+
+Analog of the reference's core test matrix (tests/test_graphs.py:142-167):
+train the full pipeline on BCC synthetic data and assert per-model RMSE /
+sample-MAE thresholds on the (normalized) test split.
+"""
+
+import numpy as np
+import pytest
+
+import hydragnn_tpu
+from hydragnn_tpu.api import run_prediction, run_training
+
+
+def make_config(mpnn_type, heads="single", num_epoch=40, num_configs=150, **arch_over):
+    arch = {
+        "mpnn_type": mpnn_type,
+        "radius": 2.0,
+        "max_neighbours": 100,
+        "hidden_dim": 8,
+        "num_conv_layers": 2,
+        "task_weights": [1.0],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 2,
+                "dim_sharedlayers": 4,
+                "num_headlayers": 2,
+                "dim_headlayers": [10, 10],
+            }
+        },
+    }
+    var = {
+        "input_node_features": [0],
+        "output_names": ["sum_x_x2_x3"],
+        "output_index": [0],
+        "type": ["graph"],
+        "denormalize_output": False,
+    }
+    if heads == "multi":
+        arch["output_heads"]["node"] = {
+            "num_headlayers": 2,
+            "dim_headlayers": [10, 10],
+            "type": "mlp",
+        }
+        arch["task_weights"] = [1.0, 1.0, 1.0, 1.0]
+        var = {
+            "input_node_features": [0],
+            "output_names": ["sum_x_x2_x3", "x", "x2", "x3"],
+            "output_index": [0, 0, 1, 2],
+            "type": ["graph", "node", "node", "node"],
+            "denormalize_output": False,
+        }
+    arch.update(arch_over)
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": f"unit_test_{heads}head",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": num_configs},
+            "compositional_stratified_splitting": True,
+            "node_features": {
+                "name": ["x", "x2", "x3"],
+                "dim": [1, 1, 1],
+                "column_index": [0, 6, 7],
+            },
+            "graph_features": {
+                "name": ["sum_x_x2_x3"],
+                "dim": [1],
+                "column_index": [0],
+            },
+        },
+        "NeuralNetwork": {
+            "Architecture": arch,
+            "Variables_of_interest": var,
+            "Training": {
+                "num_epoch": num_epoch,
+                "perc_train": 0.7,
+                "loss_function_type": "mse",
+                "batch_size": 16,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
+            },
+        },
+        "Visualization": {"create_plots": False},
+    }
+
+
+# thresholds follow the reference CI table (reference: tests/test_graphs.py:143-157)
+THRESHOLDS = {
+    "GIN": (0.25, 0.20),
+    "SAGE": (0.20, 0.20),
+    "PNA": (0.20, 0.20),
+    "MFC": (0.20, 0.30),
+    "GAT": (0.60, 0.70),
+    "CGCNN": (0.50, 0.40),
+}
+
+
+def _check_thresholds(config, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    model, state, hist, cfg, loaders, mm = run_training(config)
+    assert hist["train"][-1] < hist["train"][0], "training loss did not decrease"
+    tot, tasks, preds, trues = run_prediction(cfg, model_state=state)
+    mpnn = config["NeuralNetwork"]["Architecture"]["mpnn_type"]
+    thr_rmse, thr_mae = THRESHOLDS[mpnn]
+    for name in preds:
+        err = preds[name] - trues[name]
+        rmse = float(np.sqrt(np.mean(err**2)))
+        mae = float(np.mean(np.abs(err)))
+        assert rmse < thr_rmse, f"{mpnn}/{name}: RMSE {rmse} > {thr_rmse}"
+        assert mae < thr_mae, f"{mpnn}/{name}: sample MAE {mae} > {thr_mae}"
+
+
+@pytest.mark.parametrize("mpnn_type", ["GIN", "SAGE", "PNA", "MFC", "GAT", "CGCNN"])
+def pytest_train_singlehead(mpnn_type, tmp_path, monkeypatch):
+    _check_thresholds(make_config(mpnn_type), tmp_path, monkeypatch)
+
+
+@pytest.mark.parametrize("mpnn_type", ["SAGE", "PNA"])
+def pytest_train_multihead(mpnn_type, tmp_path, monkeypatch):
+    _check_thresholds(make_config(mpnn_type, heads="multi"), tmp_path, monkeypatch)
+
+
+@pytest.mark.parametrize("mpnn_type", ["PNA", "GIN"])
+@pytest.mark.parametrize("attn_type", ["multihead", "performer"])
+def pytest_train_gps_attention(mpnn_type, attn_type, tmp_path, monkeypatch):
+    """GPS global attention wrapping local MPNNs (reference:
+    tests/test_graphs.py:235-249 runs GPS across edge models)."""
+    cfg = make_config(
+        mpnn_type,
+        num_epoch=25,
+        global_attn_engine="GPS",
+        global_attn_type=attn_type,
+        global_attn_heads=8,
+        pe_dim=1,
+        hidden_dim=8,
+    )
+    _check_thresholds(cfg, tmp_path, monkeypatch)
+
+
+def pytest_lappe_deterministic_and_shapes():
+    from hydragnn_tpu.data import deterministic_graph_dataset, add_graph_pe
+
+    g = deterministic_graph_dataset(number_configurations=1, seed=11)[0]
+    g1 = add_graph_pe(g, 3)
+    g2 = add_graph_pe(g, 3)
+    np.testing.assert_allclose(g1.pe, g2.pe)
+    assert g1.pe.shape == (g.num_nodes, 3)
+    assert g1.rel_pe.shape == (g.num_edges, 3)
+    assert np.all(g1.rel_pe >= 0)
+
+
+def pytest_checkpoint_roundtrip(tmp_path, monkeypatch):
+    """Save -> load -> identical predictions (reference:
+    tests/test_model_loadpred.py:19-65)."""
+    monkeypatch.chdir(tmp_path)
+    config = make_config("GIN", num_epoch=3, num_configs=40)
+    model, state, hist, cfg, loaders, mm = run_training(config)
+    # load through the public path (template rebuilt from config)
+    tot1, tasks1, preds1, trues1 = run_prediction(cfg, model_state=state)
+    tot2, tasks2, preds2, trues2 = run_prediction(cfg)  # restores from ./logs
+    for name in preds1:
+        np.testing.assert_allclose(preds1[name], preds2[name], rtol=1e-5, atol=1e-6)
+
+
+def pytest_train_gaussian_nll(tmp_path, monkeypatch):
+    """GaussianNLLLoss trains through the variance heads (reference:
+    var_output plumbing Base.py:92-96; loss test
+    tests/test_loss_and_activation_functions.py:107-133)."""
+    monkeypatch.chdir(tmp_path)
+    config = make_config("GIN", num_epoch=10, num_configs=60)
+    config["NeuralNetwork"]["Training"]["loss_function_type"] = "GaussianNLLLoss"
+    model, state, hist, cfg, loaders, mm = run_training(config)
+    assert np.isfinite(hist["train"][-1])
+    assert hist["train"][-1] < hist["train"][0]
+
+
+def pytest_train_gps_over_gat(tmp_path, monkeypatch):
+    """GPS wrapping a width-expanding conv (GAT concat) must keep channel
+    widths consistent with the GPS residual."""
+    monkeypatch.chdir(tmp_path)
+    cfg = make_config(
+        "GAT",
+        num_epoch=2,
+        num_configs=40,
+        global_attn_engine="GPS",
+        global_attn_type="multihead",
+        global_attn_heads=8,
+        pe_dim=1,
+    )
+    model, state, hist, *_ = run_training(cfg)
+    assert np.isfinite(hist["train"][-1])
+
+
+def pytest_plateau_scheduler_reduces_lr(tmp_path, monkeypatch):
+    from hydragnn_tpu.train import ReduceLROnPlateau
+
+    sch = ReduceLROnPlateau(patience=2, factor=0.5)
+    lr = 0.1
+    lr = sch.step(1.0, lr)
+    for _ in range(3):
+        lr = sch.step(2.0, lr)  # no improvement
+    assert lr == pytest.approx(0.05)
