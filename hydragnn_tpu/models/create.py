@@ -25,11 +25,16 @@ from .base import (
 
 # import model files for their registry side effects
 from . import cgcnn as _cgcnn  # noqa: F401
+from . import egnn as _egnn  # noqa: F401
 from . import gat as _gat  # noqa: F401
 from . import gin as _gin  # noqa: F401
 from . import mfc as _mfc  # noqa: F401
+from . import painn as _painn  # noqa: F401
 from . import pna as _pna  # noqa: F401
+from . import pna_eq as _pna_eq  # noqa: F401
+from . import pna_plus as _pna_plus  # noqa: F401
 from . import sage as _sage  # noqa: F401
+from . import schnet as _schnet  # noqa: F401
 
 
 def normalize_output_heads(heads: Dict[str, Any]) -> Dict[str, List[Dict[str, Any]]]:

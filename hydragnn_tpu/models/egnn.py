@@ -1,0 +1,89 @@
+"""E(n)-equivariant graph conv (EGNN).
+
+TPU re-design of the reference's EGCLStack (hydragnn/models/EGCLStack.py:175-298):
+message MLP over [h_i, h_j, |x_i-x_j| (, e_ij)], sum aggregation, node MLP over
+[h, agg]; the equivariant variant also displaces coordinates along normalized
+edge vectors gated by a small MLP (tanh-bounded, mean-aggregated).
+
+The coordinate path reads/writes the ``equiv`` slot so stacked layers see the
+updated positions (reference recomputes distances from the running ``coord``
+each layer). PBC shifts are honored only in the invariant path, matching the
+reference's zero-shift override for positional updates (EGCLStack.py:278-281).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.radial import edge_vectors
+from ..ops.segment import segment_mean, segment_sum
+from .base import register_conv
+from .layers import MLP
+
+
+def coordinate_displacement(unit, gate_feat, batch, hidden_dim, tanh=False):
+    """Mean-aggregated coordinate displacement along (normalized) edge vectors,
+    gated by a small MLP whose final layer starts near zero (gain 0.001).
+    Shared by EGNN and equivariant SchNet (reference: E_GCL.coord_model,
+    EGCLStack.py:263-271; CFConv.coord_model, SCFStack.py:243-254).
+    Must be called from inside a ``@nn.compact`` ``__call__``."""
+    coef = MLP((hidden_dim,), "relu", final_activation=True)(gate_feat)
+    coef = nn.Dense(
+        1, use_bias=False,
+        kernel_init=nn.initializers.variance_scaling(0.001, "fan_avg", "uniform"),
+    )(coef)
+    if tanh:
+        # bounded displacement with a learnable range (E_GCL tanh mode)
+        coef = jnp.tanh(coef)
+    trans = jnp.clip(unit * coef, -100.0, 100.0)
+    return segment_mean(trans, batch.receivers, batch.num_nodes, batch.edge_mask)
+
+
+class EGCL(nn.Module):
+    output_dim: int
+    hidden_dim: int
+    edge_dim: int = 0
+    equivariant: bool = False
+    tanh: bool = True
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        pos = equiv
+        # The reference zeroes PBC shifts inside every E_GCL layer — positional
+        # update models have no PBC support (EGCLStack.py:278-281) — so edge
+        # vectors come from bare positions for all layers.
+        vec, length = edge_vectors(pos, batch.senders, batch.receivers)
+        # normalize=True with eps=1.0 (reference E_GCL norm_diff, operations.py)
+        unit = vec / (length + 1.0)
+
+        parts = [inv[batch.receivers], inv[batch.senders], length]
+        if self.edge_dim and batch.edge_attr is not None:
+            parts.append(batch.edge_attr)
+        edge_feat = MLP((self.hidden_dim, self.hidden_dim), "relu",
+                        final_activation=True)(jnp.concatenate(parts, axis=-1))
+
+        if self.equivariant:
+            delta = coordinate_displacement(unit, edge_feat, batch,
+                                            self.hidden_dim, tanh=self.tanh)
+            if self.tanh:
+                rng_scale = self.param("coords_range", nn.initializers.ones, (1,))
+                delta = delta * rng_scale * 3.0
+            pos = pos + delta
+
+        agg = segment_sum(edge_feat, batch.receivers, batch.num_nodes,
+                          batch.edge_mask)
+        out = MLP((self.hidden_dim, self.output_dim), "relu")(
+            jnp.concatenate([inv, agg], axis=-1)
+        )
+        return out, pos
+
+
+@register_conv("EGNN", is_edge_model=True)
+def make_egnn(cfg, in_dim, out_dim, last_layer):
+    return EGCL(
+        output_dim=out_dim,
+        hidden_dim=cfg.hidden_dim,
+        edge_dim=cfg.edge_dim,
+        equivariant=cfg.equivariance and not last_layer,
+    )

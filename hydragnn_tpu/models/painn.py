@@ -1,0 +1,107 @@
+"""PaiNN: polarizable atom interaction network.
+
+TPU re-design of the reference's PAINNStack (hydragnn/models/PAINNStack.py:
+194-343). Each conv layer = message block (sinc radial filter x cosine cutoff
+gating scalar MLP; vector messages mix neighbor vectors and unit edge vectors)
+followed by an update block (U/V channel mixings, gated scalar/vector
+residuals).
+
+State threading: scalar features ride the ``inv`` slot; per-node vector
+features [N, 3, F] ride the ``equiv`` slot. The first layer receives positions
+[N, 3] there and bootstraps v = 0 (the reference does the same in its
+``_embedding``, PAINNStack.py:190).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.radial import cosine_cutoff, edge_vectors, sinc_expansion
+from ..ops.segment import segment_sum
+from .base import register_conv
+from .layers import MLP
+
+
+def _vector_state(equiv, n, features):
+    """equiv slot -> [N, 3, F] vector features (bootstrapping from pos)."""
+    if equiv is None or equiv.ndim == 2:
+        return jnp.zeros((n, 3, features))
+    if equiv.shape[-1] != features:
+        # equivariant channel mixing to the layer's width
+        return nn.Dense(features, use_bias=False, name="v_proj")(equiv)
+    return equiv
+
+
+def painn_update(x, v, node_size, last_layer):
+    """PaiNN update block: U/V channel mixings, gated scalar/vector residuals
+    (reference: PainnUpdate, PAINNStack.py:266-316). On the last layer only
+    the scalar stream is updated. Shared by PAINN and PNAEq. Must be called
+    from inside a ``@nn.compact`` ``__call__``."""
+    uv = nn.Dense(node_size, use_bias=False)(v)
+    vv = nn.Dense(node_size, use_bias=False)(v)
+    vv_norm = jnp.sqrt(jnp.sum(vv * vv, axis=1) + 1e-12)
+    widths = 2 if last_layer else 3
+    out = MLP((node_size, widths * node_size), "silu")(
+        jnp.concatenate([vv_norm, x], axis=-1)
+    )
+    inner = jnp.sum(uv * vv, axis=1)
+    if last_layer:
+        a_sv, a_ss = jnp.split(out, 2, axis=-1)
+        return x + a_sv * inner + a_ss, v
+    a_vv, a_sv, a_ss = jnp.split(out, 3, axis=-1)
+    return x + a_sv * inner + a_ss, v + a_vv[:, None, :] * uv
+
+
+class PainnConv(nn.Module):
+    node_size: int
+    num_radial: int
+    radius: float
+    edge_dim: int = 0
+    last_layer: bool = False
+
+    @nn.compact
+    def __call__(self, inv, equiv, batch, train: bool = False):
+        n = batch.num_nodes
+        x = inv
+        if x.shape[-1] != self.node_size:
+            x = nn.Dense(self.node_size, name="x_proj")(x)
+        v = _vector_state(equiv, n, self.node_size)
+
+        vec, length = edge_vectors(batch.pos, batch.senders, batch.receivers,
+                                   batch.edge_shifts)
+        r = length[:, 0]
+        unit = vec / length
+
+        # ---- message block (PainnMessage, PAINNStack.py:194-264)
+        filt = nn.Dense(3 * self.node_size)(
+            sinc_expansion(r, self.radius, self.num_radial)
+        )
+        filt = filt * cosine_cutoff(r, self.radius)[:, None]
+        if self.edge_dim and batch.edge_attr is not None:
+            filt = filt * MLP((self.node_size, 3 * self.node_size), "silu")(
+                batch.edge_attr
+            )
+        scal = MLP((self.node_size, 3 * self.node_size), "silu")(x)
+        filter_out = filt * scal[batch.senders]
+        gate_v, gate_edge, msg_s = jnp.split(filter_out, 3, axis=-1)
+
+        msg_v = v[batch.senders] * gate_v[:, None, :]
+        msg_v = msg_v + gate_edge[:, None, :] * unit[:, :, None]
+
+        x = x + segment_sum(msg_s, batch.receivers, n, batch.edge_mask)
+        v = v + segment_sum(msg_v, batch.receivers, n, batch.edge_mask)
+
+        x, v = painn_update(x, v, self.node_size, self.last_layer)
+        return x, v
+
+
+@register_conv("PAINN", is_edge_model=True)
+def make_painn(cfg, in_dim, out_dim, last_layer):
+    return PainnConv(
+        node_size=out_dim,
+        num_radial=cfg.num_radial or 20,
+        radius=cfg.radius or 5.0,
+        edge_dim=cfg.edge_dim,
+        last_layer=last_layer,
+    )

@@ -38,6 +38,29 @@ def _avg_deg_stats(deg_hist: Tuple[int, ...]) -> Tuple[float, float]:
     return max(avg_log, 1e-6), max(avg_lin, 1e-6)
 
 
+def pna_aggregate(msg, batch, deg_hist):
+    """PNA aggregate-and-scale: [mean,min,max,std] aggregation x
+    [identity, amplification, attenuation, linear] degree scalers.
+    Shared by PNA / PNAPlus / PNAEq (reference: DegreeScalerAggregation)."""
+    n = batch.num_nodes
+    aggs = [
+        segment_mean(msg, batch.receivers, n, batch.edge_mask),
+        segment_min(msg, batch.receivers, n, batch.edge_mask),
+        segment_max(msg, batch.receivers, n, batch.edge_mask),
+        segment_std(msg, batch.receivers, n, batch.edge_mask),
+    ]
+    agg = jnp.concatenate(aggs, axis=-1)
+    avg_log, avg_lin = _avg_deg_stats(deg_hist)
+    deg = segment_count(batch.receivers, n, batch.edge_mask)[:, None]
+    log_deg = jnp.log(deg + 1.0)
+    return jnp.concatenate(
+        [agg, agg * (log_deg / avg_log),
+         agg * (avg_log / jnp.maximum(log_deg, 1e-6)),
+         agg * (deg / avg_lin)],
+        axis=-1,
+    )
+
+
 class PNAConv(nn.Module):
     output_dim: int
     deg_hist: Tuple[int, ...]
@@ -54,24 +77,7 @@ class PNAConv(nn.Module):
         f_in = inv.shape[-1]
         msg = nn.Dense(f_in)(jnp.concatenate(parts, axis=-1))
 
-        n = batch.num_nodes
-        aggs = [
-            segment_mean(msg, batch.receivers, n, batch.edge_mask),
-            segment_min(msg, batch.receivers, n, batch.edge_mask),
-            segment_max(msg, batch.receivers, n, batch.edge_mask),
-            segment_std(msg, batch.receivers, n, batch.edge_mask),
-        ]
-        agg = jnp.concatenate(aggs, axis=-1)
-
-        avg_log, avg_lin = _avg_deg_stats(self.deg_hist)
-        deg = segment_count(batch.receivers, n, batch.edge_mask)[:, None]
-        log_deg = jnp.log(deg + 1.0)
-        amplification = log_deg / avg_log
-        attenuation = avg_log / jnp.maximum(log_deg, 1e-6)
-        linear = deg / avg_lin
-        scaled = jnp.concatenate(
-            [agg, agg * amplification, agg * attenuation, agg * linear], axis=-1
-        )
+        scaled = pna_aggregate(msg, batch, self.deg_hist)
         # post-MLP, post_layers=1, then final linear projection
         out = nn.Dense(self.output_dim)(jnp.concatenate([inv, scaled], axis=-1))
         out = nn.Dense(self.output_dim)(out)

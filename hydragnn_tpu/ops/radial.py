@@ -1,0 +1,222 @@
+"""Radial bases, cutoff envelopes, and distance transforms.
+
+TPU-native equivalents of the geometric primitives the reference spreads over
+its model stacks (reference: hydragnn/models/SCFStack.py Gaussian smearing,
+hydragnn/models/PNAPlusStack.py Bessel basis + envelope,
+hydragnn/models/PAINNStack.py:322-343 sinc expansion + cosine cutoff,
+hydragnn/utils/model/mace_utils/modules/radial.py Bessel/Chebyshev/Gaussian
+bases, polynomial cutoff, Agnesi/Soft transforms).
+
+Everything here is a pure jnp function or tiny flax module over fixed-shape
+arrays: XLA fuses all of it into the surrounding conv, which is exactly what
+the MXU/HBM balance wants (these are elementwise ops feeding matmuls).
+
+Distances are computed PBC-aware: ``edge_vectors`` honors per-edge cartesian
+shift vectors (reference: get_edge_vectors_and_lengths usage in EGCLStack).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# Covalent radii in Angstrom indexed by atomic number 0..96 (element 0 is a
+# placeholder). Public physical constants (Cordero et al. 2008), the same table
+# ase.data.covalent_radii exposes in the reference's Agnesi/Soft transforms.
+COVALENT_RADII = np.array(
+    [
+        0.2, 0.31, 0.28, 1.28, 0.96, 0.84, 0.76, 0.71, 0.66, 0.57, 0.58,
+        1.66, 1.41, 1.21, 1.11, 1.07, 1.05, 1.02, 1.06, 2.03, 1.76,
+        1.70, 1.60, 1.53, 1.39, 1.39, 1.32, 1.26, 1.24, 1.32, 1.22,
+        1.22, 1.20, 1.19, 1.20, 1.20, 1.16, 2.20, 1.95, 1.90, 1.75,
+        1.64, 1.54, 1.47, 1.46, 1.42, 1.39, 1.45, 1.44, 1.42, 1.39,
+        1.39, 1.38, 1.39, 1.40, 2.44, 2.15, 2.07, 2.04, 2.03, 2.01,
+        1.99, 1.98, 1.98, 1.96, 1.94, 1.92, 1.92, 1.89, 1.90, 1.87,
+        1.87, 1.75, 1.70, 1.62, 1.51, 1.44, 1.41, 1.36, 1.36, 1.32,
+        1.45, 1.46, 1.48, 1.40, 1.50, 1.50, 2.60, 2.21, 2.15, 2.06,
+        2.00, 1.96, 1.90, 1.87, 1.80, 1.69, 1.68,
+    ],
+    dtype=np.float32,
+)
+
+
+def edge_vectors(
+    pos: jnp.ndarray,
+    senders: jnp.ndarray,
+    receivers: jnp.ndarray,
+    edge_shifts: Optional[jnp.ndarray] = None,
+    eps: float = 1e-12,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-edge displacement r_j - r_i (+ PBC shift) and its length.
+
+    Lengths are clamped away from 0 so padding self-edges (sender==receiver)
+    stay differentiable; mask downstream with ``edge_mask``.
+    """
+    vec = pos[senders] - pos[receivers]
+    if edge_shifts is not None:
+        vec = vec + edge_shifts
+    d2 = jnp.sum(vec * vec, axis=-1, keepdims=True)
+    length = jnp.sqrt(jnp.maximum(d2, eps))
+    return vec, length
+
+
+# ---------------------------------------------------------------------------
+# bases
+# ---------------------------------------------------------------------------
+
+
+def bessel_basis(r: jnp.ndarray, r_max: float, num_basis: int) -> jnp.ndarray:
+    """Spherical-Bessel radial basis sqrt(2/c) sin(n pi r / c)/r
+    (reference: mace radial.py BesselBasis eq. (7); PNAPlusStack rbf)."""
+    n = jnp.arange(1, num_basis + 1, dtype=r.dtype) * (math.pi / r_max)
+    r = r.reshape(-1, 1)
+    return math.sqrt(2.0 / r_max) * jnp.sin(n * r) / jnp.maximum(r, 1e-9)
+
+
+def gaussian_basis(r: jnp.ndarray, r_max: float, num_basis: int, start: float = 0.0):
+    """Gaussian-smeared distances (reference: SCFStack GaussianSmearing;
+    mace radial.py GaussianBasis)."""
+    centers = jnp.linspace(start, r_max, num_basis, dtype=r.dtype)
+    width = (r_max - start) / max(num_basis - 1, 1)
+    coeff = -0.5 / (width * width)
+    diff = r.reshape(-1, 1) - centers
+    return jnp.exp(coeff * diff * diff)
+
+
+def chebyshev_basis(r: jnp.ndarray, num_basis: int) -> jnp.ndarray:
+    """Chebyshev polynomials T_1..T_num_basis of the (pre-scaled) input
+    (reference: mace radial.py ChebychevBasis). Input expected in [-1, 1]."""
+    x = r.reshape(-1, 1)
+    t_prev = jnp.ones_like(x)  # T_0
+    t_cur = x  # T_1
+    cols = [t_cur]
+    for _ in range(num_basis - 1):
+        t_next = 2.0 * x * t_cur - t_prev
+        t_prev, t_cur = t_cur, t_next
+        cols.append(t_cur)
+    return jnp.concatenate(cols, axis=-1)
+
+
+def sinc_expansion(r: jnp.ndarray, r_max: float, num_basis: int) -> jnp.ndarray:
+    """sin(n pi r / r_max) / r expansion used by PaiNN
+    (reference: PAINNStack.py:322-332)."""
+    n = jnp.arange(1, num_basis + 1, dtype=r.dtype) * (math.pi / r_max)
+    r = r.reshape(-1, 1)
+    return jnp.sin(n * r) / jnp.maximum(r, 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# cutoffs
+# ---------------------------------------------------------------------------
+
+
+def cosine_cutoff(r: jnp.ndarray, r_max: float) -> jnp.ndarray:
+    """0.5 (cos(pi r / r_max) + 1) for r < r_max else 0
+    (reference: PAINNStack.py:335-343; SCFStack CFConv cutoff)."""
+    return jnp.where(r < r_max, 0.5 * (jnp.cos(math.pi * r / r_max) + 1.0), 0.0)
+
+
+def polynomial_cutoff(r: jnp.ndarray, r_max: float, p: int = 6) -> jnp.ndarray:
+    """MACE/DimeNet smooth polynomial envelope, eq. (8) of MACE
+    (reference: mace radial.py PolynomialCutoff)."""
+    x = r / r_max
+    env = (
+        1.0
+        - ((p + 1.0) * (p + 2.0) / 2.0) * x**p
+        + p * (p + 2.0) * x ** (p + 1)
+        - (p * (p + 1.0) / 2.0) * x ** (p + 2)
+    )
+    return env * (r < r_max)
+
+
+def dimenet_envelope(r_scaled: jnp.ndarray, exponent: int = 5) -> jnp.ndarray:
+    """DimeNet envelope u(d) = 1/d + a d^(p-1) + b d^p + c d^(p+1), smooth to
+    zero at d=1 (reference: PNAPlusStack.py Envelope; DIMEStack via PyG).
+    Input is d = r/cutoff; combined with 1/d-weighted bases."""
+    p = exponent + 1
+    a = -(p + 1) * (p + 2) / 2.0
+    b = p * (p + 2.0)
+    c = -p * (p + 1) / 2.0
+    x = r_scaled
+    val = 1.0 / jnp.maximum(x, 1e-9) + a * x ** (p - 1) + b * x**p + c * x ** (p + 1)
+    return val * (x < 1.0)
+
+
+def bessel_basis_enveloped(r: jnp.ndarray, r_max: float, num_basis: int,
+                           envelope_exponent: int = 5) -> jnp.ndarray:
+    """DimeNet-style enveloped Bessel rbf: env(d) * sin(n pi d)  with
+    d = r/r_max (reference: PNAPlusStack BesselBasisLayer)."""
+    d = (r / r_max).reshape(-1, 1)
+    n = jnp.arange(1, num_basis + 1, dtype=r.dtype) * math.pi
+    return dimenet_envelope(d, envelope_exponent) * jnp.sin(n * d)
+
+
+# ---------------------------------------------------------------------------
+# distance transforms (MACE)
+# ---------------------------------------------------------------------------
+
+
+def _pair_r0(z: jnp.ndarray, senders, receivers, scale: float) -> jnp.ndarray:
+    radii = jnp.asarray(COVALENT_RADII)
+    zi = jnp.clip(z, 0, radii.shape[0] - 1)
+    r = radii[zi]
+    return scale * (r[senders] + r[receivers]).reshape(-1, 1)
+
+
+def agnesi_transform(
+    r: jnp.ndarray, z: jnp.ndarray, senders, receivers,
+    q: float = 0.9183, p: float = 4.5791, a: float = 1.0805,
+) -> jnp.ndarray:
+    """Agnesi distance transform (ACEpotentials.jl; reference: mace
+    radial.py AgnesiTransform). r0 = (rc_i + rc_j)/2 from covalent radii."""
+    r0 = _pair_r0(z, senders, receivers, 0.5)
+    x = r.reshape(-1, 1) / r0
+    return 1.0 / (1.0 + a * x**q / (1.0 + x ** (q - p)))
+
+
+def soft_transform(
+    r: jnp.ndarray, z: jnp.ndarray, senders, receivers,
+    a: float = 0.2, b: float = 3.0,
+) -> jnp.ndarray:
+    """Soft distance transform (reference: mace radial.py SoftTransform);
+    r0 = (rc_i + rc_j)/4."""
+    r0 = _pair_r0(z, senders, receivers, 0.25)
+    x = r.reshape(-1, 1) / r0
+    return r.reshape(-1, 1) + 0.5 * jnp.tanh(-x - a * x**b) + 0.5
+
+
+class RadialEmbedding(nn.Module):
+    """Distance -> radial feature row, combining basis x cutoff (+transform).
+
+    The MACE radial embedding block (reference: mace radial.py:23-100 analog):
+    ``radial_type`` in {bessel, gaussian, chebyshev}, polynomial cutoff, and
+    optional Agnesi/Soft distance transform applied before the basis.
+    """
+
+    r_max: float
+    num_basis: int = 8
+    radial_type: str = "bessel"
+    envelope_exponent: int = 6  # polynomial cutoff p
+    distance_transform: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, lengths, z=None, senders=None, receivers=None):
+        r = lengths.reshape(-1)
+        cutoff = polynomial_cutoff(r, self.r_max, self.envelope_exponent)[:, None]
+        if self.distance_transform in ("Agnesi", "agnesi"):
+            r = agnesi_transform(r, z, senders, receivers).reshape(-1)
+        elif self.distance_transform in ("Soft", "soft"):
+            r = soft_transform(r, z, senders, receivers).reshape(-1)
+        if self.radial_type == "bessel":
+            feats = bessel_basis(r, self.r_max, self.num_basis)
+        elif self.radial_type == "gaussian":
+            feats = gaussian_basis(r, self.r_max, self.num_basis)
+        elif self.radial_type == "chebyshev":
+            feats = chebyshev_basis(2.0 * r / self.r_max - 1.0, self.num_basis)
+        else:
+            raise ValueError(f"unknown radial_type {self.radial_type!r}")
+        return feats * cutoff

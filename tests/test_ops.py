@@ -1,0 +1,104 @@
+"""Unit tests for the radial basis / cutoff / distance-transform ops."""
+
+import numpy as np
+import pytest
+
+
+def pytest_bessel_basis_values():
+    """Bessel basis matches the closed form sqrt(2/c) sin(n pi r/c)/r
+    (reference: mace radial.py BesselBasis eq. 7)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import bessel_basis
+
+    r = jnp.array([0.5, 1.0, 2.0])
+    out = np.asarray(bessel_basis(r, r_max=3.0, num_basis=4))
+    assert out.shape == (3, 4)
+    for i, ri in enumerate([0.5, 1.0, 2.0]):
+        for n in range(1, 5):
+            expect = np.sqrt(2.0 / 3.0) * np.sin(n * np.pi * ri / 3.0) / ri
+            np.testing.assert_allclose(out[i, n - 1], expect, rtol=1e-5, atol=1e-6)
+
+
+def pytest_polynomial_cutoff_boundary():
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import polynomial_cutoff
+
+    r = jnp.array([0.0, 2.5, 4.999, 5.0, 6.0])
+    out = np.asarray(polynomial_cutoff(r, 5.0, p=6))
+    np.testing.assert_allclose(out[0], 1.0, atol=1e-6)
+    assert 0.0 < out[1] < 1.0
+    np.testing.assert_allclose(out[2], 0.0, atol=1e-6)
+    assert out[3] == 0.0 and out[4] == 0.0
+
+
+def pytest_cosine_cutoff_boundary():
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import cosine_cutoff
+
+    out = np.asarray(cosine_cutoff(jnp.array([0.0, 2.5, 5.0, 7.0]), 5.0))
+    np.testing.assert_allclose(out, [1.0, 0.5, 0.0, 0.0], atol=1e-6)
+
+
+def pytest_chebyshev_basis_recurrence():
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import chebyshev_basis
+
+    x = jnp.array([-0.7, 0.0, 0.3, 1.0])
+    out = np.asarray(chebyshev_basis(x, 4))
+    xs = np.asarray(x)
+    # T_1..T_4 closed forms
+    np.testing.assert_allclose(out[:, 0], xs, atol=1e-6)
+    np.testing.assert_allclose(out[:, 1], 2 * xs**2 - 1, atol=1e-6)
+    np.testing.assert_allclose(out[:, 2], 4 * xs**3 - 3 * xs, atol=1e-6)
+    np.testing.assert_allclose(out[:, 3], 8 * xs**4 - 8 * xs**2 + 1, atol=1e-5)
+
+
+def pytest_dimenet_envelope_smooth_zero():
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import bessel_basis_enveloped
+
+    r = jnp.array([0.1, 2.0, 4.99, 5.0, 6.0])
+    out = np.asarray(bessel_basis_enveloped(r, 5.0, 5))
+    assert out.shape == (5, 5)
+    assert np.all(np.isfinite(out))
+    np.testing.assert_allclose(out[3], 0.0, atol=1e-4)
+    np.testing.assert_allclose(out[4], 0.0, atol=1e-6)
+
+
+def pytest_distance_transforms_finite_and_bounded():
+    """Agnesi maps to (0,1]; Soft stays monotone-ish near r
+    (reference: mace radial.py Agnesi/Soft transforms)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import agnesi_transform, soft_transform
+
+    r = jnp.array([0.3, 1.0, 2.5, 4.0])
+    z = jnp.array([1, 6, 8, 26], dtype=jnp.int32)
+    senders = jnp.array([0, 1, 2, 3])
+    receivers = jnp.array([1, 2, 3, 0])
+    a = np.asarray(agnesi_transform(r, z, senders, receivers))
+    assert a.shape == (4, 1)
+    assert np.all(a > 0) and np.all(a <= 1.0)
+    s = np.asarray(soft_transform(r, z, senders, receivers))
+    assert np.all(np.isfinite(s))
+    # large r: soft transform approaches r + 1/2 (tanh -> -1 ... +1/2 shift -> r)
+    np.testing.assert_allclose(s[3, 0], 4.0, atol=0.05)
+
+
+def pytest_radial_embedding_module():
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.radial import RadialEmbedding
+
+    mod = RadialEmbedding(r_max=5.0, num_basis=8, radial_type="bessel")
+    lengths = jnp.array([[0.8], [2.0], [4.5]])
+    var = mod.init(jax.random.PRNGKey(0), lengths)
+    out = mod.apply(var, lengths)
+    assert out.shape == (3, 8)
+    assert np.all(np.isfinite(np.asarray(out)))

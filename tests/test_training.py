@@ -89,9 +89,16 @@ THRESHOLDS = {
     "GIN": (0.25, 0.20),
     "SAGE": (0.20, 0.20),
     "PNA": (0.20, 0.20),
+    "PNAPlus": (0.20, 0.20),
     "MFC": (0.20, 0.30),
     "GAT": (0.60, 0.70),
     "CGCNN": (0.50, 0.40),
+    "SchNet": (0.20, 0.20),
+    "DimeNet": (0.50, 0.50),
+    "EGNN": (0.20, 0.20),
+    "PNAEq": (0.60, 0.60),
+    "PAINN": (0.60, 0.60),
+    "MACE": (0.60, 0.70),
 }
 
 
@@ -110,9 +117,20 @@ def _check_thresholds(config, tmp_path, monkeypatch):
         assert mae < thr_mae, f"{mpnn}/{name}: sample MAE {mae} > {thr_mae}"
 
 
-@pytest.mark.parametrize("mpnn_type", ["GIN", "SAGE", "PNA", "MFC", "GAT", "CGCNN"])
+@pytest.mark.parametrize(
+    "mpnn_type",
+    ["GIN", "SAGE", "PNA", "MFC", "GAT", "CGCNN",
+     "SchNet", "PNAPlus", "EGNN", "PAINN", "PNAEq"],
+)
 def pytest_train_singlehead(mpnn_type, tmp_path, monkeypatch):
     _check_thresholds(make_config(mpnn_type), tmp_path, monkeypatch)
+
+
+@pytest.mark.parametrize("mpnn_type", ["SchNet", "EGNN", "PAINN"])
+def pytest_train_equivariant(mpnn_type, tmp_path, monkeypatch):
+    """Equivariant-mode variants (reference: tests/test_graphs.py:262-266)."""
+    cfg = make_config(mpnn_type, num_epoch=40, equivariance=True)
+    _check_thresholds(cfg, tmp_path, monkeypatch)
 
 
 @pytest.mark.parametrize("mpnn_type", ["SAGE", "PNA"])
