@@ -86,7 +86,11 @@ def prepare_data(
 
     config = update_config(config, trainset, valset, testset)
     batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
-    spec = PadSpec.for_dataset(trainset + valset + testset, batch_size)
+    spec = PadSpec.for_dataset(
+        trainset + valset + testset,
+        batch_size,
+        with_triplets=config["NeuralNetwork"]["Architecture"]["mpnn_type"] == "DimeNet",
+    )
     train_loader = GraphLoader(trainset, batch_size, spec=spec, shuffle=True, seed=0)
     val_loader = GraphLoader(valset, batch_size, spec=spec, shuffle=False)
     test_loader = GraphLoader(testset, batch_size, spec=spec, shuffle=False)

@@ -92,6 +92,13 @@ class GraphBatch:
     pe: Optional[jnp.ndarray] = None  # [N, pe_dim]
     rel_pe: Optional[jnp.ndarray] = None  # [E, pe_dim]
     z: Optional[jnp.ndarray] = None  # [N] int32
+    # optional statically padded triplets k->j->i for directional MP (DimeNet):
+    # trip_kj/trip_ji index into the edge arrays (reference computes these
+    # per-batch on device via SparseTensor, DIMEStack.py:233-258; here the
+    # loader precomputes them on host, cf. SURVEY §3 hot-spot (d))
+    trip_kj: Optional[jnp.ndarray] = None  # [T] int32 edge id of k->j
+    trip_ji: Optional[jnp.ndarray] = None  # [T] int32 edge id of j->i
+    trip_mask: Optional[jnp.ndarray] = None  # [T] bool
     # targets: head name -> [G, d] (graph heads) or [N, d] (node heads)
     graph_targets: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
     node_targets: Dict[str, jnp.ndarray] = struct.field(default_factory=dict)
@@ -126,6 +133,7 @@ class PadSpec:
     n_nodes: int
     n_edges: int
     n_graphs: int  # includes the +1 dummy graph slot
+    n_triplets: int = 0  # 0 = no triplet channel
 
     @staticmethod
     def for_dataset(
@@ -134,6 +142,7 @@ class PadSpec:
         node_multiple: int = 8,
         edge_multiple: int = 128,
         slack: float = 1.0,
+        with_triplets: bool = False,
     ) -> "PadSpec":
         """Choose one spec covering any ``batch_size`` graphs from ``graphs``.
 
@@ -149,11 +158,68 @@ class PadSpec:
         k = min(batch_size, len(n_sizes))
         n_bound = int(sum(n_sizes[:k]) * slack) + 1
         e_bound = int(sum(e_sizes[:k]) * slack) + 1
+        n_triplets = 0
+        if with_triplets:
+            # exact per-graph triplet count: for each edge j->i, one triplet
+            # per in-edge k->j with k != i
+            t_sizes = sorted((_triplet_count(g) for g in graphs), reverse=True)
+            n_triplets = _round_up(int(sum(t_sizes[:k]) * slack) + 1, edge_multiple)
         return PadSpec(
             n_nodes=_round_up(n_bound + 1, node_multiple),
             n_edges=_round_up(e_bound, edge_multiple),
             n_graphs=batch_size + 1,
+            n_triplets=n_triplets,
         )
+
+
+def _triplet_count(g: Graph) -> int:
+    deg = np.bincount(g.receivers, minlength=g.num_nodes)
+    total = int(deg[g.senders].sum())
+    # subtract k == i cases: pairs of mutual edges j->i and i->j
+    pairs = set(zip(g.senders.tolist(), g.receivers.tolist()))
+    mutual = sum(1 for (j, i) in pairs if (i, j) in pairs)
+    return total - mutual
+
+
+def compute_triplets_np(
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    edge_mask: np.ndarray,
+    n_triplets: int,
+) -> Dict[str, np.ndarray]:
+    """Vectorized k->j->i triplet enumeration over the real edges of a padded
+    batch (reference: PyG-style ``triplets``, DIMEStack.py:233-258).
+
+    Returns edge-index pairs (trip_kj, trip_ji) padded to ``n_triplets`` with
+    the last edge slot and a validity mask.
+    """
+    real = np.nonzero(edge_mask)[0]
+    n_nodes = int(senders.max(initial=0)) + 1 if senders.size else 1
+    # in-edges grouped by receiver
+    order = np.argsort(receivers[real], kind="stable")
+    sorted_edges = real[order]
+    deg = np.bincount(receivers[real], minlength=n_nodes)
+    start = np.concatenate([[0], np.cumsum(deg)])
+    # for each real edge e2 = j->i: a block of deg[j] candidate k->j edges
+    j_of = senders[real]
+    counts = deg[j_of]
+    ji = np.repeat(real, counts)
+    cum = np.concatenate([[0], np.cumsum(counts)])
+    pos = np.arange(int(counts.sum())) - np.repeat(cum[:-1], counts)
+    kj = sorted_edges[np.repeat(start[j_of], counts) + pos]
+    keep = senders[kj] != receivers[ji]  # drop i == k triplets
+    kj, ji = kj[keep], ji[keep]
+    t = kj.shape[0]
+    if t > n_triplets:
+        raise ValueError(f"batch has {t} triplets, exceeds pad spec {n_triplets}")
+    pad_edge = senders.shape[0] - 1
+    out_kj = np.full((n_triplets,), pad_edge, np.int32)
+    out_ji = np.full((n_triplets,), pad_edge, np.int32)
+    out_kj[:t] = kj
+    out_ji[:t] = ji
+    mask = np.zeros((n_triplets,), bool)
+    mask[:t] = True
+    return {"trip_kj": out_kj, "trip_ji": out_ji, "trip_mask": mask}
 
 
 def _round_up(x: int, m: int) -> int:
@@ -229,6 +295,13 @@ def batch_graphs_np(
         buf = np.zeros((spec.n_edges, stacked.shape[1]), np_dtype)
         buf[:e] = stacked
         out[field] = buf
+
+    if spec.n_triplets:
+        edge_mask_tmp = np.zeros((spec.n_edges,), bool)
+        edge_mask_tmp[:e] = True
+        out.update(
+            compute_triplets_np(senders, receivers, edge_mask_tmp, spec.n_triplets)
+        )
 
     # masks
     node_mask = np.zeros((spec.n_nodes,), bool)

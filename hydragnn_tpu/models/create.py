@@ -25,6 +25,7 @@ from .base import (
 
 # import model files for their registry side effects
 from . import cgcnn as _cgcnn  # noqa: F401
+from . import dimenet as _dimenet  # noqa: F401
 from . import egnn as _egnn  # noqa: F401
 from . import gat as _gat  # noqa: F401
 from . import gin as _gin  # noqa: F401

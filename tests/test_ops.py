@@ -102,3 +102,44 @@ def pytest_radial_embedding_module():
     out = mod.apply(var, lengths)
     assert out.shape == (3, 8)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+def pytest_triplet_enumeration_matches_bruteforce():
+    """Vectorized triplet builder == brute-force enumeration (reference
+    semantics: PyG triplets, DIMEStack.py:233-258)."""
+    import numpy as np
+
+    from hydragnn_tpu.data.graph import compute_triplets_np
+
+    rng = np.random.default_rng(3)
+    n, e_real, e_pad = 12, 40, 8
+    senders = rng.integers(0, n, e_real)
+    receivers = (senders + rng.integers(1, n, e_real)) % n  # no self loops
+    senders = np.concatenate([senders, np.full(e_pad, n - 1)]).astype(np.int32)
+    receivers = np.concatenate([receivers, np.full(e_pad, n - 1)]).astype(np.int32)
+    mask = np.concatenate([np.ones(e_real, bool), np.zeros(e_pad, bool)])
+
+    out = compute_triplets_np(senders, receivers, mask, 4096)
+    got = set(zip(out["trip_kj"][out["trip_mask"]].tolist(),
+                  out["trip_ji"][out["trip_mask"]].tolist()))
+    want = set()
+    for e2 in range(e_real):
+        for e1 in range(e_real):
+            if receivers[e1] == senders[e2] and senders[e1] != receivers[e2]:
+                want.add((e1, e2))
+    assert got == want
+
+
+def pytest_spherical_bessel_zero_values():
+    import numpy as np
+
+    from hydragnn_tpu.ops.sbf import _sph_jl_np, spherical_bessel_zeros
+
+    zs = spherical_bessel_zeros(5, 4)
+    np.testing.assert_allclose(zs[0], np.pi * np.arange(1, 5), rtol=1e-10)
+    # j_1 first zero is 4.493409...
+    np.testing.assert_allclose(zs[1][0], 4.493409457909064, rtol=1e-8)
+    for l, row in enumerate(zs):
+        assert len(row) == 4
+        for z in row:
+            assert abs(_sph_jl_np(l, np.array(z))) < 1e-8

@@ -120,7 +120,7 @@ def _check_thresholds(config, tmp_path, monkeypatch):
 @pytest.mark.parametrize(
     "mpnn_type",
     ["GIN", "SAGE", "PNA", "MFC", "GAT", "CGCNN",
-     "SchNet", "PNAPlus", "EGNN", "PAINN", "PNAEq"],
+     "SchNet", "PNAPlus", "EGNN", "PAINN", "PNAEq", "DimeNet"],
 )
 def pytest_train_singlehead(mpnn_type, tmp_path, monkeypatch):
     _check_thresholds(make_config(mpnn_type), tmp_path, monkeypatch)
