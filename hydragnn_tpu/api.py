@@ -21,7 +21,13 @@ from .config import (
     voi_from_config,
 )
 from .data.graph import Graph, PadSpec
-from .data.pipeline import GraphLoader, MinMax, extract_variables, split_dataset
+from .data.pipeline import (
+    GraphLoader,
+    MinMax,
+    extract_variables,
+    select_input_columns,
+    split_dataset,
+)
 from .data.synthetic import deterministic_graph_dataset
 from .models.create import create_model, init_model
 from .train.checkpoint import load_existing_model, save_model
@@ -46,6 +52,15 @@ def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
             or 100,
             seed=opts.get("seed", 97),
         )
+    if fmt == "lennard_jones":
+        from .data.synthetic import lennard_jones_dataset
+
+        opts = dict(ds.get("lennard_jones", {}))
+        arch = config["NeuralNetwork"]["Architecture"]
+        opts.setdefault("radius", arch.get("radius", 2.5) or 2.5)
+        if arch.get("max_neighbours"):
+            opts.setdefault("max_neighbours", arch["max_neighbours"])
+        return lennard_jones_dataset(**opts)
     if fmt == "pickle":
         from .data.datasets import SimplePickleDataset
 
@@ -60,11 +75,19 @@ def prepare_data(
     (completed config, loaders, minmax)."""
     if datasets is None:
         raw = _load_raw_dataset(config)
-        mm = MinMax.fit(raw)
-        if config.get("Dataset", {}).get("normalize", True):
-            raw = mm.apply(raw)
-        voi = voi_from_config(config)
-        ready = [extract_variables(g, voi) for g in raw]
+        if config["NeuralNetwork"]["Training"].get("compute_grad_energy", False):
+            # energy/forces ride on the graphs directly (no target extraction
+            # or minmax scaling — physical units matter); input node-feature
+            # column selection still applies
+            mm = None
+            voi = voi_from_config(config)
+            ready = [select_input_columns(g, voi) for g in raw]
+        else:
+            mm = MinMax.fit(raw)
+            if config.get("Dataset", {}).get("normalize", True):
+                raw = mm.apply(raw)
+            voi = voi_from_config(config)
+            ready = [extract_variables(g, voi) for g in raw]
         arch = config["NeuralNetwork"]["Architecture"]
         if arch.get("global_attn_engine"):
             # Laplacian PE + relative edge PE feed GPS (reference:
@@ -163,7 +186,14 @@ def _(config: dict, model_state=None, datasets=None):
         template = TrainState.create(variables, tx)
         log_name = get_log_name_config(config)
         model_state = load_existing_model(template, log_name)
-    tot, tasks, preds, trues = test_model(model, model_state, test_loader)
+    tot, tasks, preds, trues = test_model(
+        model,
+        model_state,
+        test_loader,
+        compute_grad_energy=config["NeuralNetwork"]["Training"].get(
+            "compute_grad_energy", False
+        ),
+    )
     var = config["NeuralNetwork"]["Variables_of_interest"]
     if var.get("denormalize_output") and mm is not None:
         voi = voi_from_config(config)

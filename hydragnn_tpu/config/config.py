@@ -136,19 +136,30 @@ def update_config(
     voi = voi_from_config(config)
     sample = trainset[0]
     output_dim: List[int] = []
-    for t, idx in zip(voi.output_types, voi.output_index):
-        if t == "graph":
-            output_dim.append(int(voi.graph_feature_dims[idx]))
-        elif t == "node":
-            dim = int(voi.node_feature_dims[idx])
-            node_head = arch["output_heads"].get("node", {})
-            if isinstance(node_head, list):  # multibranch list form
-                node_head = node_head[0].get("architecture", {}) if node_head else {}
-            if not graph_size_variable and node_head.get("type") == "mlp_per_node":
-                dim *= sample.num_nodes
-            output_dim.append(dim)
-        else:
-            raise ValueError(f"output type {t!r} not graph or node")
+    if training["compute_grad_energy"]:
+        # energy-force training: dims taken verbatim from the config
+        # (reference: config_utils.py:223-224)
+        if "output_dim" not in var:
+            raise KeyError(
+                "Training.compute_grad_energy requires "
+                "Variables_of_interest.output_dim (the nodal-energy head "
+                "dims, usually [1]) since they cannot be derived from data"
+            )
+        output_dim = [int(d) for d in var["output_dim"]]
+    else:
+        for t, idx in zip(voi.output_types, voi.output_index):
+            if t == "graph":
+                output_dim.append(int(voi.graph_feature_dims[idx]))
+            elif t == "node":
+                dim = int(voi.node_feature_dims[idx])
+                node_head = arch["output_heads"].get("node", {})
+                if isinstance(node_head, list):  # multibranch list form
+                    node_head = node_head[0].get("architecture", {}) if node_head else {}
+                if not graph_size_variable and node_head.get("type") == "mlp_per_node":
+                    dim *= sample.num_nodes
+                output_dim.append(dim)
+            else:
+                raise ValueError(f"output type {t!r} not graph or node")
     arch["output_dim"] = output_dim
     arch["output_type"] = list(voi.output_types)
     arch["num_nodes"] = sample.num_nodes

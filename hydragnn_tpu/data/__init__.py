@@ -5,10 +5,11 @@ from .pipeline import (
     MinMax,
     VariablesOfInterest,
     extract_variables,
+    select_input_columns,
     split_dataset,
 )
 from .lappe import add_dataset_pe, add_graph_pe, laplacian_pe
-from .synthetic import deterministic_graph_dataset
+from .synthetic import deterministic_graph_dataset, lennard_jones_dataset
 
 __all__ = [
     "Graph",
@@ -24,6 +25,8 @@ __all__ = [
     "MinMax",
     "VariablesOfInterest",
     "extract_variables",
+    "select_input_columns",
     "split_dataset",
     "deterministic_graph_dataset",
+    "lennard_jones_dataset",
 ]

@@ -61,6 +61,15 @@ class VariablesOfInterest:
         return dims
 
 
+def select_input_columns(graph: Graph, voi: VariablesOfInterest) -> Graph:
+    """Keep only the configured input node-feature columns of ``graph.x``."""
+    in_cols = np.concatenate(
+        [np.arange(voi.node_feature_slice(i).start, voi.node_feature_slice(i).stop)
+         for i in voi.input_node_features]
+    )
+    return dataclasses.replace(graph, x=np.asarray(graph.x)[:, in_cols])
+
+
 def extract_variables(graph: Graph, voi: VariablesOfInterest) -> Graph:
     """Produce a model-ready graph: input columns + per-head target dicts."""
     in_cols = np.concatenate(

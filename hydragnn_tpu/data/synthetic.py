@@ -114,3 +114,81 @@ def _configuration(rng, uc, types, number_neighbors, linear_only, radius, max_ne
         graph_y=np.asarray([float(total)], np.float32),
         z=node_type[:, 0].astype(np.int32),
     )
+
+
+def lennard_jones_dataset(
+    number_configurations: int = 200,
+    supercell: Sequence[int] = (2, 2, 2),
+    spacing: float = 1.2,
+    jitter: float = 0.08,
+    radius: float = 2.5,
+    max_neighbours: int = 32,
+    epsilon: float = 1.0,
+    sigma: float = 1.0,
+    seed: int = 17,
+    center_energies: bool = True,
+) -> List[Graph]:
+    """Perturbed-lattice configurations with exact Lennard-Jones energies and
+    analytic forces, for energy+force (``compute_grad_energy``) training.
+
+    Behavioral analog of the reference's ``examples/LennardJones`` dataset
+    (examples/LennardJones/LJ_data.py): graph target ``energy`` (total LJ
+    energy within the cutoff) and node target ``forces`` (−∇E, closed form).
+
+    ``center_energies`` subtracts the dataset-mean per-atom energy (the
+    standard atomic-reference-energy shift; forces are invariant to it).
+    """
+    rng = np.random.default_rng(seed)
+    graphs: List[Graph] = []
+    for _ in range(number_configurations):
+        base = np.array(
+            [
+                (x, y, z)
+                for x in range(supercell[0])
+                for y in range(supercell[1])
+                for z in range(supercell[2])
+            ],
+            np.float64,
+        )
+        pos = base * spacing + rng.uniform(-jitter, jitter, base.shape)
+        senders, receivers = radius_graph(pos, radius, max_neighbours)
+        # symmetrize after any per-receiver neighbour capping: every pair must
+        # appear in both directions or the 0.5-per-edge energy sum and the
+        # receiver-side force accumulation break Newton's third law
+        pairs = set(zip(senders.tolist(), receivers.tolist()))
+        pairs |= {(i, j) for (j, i) in pairs}
+        senders, receivers = map(
+            lambda a: np.asarray(a, np.int32), zip(*sorted(pairs))
+        )
+        diff = pos[receivers] - pos[senders]  # r_i - r_j for edge j->i
+        r = np.linalg.norm(diff, axis=1)
+        s6 = (sigma / r) ** 6
+        s12 = s6**2
+        # each pair appears twice (j->i and i->j): half the pair energy per edge
+        energy = float(np.sum(0.5 * 4.0 * epsilon * (s12 - s6)))
+        # F_i = sum_j 24 eps (2 s12 - s6) / r^2 * (r_i - r_j)
+        coef = 24.0 * epsilon * (2.0 * s12 - s6) / r**2
+        forces = np.zeros_like(pos)
+        np.add.at(forces, receivers, coef[:, None] * diff)
+        graphs.append(
+            Graph(
+                x=np.ones((pos.shape[0], 1), np.float32),
+                pos=pos.astype(np.float32),
+                senders=senders,
+                receivers=receivers,
+                graph_targets={"energy": np.asarray([energy], np.float32)},
+                node_targets={"forces": forces.astype(np.float32)},
+                z=np.ones((pos.shape[0],), np.int32),
+            )
+        )
+    if center_energies:
+        e_per_atom = float(
+            np.mean(
+                [g.graph_targets["energy"][0] / g.num_nodes for g in graphs]
+            )
+        )
+        for g in graphs:
+            g.graph_targets["energy"] = (
+                g.graph_targets["energy"] - e_per_atom * g.num_nodes
+            ).astype(np.float32)
+    return graphs

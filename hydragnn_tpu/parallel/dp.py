@@ -25,26 +25,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from ..models.base import HydraModel
-from ..train.loss import multitask_loss
+from ..train.loss import compute_loss
 from ..train.state import TrainState
 from .mesh import BRANCH_AXIS, DATA_AXIS
 
 _BOTH = (BRANCH_AXIS, DATA_AXIS)
 
 
-def make_parallel_train_step(model: HydraModel, tx, mesh: Mesh):
+def make_parallel_train_step(
+    model: HydraModel, tx, mesh: Mesh, compute_grad_energy: bool = False
+):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh."""
     cfg = model.cfg
 
     def per_device_loss(params, batch_stats, batch, rng):
-        outputs, mutated = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            batch,
-            train=True,
-            mutable=["batch_stats"],
-            rngs={"dropout": rng},
+        variables = {"params": params, "batch_stats": batch_stats}
+        tot, tasks, mutated, _ = compute_loss(
+            model, variables, batch, cfg, True, rng, compute_grad_energy
         )
-        tot, tasks = multitask_loss(outputs, batch, cfg)
         return tot, (tasks, mutated)
 
     if cfg.conv_checkpointing:
@@ -96,13 +94,16 @@ def make_parallel_train_step(model: HydraModel, tx, mesh: Mesh):
     return jax.jit(mapped, donate_argnums=0)
 
 
-def make_parallel_eval_step(model: HydraModel, mesh: Mesh):
+def make_parallel_eval_step(
+    model: HydraModel, mesh: Mesh, compute_grad_energy: bool = False
+):
     cfg = model.cfg
 
     def sharded_eval(state: TrainState, batch):
         batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        outputs = model.apply(state.variables(), batch, train=False)
-        tot, tasks = multitask_loss(outputs, batch, cfg)
+        tot, tasks, _, _ = compute_loss(
+            model, state.variables(), batch, cfg, False, None, compute_grad_energy
+        )
         # weight by real graphs so padded shards don't skew the mean
         n = jnp.sum(batch.graph_mask.astype(jnp.float32))
         n_tot = jax.lax.psum(n, _BOTH)

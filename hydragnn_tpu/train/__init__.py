@@ -9,7 +9,14 @@ from .loop import (
     train_epoch,
     train_validate_test,
 )
-from .loss import head_loss, masked_mean, multitask_loss
+from .loss import (
+    compute_loss,
+    energy_force_loss,
+    head_loss,
+    masked_mean,
+    multitask_loss,
+    predict_energy_forces,
+)
 from .optimizer import ReduceLROnPlateau, make_optimizer
 from .state import TrainState
 
@@ -18,8 +25,11 @@ __all__ = [
     "EarlyStopping",
     "ReduceLROnPlateau",
     "TrainState",
+    "compute_loss",
+    "energy_force_loss",
     "evaluate",
     "head_loss",
+    "predict_energy_forces",
     "load_existing_model",
     "make_eval_step",
     "make_optimizer",

@@ -26,25 +26,27 @@ import optax
 
 from ..data.graph import GraphBatch
 from ..models.base import HydraModel
-from .loss import multitask_loss
+from .loss import compute_loss
 from .optimizer import ReduceLROnPlateau
 from .state import TrainState
 
 
-def make_train_step(model: HydraModel, tx: optax.GradientTransformation):
-    """Build the jitted SGD step: (state, batch, rng) -> (state, loss, tasks)."""
+def make_train_step(
+    model: HydraModel,
+    tx: optax.GradientTransformation,
+    compute_grad_energy: bool = False,
+):
+    """Build the jitted SGD step: (state, batch, rng) -> (state, loss, tasks).
+
+    ``compute_grad_energy=True`` switches to the energy+force objective
+    (reference: train_validate_test.py:517-520 -> Base.energy_force_loss)."""
     cfg = model.cfg
 
     def loss_fn(params, batch_stats, batch, rng):
         variables = {"params": params, "batch_stats": batch_stats}
-        outputs, mutated = model.apply(
-            variables,
-            batch,
-            train=True,
-            mutable=["batch_stats"],
-            rngs={"dropout": rng},
+        tot, tasks, mutated, _ = compute_loss(
+            model, variables, batch, cfg, True, rng, compute_grad_energy
         )
-        tot, tasks = multitask_loss(outputs, batch, cfg)
         return tot, (tasks, mutated)
 
     if cfg.conv_checkpointing:
@@ -70,13 +72,14 @@ def make_train_step(model: HydraModel, tx: optax.GradientTransformation):
     return train_step
 
 
-def make_eval_step(model: HydraModel):
+def make_eval_step(model: HydraModel, compute_grad_energy: bool = False):
     cfg = model.cfg
 
     @jax.jit
     def eval_step(state: TrainState, batch: GraphBatch):
-        outputs = model.apply(state.variables(), batch, train=False)
-        tot, tasks = multitask_loss(outputs, batch, cfg)
+        tot, tasks, _, outputs = compute_loss(
+            model, state.variables(), batch, cfg, False, None, compute_grad_energy
+        )
         return tot, tasks, outputs
 
     return eval_step
@@ -174,8 +177,9 @@ def train_validate_test(
     num_epoch = training["num_epoch"]
     do_valtest = os.getenv("HYDRAGNN_VALTEST", "1") != "0"
 
-    step_fn = make_train_step(model, tx)
-    eval_fn = make_eval_step(model)
+    compute_grad_energy = training.get("compute_grad_energy", False)
+    step_fn = make_train_step(model, tx, compute_grad_energy)
+    eval_fn = make_eval_step(model, compute_grad_energy)
     scheduler = ReduceLROnPlateau()
     stopper = (
         EarlyStopping(patience=training.get("patience", 10))
@@ -228,27 +232,38 @@ def train_validate_test(
 
 
 def test_model(
-    model: HydraModel, state: TrainState, loader
+    model: HydraModel, state: TrainState, loader, compute_grad_energy: bool = False
 ) -> Tuple[float, Dict[str, float], Dict[str, np.ndarray], Dict[str, np.ndarray]]:
     """Full-dataset evaluation returning flattened real predictions/targets
     per head (reference: test(), train_validate_test.py:620-748)."""
-    eval_fn = make_eval_step(model)
+    eval_fn = make_eval_step(model, compute_grad_energy)
     cfg = model.cfg
+    if compute_grad_energy:
+        # energy is reported graph-level, forces node-level, regardless of the
+        # (node) head type (reference: test(), train_validate_test.py:655-698)
+        names_types = [(cfg.output_names[0], "graph"), ("forces", "node")]
+    else:
+        names_types = list(zip(cfg.output_names, cfg.output_type))
     entries = []
-    preds: Dict[str, List[np.ndarray]] = {n: [] for n in cfg.output_names}
-    trues: Dict[str, List[np.ndarray]] = {n: [] for n in cfg.output_names}
+    preds: Dict[str, List[np.ndarray]] = {n: [] for n, _ in names_types}
+    trues: Dict[str, List[np.ndarray]] = {n: [] for n, _ in names_types}
     for batch in loader:
         tot, tasks, outputs = eval_fn(state, batch)
         n = int(np.asarray(batch.graph_mask).sum())
         entries.append((float(tot), {k: float(v) for k, v in tasks.items()}, n))
-        for name, t in zip(cfg.output_names, cfg.output_type):
+        for name, t in names_types:
             if t == "graph":
                 mask = np.asarray(batch.graph_mask)
-                target = np.asarray(batch.graph_targets[name])
+                if compute_grad_energy:
+                    target = np.asarray(batch.graph_targets["energy"]).reshape(
+                        -1, 1
+                    )
+                else:
+                    target = np.asarray(batch.graph_targets[name])
             else:
                 mask = np.asarray(batch.node_mask)
                 target = np.asarray(batch.node_targets[name])
-            preds[name].append(np.asarray(outputs[name])[mask])
+            preds[name].append(np.asarray(outputs[name]).reshape(target.shape)[mask])
             trues[name].append(target[mask])
     tot, tasks = _weighted_avg(entries)
     return (

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
+import jax
 import jax.numpy as jnp
 
 from ..data.graph import GraphBatch
@@ -62,6 +63,134 @@ def gaussian_nll(
     v = jnp.maximum(var, eps)
     per_elem = 0.5 * (jnp.log(v) + (pred - target) ** 2 / v)
     return masked_mean(per_elem, mask)
+
+
+def compute_loss(
+    model,
+    variables: Dict,
+    batch: GraphBatch,
+    cfg: ModelConfig,
+    train: bool,
+    rng,
+    compute_grad_energy: bool,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], Dict, Dict[str, jnp.ndarray]]:
+    """Single entry point for both objectives, shared by the single-device and
+    mesh-parallel step builders: returns (total, per-task losses, mutated
+    collections, outputs)."""
+    if compute_grad_energy:
+        def apply_outputs(b):
+            if train:
+                return model.apply(
+                    variables,
+                    b,
+                    train=True,
+                    mutable=["batch_stats"],
+                    rngs={"dropout": rng},
+                )
+            return model.apply(variables, b, train=False), None
+
+        tot, tasks, aux, preds = energy_force_loss(apply_outputs, batch, cfg)
+        return tot, tasks, aux or {}, preds
+    if train:
+        outputs, mutated = model.apply(
+            variables,
+            batch,
+            train=True,
+            mutable=["batch_stats"],
+            rngs={"dropout": rng},
+        )
+    else:
+        outputs, mutated = model.apply(variables, batch, train=False), {}
+    tot, tasks = multitask_loss(outputs, batch, cfg)
+    return tot, tasks, mutated, outputs
+
+
+def energy_force_loss(
+    apply_outputs: "callable",
+    batch: GraphBatch,
+    cfg: ModelConfig,
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray], object, Dict[str, jnp.ndarray]]:
+    """Energy + autograd-force loss (reference: Base.energy_force_loss,
+    hydragnn/models/Base.py:582-636). Returns
+    ``(total, per_task_losses, aux, predictions)`` where ``predictions`` holds
+    the graph energies [G] and forces [N,3] already computed for the loss.
+
+    The model's single node head predicts per-node energy; graph energy is the
+    masked segment-sum over nodes and forces are ``-dE/dpos`` — in JAX a plain
+    ``jax.grad`` through the forward (vs the reference's
+    ``torch.autograd.grad(..., create_graph=True)`` dance), so the force loss
+    backward is just second-order AD handled by XLA.
+
+    ``apply_outputs(batch) -> (outputs, aux)`` must close over params so that
+    this function can differentiate w.r.t. positions only; ``aux`` (e.g.
+    mutated batch stats) is threaded through ``has_aux`` and returned.
+
+    Targets: ``batch.graph_targets['energy']`` [G,1] and
+    ``batch.node_targets['forces']`` [N,3].
+    """
+    assert cfg.num_heads == 1 and cfg.output_type[0] == "node", (
+        "energy-force training needs exactly one node head predicting nodal "
+        "energy (reference assert, Base.py:590-593)"
+    )
+    name = cfg.output_names[0]
+    node_mask_f = batch.node_mask.astype(batch.pos.dtype)
+    graph_mask_f = batch.graph_mask.astype(batch.pos.dtype)
+
+    def graph_energy_sum(pos):
+        outputs, aux = apply_outputs(batch.replace(pos=pos))
+        node_e = outputs[name][:, 0] * node_mask_f
+        graph_e = jnp.zeros((batch.num_graphs,), node_e.dtype)
+        graph_e = graph_e.at[batch.node_graph].add(node_e)
+        return jnp.sum(graph_e * graph_mask_f), (graph_e, aux)
+
+    (_, (graph_e_pred, aux)), de_dpos = jax.value_and_grad(
+        graph_energy_sum, has_aux=True
+    )(batch.pos)
+    forces_pred = -de_dpos
+
+    e_true = batch.graph_targets["energy"].reshape(-1)
+    f_true = batch.node_targets["forces"]
+
+    energy_loss = head_loss(
+        graph_e_pred[:, None], e_true[:, None], batch.graph_mask, cfg.loss_function_type
+    )
+    force_loss = head_loss(
+        forces_pred, f_true, batch.node_mask, cfg.loss_function_type
+    )
+    # auto-balanced force weight: energy and force terms contribute equally
+    # in the units of the data (Base.py:626-631)
+    e_w = cfg.normalized_task_weights[0]
+    mean_abs_e = masked_mean(jnp.abs(e_true)[:, None], batch.graph_mask)
+    mean_abs_f = masked_mean(jnp.abs(f_true), batch.node_mask)
+    f_w = e_w * mean_abs_e / (mean_abs_f + 1e-8)
+    tot = e_w * energy_loss + f_w * force_loss
+    tasks = {name: energy_loss, "forces": force_loss}
+    preds = {
+        name: graph_e_pred[:, None],
+        "forces": forces_pred * node_mask_f[:, None],
+    }
+    return tot, tasks, aux, preds
+
+
+def predict_energy_forces(
+    apply_outputs: "callable", batch: GraphBatch, cfg: ModelConfig
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Inference-side energies [G] and forces [N,3] (masked)."""
+    name = cfg.output_names[0]
+    node_mask_f = batch.node_mask.astype(batch.pos.dtype)
+
+    def graph_energy_sum(pos):
+        outputs, _ = apply_outputs(batch.replace(pos=pos))
+        node_e = outputs[name][:, 0] * node_mask_f
+        graph_e = jnp.zeros((batch.num_graphs,), node_e.dtype)
+        graph_e = graph_e.at[batch.node_graph].add(node_e)
+        return jnp.sum(graph_e * batch.graph_mask.astype(node_e.dtype)), graph_e
+
+    (_, graph_e), de_dpos = jax.value_and_grad(graph_energy_sum, has_aux=True)(
+        batch.pos
+    )
+    forces = -de_dpos * node_mask_f[:, None]
+    return graph_e, forces
 
 
 def multitask_loss(

@@ -131,3 +131,69 @@ def pytest_loader_sharded_batches_cover_all_graphs():
         assert gm.shape[0] == 4  # leading device axis
         seen += int(gm.sum())
     assert seen == (len(loader.graphs) // 8) * 8
+
+
+def pytest_dp_energy_force_training():
+    """Energy+force objective through the sharded mesh path
+    (compute_grad_energy plumbed into make_parallel_{train,eval}_step)."""
+    from hydragnn_tpu.data import lennard_jones_dataset
+
+    mesh = make_mesh()
+    graphs = lennard_jones_dataset(64, seed=5)
+    tr, va, te = split_dataset(graphs, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 2.5,
+                "max_neighbours": 32,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "node": {
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                        "type": "mlp",
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["graph_energy"],
+                "output_index": [0],
+                "type": ["node"],
+                "output_dim": [1],
+            },
+            "Training": {
+                "batch_size": 16,
+                "num_epoch": 2,
+                "compute_grad_energy": True,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
+            },
+        },
+        "Dataset": {"node_features": {"dim": [1]}},
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(tr, 16, seed=0, num_shards=8, drop_last=True)
+    val_loader = GraphLoader(va, 16, spec=loader.spec, shuffle=False, num_shards=8)
+    model = create_model(config)
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], next(iter(loader)))
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = replicate_state(TrainState.create(variables, tx), mesh)
+    step = make_parallel_train_step(model, tx, mesh, compute_grad_energy=True)
+    evalf = make_parallel_eval_step(model, mesh, compute_grad_energy=True)
+
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for epoch in range(5):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            rng, sub = jax.random.split(rng)
+            state, tot, tasks = step(state, batch, sub)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0], f"force DP training did not converge: {losses}"
+    va_loss, va_tasks = evalf(state, next(iter(val_loader)))
+    assert np.isfinite(float(va_loss))
+    assert "forces" in va_tasks
