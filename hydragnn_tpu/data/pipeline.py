@@ -72,10 +72,6 @@ def select_input_columns(graph: Graph, voi: VariablesOfInterest) -> Graph:
 
 def extract_variables(graph: Graph, voi: VariablesOfInterest) -> Graph:
     """Produce a model-ready graph: input columns + per-head target dicts."""
-    in_cols = np.concatenate(
-        [np.arange(voi.node_feature_slice(i).start, voi.node_feature_slice(i).stop)
-         for i in voi.input_node_features]
-    )
     graph_targets: Dict[str, np.ndarray] = {}
     node_targets: Dict[str, np.ndarray] = {}
     for name, t, idx in zip(voi.output_names, voi.output_types, voi.output_index):
@@ -84,8 +80,7 @@ def extract_variables(graph: Graph, voi: VariablesOfInterest) -> Graph:
         else:
             node_targets[name] = np.asarray(graph.x)[:, voi.node_feature_slice(idx)]
     return dataclasses.replace(
-        graph,
-        x=np.asarray(graph.x)[:, in_cols],
+        select_input_columns(graph, voi),
         graph_targets=graph_targets,
         node_targets=node_targets,
     )

@@ -128,10 +128,24 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
     )
 
 
-def create_model(config: Dict[str, Any]) -> HydraModel:
+def create_model(config: Dict[str, Any]):
     """Completed config dict -> flax model (reference: create_model_config,
-    create.py:35-82)."""
-    return HydraModel(cfg=model_config_from(config))
+    create.py:35-82). MACE gets its own module class because its n-body
+    per-layer readout structure replaces the shared encoder/decoder split
+    (reference: create.py:473-512 -> MACEStack)."""
+    cfg = model_config_from(config)
+    if cfg.mpnn_type == "MACE":
+        from .mace import MACEModel
+
+        assert cfg.radius is not None, "MACE requires radius"
+        assert cfg.num_radial is not None, "MACE requires num_radial"
+        assert (cfg.max_ell or 0) >= 1, "MACE requires max_ell >= 1"
+        assert (cfg.node_max_ell or 0) >= 1, "MACE requires node_max_ell >= 1"
+        assert not cfg.use_global_attn, (
+            "GPS global attention is not supported with MACE"
+        )
+        return MACEModel(cfg=cfg)
+    return HydraModel(cfg=cfg)
 
 
 def init_model(
@@ -144,4 +158,4 @@ def init_model(
 
 
 def available_models() -> Tuple[str, ...]:
-    return conv_registry()
+    return conv_registry() + ("MACE",)

@@ -59,7 +59,11 @@ class DimeNetConv(nn.Module):
         pos_kj = vec[batch.trip_kj]
         pos_ki = pos_kj + pos_ji
         a = jnp.sum(pos_ji * pos_ki, axis=-1)
-        b = jnp.linalg.norm(jnp.cross(pos_ji, pos_ki), axis=-1)
+        cross = jnp.cross(pos_ji, pos_ki)
+        # smoothed norm: keeps d(angle)/d(pos) finite at collinear and
+        # zero-length (padding) triplets, which energy-force training
+        # differentiates through (plain norm() has a NaN gradient at 0)
+        b = jnp.sqrt(jnp.sum(cross * cross, axis=-1) + 1e-12)
         angle = jnp.arctan2(b, a)
 
         sbf = spherical_basis(dist, angle, batch.trip_kj, self.radius,

@@ -1,0 +1,344 @@
+"""MACE: higher-order E(3)-equivariant message passing, TPU-native.
+
+Re-design of the reference's MACE stack (hydragnn/models/MACEStack.py, which
+adapts ACEsuit/MACE via e3nn) in terms of dense uniform-multiplicity irreps
+arrays ``[N, C, (L+1)^2]`` and host-precomputed real CG tensors (ops/o3.py):
+
+- node attributes are one-hot atomic numbers Z in [1,118]
+  (MACEStack.py:123-126), embedded to C scalar channels;
+- each layer runs an attention-style residual interaction
+  (mace_utils/modules/blocks.py:286-390: linear_up, radial MLP over
+  [bessel, scalars_down[sender], scalars_down[receiver]] producing per-path
+  per-channel tensor-product weights, CG coupling with edge spherical
+  harmonics, receiver segment-sum / avg_num_neighbors, linear, plus an
+  equivariant skip connection) followed by the symmetric product basis
+  (blocks.py:166-204 -> symmetric_contraction.py): here the n-body product is
+  built recursively — B_1 = A, B_{k+1} = CG(B_k (x) A) — with per-element,
+  per-channel weights at every order, which spans the same n-body feature
+  space as the reference's U-matrix formulation without e3nn codegen;
+- predictions are an n-body expansion: a readout per layer (plus one on the
+  raw one-hot attributes), all summed (MACEStack.py:21-28, forward
+  :367-400). The last layer contracts to scalars and decodes nonlinearly.
+
+Everything is einsum over static slices — XLA maps the channel dimension onto
+the MXU; no data-dependent shapes anywhere. Spherical harmonics act on edge
+vectors only, which are translation invariant, so the reference's per-graph
+position centering (MACEStack.py:405-418) is unnecessary here.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.graph import GraphBatch
+from ..ops.o3 import irrep_slice, real_cg, real_sph_harm, sh_dim, tp_paths
+from ..ops.radial import RadialEmbedding, edge_vectors
+from ..ops.segment import masked_global_mean_pool
+from .base import ModelConfig, NodeHeadConfig
+from .layers import MLP, get_activation
+
+NUM_ELEMENTS = 118
+
+
+class EquivariantLinear(nn.Module):
+    """Per-l channel mixing [N, C_in, (Lin+1)^2] -> [N, C_out, (Lout+1)^2].
+
+    The analog of e3nn ``o3.Linear`` on uniform-multiplicity irreps: one
+    weight matrix per l (shared across the 2l+1 components, which is exactly
+    what keeps it equivariant); bias only on l=0.
+    """
+
+    features: int
+    lmax_out: int
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        n, c_in, d_in = x.shape
+        lmax_in = int(math.isqrt(d_in)) - 1
+        outs = []
+        for l in range(self.lmax_out + 1):
+            if l <= lmax_in:
+                w = self.param(
+                    f"w{l}",
+                    nn.initializers.lecun_normal(),
+                    (c_in, self.features),
+                    x.dtype,
+                )
+                block = jnp.einsum("ncm,cf->nfm", x[:, :, irrep_slice(l)], w)
+                if l == 0:
+                    b = self.param(
+                        "b0", nn.initializers.zeros, (self.features,), x.dtype
+                    )
+                    block = block + b[None, :, None]
+            else:
+                block = jnp.zeros((n, self.features, 2 * l + 1), x.dtype)
+            outs.append(block)
+        return jnp.concatenate(outs, axis=-1)
+
+
+class MACEInteraction(nn.Module):
+    """Residual attention-style interaction block
+    (reference: RealAgnosticAttResidualInteractionBlock, blocks.py:286-390)."""
+
+    features: int
+    max_ell: int  # lmax of edge spherical harmonics and messages
+    node_max_ell: int  # lmax of node features / skip connection
+    avg_num_neighbors: float
+    last_layer: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        h: jnp.ndarray,  # [N, C, (lin+1)^2]
+        sh: jnp.ndarray,  # [E, (max_ell+1)^2]
+        radial: jnp.ndarray,  # [E, B]
+        batch: GraphBatch,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        c = self.features
+        lmax_in = int(math.isqrt(h.shape[-1])) - 1
+        sc_lmax = 0 if self.last_layer else self.node_max_ell
+        sc = EquivariantLinear(c, sc_lmax, name="skip")(h)
+        h_up = EquivariantLinear(c, lmax_in, name="linear_up")(h)
+        scalars_down = nn.Dense(c, name="linear_down")(h[:, :, 0])
+
+        edge_in = [radial, scalars_down[batch.senders], scalars_down[batch.receivers]]
+        if batch.edge_attr is not None:
+            edge_in.append(batch.edge_attr)
+        edge_in = jnp.concatenate(edge_in, axis=-1)
+
+        paths = tp_paths(lmax_in, self.max_ell, self.max_ell)
+        tp_w = MLP(
+            (c, c, c, len(paths) * c), activation="silu", name="conv_tp_weights"
+        )(edge_in).reshape(-1, len(paths), c)
+
+        hs = h_up[batch.senders]  # [E, C, (lin+1)^2]
+        msg = jnp.zeros((sh.shape[0], c, sh_dim(self.max_ell)), h.dtype)
+        for p, (l1, l2, l3) in enumerate(paths):
+            cg = jnp.asarray(real_cg(l1, l2, l3), h.dtype)
+            contrib = jnp.einsum(
+                "eca,eb,abm->ecm",
+                hs[:, :, irrep_slice(l1)],
+                sh[:, irrep_slice(l2)],
+                cg,
+            )
+            contrib = contrib * tp_w[:, p, :, None]
+            msg = msg.at[:, :, irrep_slice(l3)].add(contrib)
+
+        msg = msg * batch.edge_mask.astype(h.dtype)[:, None, None]
+        agg = jnp.zeros((h.shape[0], c, sh_dim(self.max_ell)), h.dtype)
+        agg = agg.at[batch.receivers].add(msg) / self.avg_num_neighbors
+        agg = EquivariantLinear(c, self.max_ell, name="linear")(agg)
+        return agg, sc
+
+
+class SymmetricProduct(nn.Module):
+    """n-body product basis with per-element weights
+    (reference: EquivariantProductBasisBlock -> SymmetricContraction,
+    blocks.py:166-204, symmetric_contraction.py:29-238).
+
+    Recursive construction: B_1 = A, B_{k+1}[l3] = sum_paths CG(B_k[l1],
+    A[l2]); the output is sum_k W_k(Z) (.) B_k projected to l <= lmax_out.
+    """
+
+    features: int
+    lmax_out: int
+    correlation: int
+    lmax_keep: int  # intermediate lmax retained during recursion
+
+    @nn.compact
+    def __call__(self, a: jnp.ndarray, node_attrs: jnp.ndarray) -> jnp.ndarray:
+        c = self.features
+        n = a.shape[0]
+        lmax_a = int(math.isqrt(a.shape[-1])) - 1
+        out = jnp.zeros((n, c, sh_dim(self.lmax_out)), a.dtype)
+        b = a
+        lmax_b = lmax_a
+        for k in range(1, self.correlation + 1):
+            if k > 1:
+                new_lmax = min(self.lmax_keep, lmax_b + lmax_a)
+                nb = jnp.zeros((n, c, sh_dim(new_lmax)), a.dtype)
+                for l1, l2, l3 in tp_paths(lmax_b, lmax_a, new_lmax):
+                    cg = jnp.asarray(real_cg(l1, l2, l3), a.dtype)
+                    nb = nb.at[:, :, irrep_slice(l3)].add(
+                        jnp.einsum(
+                            "nca,ncb,abm->ncm",
+                            b[:, :, irrep_slice(l1)],
+                            a[:, :, irrep_slice(l2)],
+                            cg,
+                        )
+                    )
+                b, lmax_b = nb, new_lmax
+            for l in range(min(self.lmax_out, lmax_b) + 1):
+                w = self.param(
+                    f"w{k}_{l}",
+                    nn.initializers.normal(1.0 / math.sqrt(NUM_ELEMENTS)),
+                    (NUM_ELEMENTS, c),
+                    a.dtype,
+                )
+                wn = node_attrs @ w  # [N, C] element-dependent mixing
+                out = out.at[:, :, irrep_slice(l)].add(
+                    wn[:, :, None] * b[:, :, irrep_slice(l)]
+                )
+        return out
+
+
+class MACEConv(nn.Module):
+    """One interaction + product layer mapping node irreps
+    [N, C, *] -> [N, C, (lmax_out+1)^2]."""
+
+    features: int
+    max_ell: int
+    node_max_ell: int
+    avg_num_neighbors: float
+    correlation: int
+    last_layer: bool = False
+
+    @nn.compact
+    def __call__(self, h, sh, radial, node_attrs, batch):
+        lmax_out = 0 if self.last_layer else self.node_max_ell
+        agg, sc = MACEInteraction(
+            self.features,
+            self.max_ell,
+            self.node_max_ell,
+            self.avg_num_neighbors,
+            last_layer=self.last_layer,
+            name="interaction",
+        )(h, sh, radial, batch)
+        prod = SymmetricProduct(
+            self.features,
+            lmax_out,
+            self.correlation,
+            lmax_keep=self.max_ell,
+            name="product",
+        )(agg, node_attrs)
+        prod = EquivariantLinear(self.features, lmax_out, name="sizing")(prod)
+        return prod + sc
+
+
+class MACEModel(nn.Module):
+    """Full MACE model with HydraGNN-style multihead decoding
+    (reference: MACEStack.forward, MACEStack.py:367-400; multihead decoders
+    blocks.py:417-899). Output contract matches ``HydraModel``: a dict of
+    head-name -> [G, d] or [N, d], so every train/eval/loss path is shared.
+    """
+
+    cfg: ModelConfig
+
+    @nn.compact
+    def __call__(self, batch: GraphBatch, train: bool = False):
+        cfg = self.cfg
+        c = cfg.hidden_dim
+        max_ell = int(cfg.max_ell or 3)
+        node_max_ell = int(cfg.node_max_ell or 1)
+        correlation = int(cfg.correlation or 2)
+        avg_num_neighbors = float(cfg.avg_num_neighbors or 1.0)
+        n_layers = cfg.num_conv_layers
+
+        assert batch.z is not None, "MACE requires atomic numbers (batch.z)"
+        z = jnp.clip(batch.z.astype(jnp.int32) - 1, 0, NUM_ELEMENTS - 1)
+        node_attrs = jax.nn.one_hot(z, NUM_ELEMENTS, dtype=batch.pos.dtype)
+        node_attrs = node_attrs * batch.node_mask.astype(batch.pos.dtype)[:, None]
+
+        vec, length = edge_vectors(
+            batch.pos, batch.senders, batch.receivers, batch.edge_shifts
+        )
+        sh = real_sph_harm(vec, max_ell)
+        radial = RadialEmbedding(
+            r_max=float(cfg.radius or 5.0),
+            num_basis=int(cfg.num_radial or 8),
+            radial_type=cfg.radial_type or "bessel",
+            envelope_exponent=int(cfg.envelope_exponent or 5),
+            distance_transform=cfg.distance_transform,
+            name="radial_embedding",
+        )(length, z=z, senders=batch.senders, receivers=batch.receivers)
+
+        # outputs start from the 1-body readout on the one-hot attributes
+        # (MACEStack.py:372-375)
+        outputs = self._readout(node_attrs, batch, nonlinear=False, idx=0)
+
+        h = nn.Dense(c, name="node_embedding")(node_attrs)[:, :, None]
+        for i in range(n_layers):
+            last = i == n_layers - 1
+            h = MACEConv(
+                c,
+                max_ell,
+                node_max_ell,
+                avg_num_neighbors,
+                correlation,
+                last_layer=last,
+                name=f"conv{i}",
+            )(h, sh, radial, node_attrs, batch)
+            layer_out = self._readout(
+                h[:, :, 0], batch, nonlinear=last, idx=i + 1
+            )
+            outputs = {k: outputs[k] + v for k, v in layer_out.items()}
+        return outputs
+
+    def _readout(
+        self, scalars: jnp.ndarray, batch: GraphBatch, nonlinear: bool, idx: int
+    ) -> Dict[str, jnp.ndarray]:
+        """Per-layer multihead decode of node scalars; graph heads pool first
+        (reference: Linear/NonLinearMultiheadDecoderBlock, blocks.py:417-899)."""
+        cfg = self.cfg
+        outputs: Dict[str, jnp.ndarray] = {}
+        pooled = None
+        for ihead, (name, t, d) in enumerate(
+            zip(cfg.output_names, cfg.output_type, cfg.output_dim)
+        ):
+            d_out = d * 2 if cfg.var_output else d
+            branch_outs = []
+            for b in range(cfg.num_branches):
+                prefix = f"readout{idx}_head{ihead}_branch{b}"
+                if t == "graph":
+                    if pooled is None:
+                        pooled = masked_global_mean_pool(
+                            scalars,
+                            batch.node_graph,
+                            batch.num_graphs,
+                            batch.node_mask,
+                        )
+                    if nonlinear:
+                        gh = cfg.graph_head
+                        dims = tuple(gh.dim_headlayers if gh else (scalars.shape[-1],))
+                        branch_outs.append(
+                            MLP(dims + (d_out,), cfg.activation, name=prefix)(pooled)
+                        )
+                    else:
+                        branch_outs.append(
+                            nn.Dense(d_out, name=prefix)(pooled)
+                        )
+                else:
+                    if nonlinear:
+                        nh = cfg.node_head or NodeHeadConfig()
+                        dims = tuple(nh.dim_headlayers)
+                        branch_outs.append(
+                            MLP(dims + (d_out,), cfg.activation, name=prefix)(scalars)
+                        )
+                    else:
+                        branch_outs.append(
+                            nn.Dense(d_out, name=prefix)(scalars)
+                        )
+            if cfg.num_branches == 1:
+                out = branch_outs[0]
+            else:
+                stacked = jnp.stack(branch_outs, axis=0)
+                ds = (
+                    batch.dataset_id
+                    if t == "graph"
+                    else batch.dataset_id[batch.node_graph]
+                )
+                out = jnp.take_along_axis(
+                    stacked, ds[None, :, None].astype(jnp.int32), axis=0
+                )[0]
+            if cfg.var_output:
+                outputs[name] = out[..., :d]
+                outputs[f"{name}__var"] = out[..., d:] ** 2
+            else:
+                outputs[name] = out
+        return outputs

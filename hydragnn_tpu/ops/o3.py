@@ -1,0 +1,208 @@
+"""O(3) representation algebra for higher-order equivariant message passing.
+
+TPU-native replacement for the e3nn machinery the reference's MACE stack
+wraps (reference: hydragnn/models/MACEStack.py:146-150 uses
+``o3.SphericalHarmonics``; hydragnn/utils/model/mace_utils/tools/cg.py:94-136
+builds Wigner/CG contraction tensors through e3nn). Everything here is either
+a host-side numpy precomputation (CG tensors, cached per (l1,l2,l3)) or a
+closed-form jax function (real spherical harmonics), so the device program is
+pure einsum/MXU work with no codegen.
+
+Conventions (self-consistent across this module, verified by
+tests/test_o3.py):
+- real spherical harmonics with "component" normalization
+  (mean_{unit sphere} Y_lm^2 = 1, i.e. sqrt(4*pi) times the orthonormal
+  basis), component order m = -l..l;
+- features with uniform channel multiplicity are stored dense as
+  [N, C, (L+1)^2] with irrep l occupying slice l^2:(l+1)^2 of the last axis.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import lru_cache
+from typing import Dict, List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# real spherical harmonics (closed form, l <= 3)
+# ---------------------------------------------------------------------------
+
+_SQRT_4PI = math.sqrt(4.0 * math.pi)
+
+
+def sh_dim(lmax: int) -> int:
+    return (lmax + 1) ** 2
+
+
+def irrep_slice(l: int) -> slice:
+    """Slice of irrep ``l`` inside a stacked [..., (L+1)^2] axis."""
+    return slice(l * l, (l + 1) * (l + 1))
+
+
+def real_sph_harm(vec: jnp.ndarray, lmax: int, eps: float = 1e-12) -> jnp.ndarray:
+    """Component-normalized real spherical harmonics of (auto-normalized)
+    3-vectors. vec: [..., 3] -> [..., (lmax+1)^2].
+
+    Replaces e3nn ``o3.SphericalHarmonics(normalize=True,
+    normalization="component")`` (reference: MACEStack.py:146-150).
+    """
+    if lmax > 3:
+        raise NotImplementedError("real_sph_harm implemented for lmax <= 3")
+    n = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
+    u = vec / n
+    x, y, z = u[..., 0], u[..., 1], u[..., 2]
+    out = [jnp.ones_like(x)]
+    if lmax >= 1:
+        c1 = math.sqrt(3.0)
+        out += [c1 * y, c1 * z, c1 * x]
+    if lmax >= 2:
+        c2a = math.sqrt(15.0)
+        c2b = math.sqrt(5.0) / 2.0
+        c2c = math.sqrt(15.0) / 2.0
+        out += [
+            c2a * x * y,
+            c2a * y * z,
+            c2b * (3.0 * z * z - 1.0),
+            c2a * x * z,
+            c2c * (x * x - y * y),
+        ]
+    if lmax >= 3:
+        c3a = math.sqrt(35.0 / 8.0)
+        c3b = math.sqrt(105.0)
+        c3c = math.sqrt(21.0 / 8.0)
+        c3d = math.sqrt(7.0) / 2.0
+        c3e = math.sqrt(105.0) / 2.0
+        out += [
+            c3a * y * (3.0 * x * x - y * y),
+            c3b * x * y * z,
+            c3c * y * (5.0 * z * z - 1.0),
+            c3d * z * (5.0 * z * z - 3.0),
+            c3c * x * (5.0 * z * z - 1.0),
+            c3e * z * (x * x - y * y),
+            c3a * x * (x * x - 3.0 * y * y),
+        ]
+    return jnp.stack(out, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# Clebsch-Gordan coefficients (complex, Racah formula) -> real basis
+# ---------------------------------------------------------------------------
+
+
+def _fact(n: float) -> float:
+    return math.gamma(n + 1.0)
+
+
+def _cg_complex_element(j1, m1, j2, m2, j3, m3) -> float:
+    """<j1 m1 j2 m2 | j3 m3> via the Racah closed form (Condon-Shortley)."""
+    if m3 != m1 + m2:
+        return 0.0
+    if not (abs(j1 - j2) <= j3 <= j1 + j2):
+        return 0.0
+    pref = math.sqrt(
+        (2 * j3 + 1)
+        * _fact(j3 + j1 - j2)
+        * _fact(j3 - j1 + j2)
+        * _fact(j1 + j2 - j3)
+        / _fact(j1 + j2 + j3 + 1)
+    )
+    pref *= math.sqrt(
+        _fact(j3 + m3)
+        * _fact(j3 - m3)
+        * _fact(j1 - m1)
+        * _fact(j1 + m1)
+        * _fact(j2 - m2)
+        * _fact(j2 + m2)
+    )
+    s = 0.0
+    kmin = max(0, int(j2 - j3 - m1), int(j1 - j3 + m2))
+    kmax = min(int(j1 + j2 - j3), int(j1 - m1), int(j2 + m2))
+    for k in range(kmin, kmax + 1):
+        s += (-1.0) ** k / (
+            _fact(k)
+            * _fact(j1 + j2 - j3 - k)
+            * _fact(j1 - m1 - k)
+            * _fact(j2 + m2 - k)
+            * _fact(j3 - j2 + m1 + k)
+            * _fact(j3 - j1 - m2 + k)
+        )
+    return pref * s
+
+
+@lru_cache(maxsize=None)
+def _cg_complex(l1: int, l2: int, l3: int) -> np.ndarray:
+    out = np.zeros((2 * l1 + 1, 2 * l2 + 1, 2 * l3 + 1))
+    for i1, m1 in enumerate(range(-l1, l1 + 1)):
+        for i2, m2 in enumerate(range(-l2, l2 + 1)):
+            for i3, m3 in enumerate(range(-l3, l3 + 1)):
+                out[i1, i2, i3] = _cg_complex_element(l1, m1, l2, m2, l3, m3)
+    return out
+
+
+@lru_cache(maxsize=None)
+def _real_to_complex(l: int) -> np.ndarray:
+    """U with Y_real = U @ Y_complex for the real convention above
+    (rows: real m = -l..l; cols: complex m = -l..l)."""
+    U = np.zeros((2 * l + 1, 2 * l + 1), complex)
+    for m in range(-l, l + 1):
+        r = m + l  # row index of real component m
+        if m == 0:
+            U[r, l] = 1.0
+        elif m > 0:
+            U[r, l + m] = (-1.0) ** m / math.sqrt(2.0)
+            U[r, l - m] = 1.0 / math.sqrt(2.0)
+        else:
+            a = -m
+            U[r, l + a] = -1j * (-1.0) ** a / math.sqrt(2.0)
+            U[r, l - a] = 1j / math.sqrt(2.0)
+    return U
+
+
+@lru_cache(maxsize=None)
+def real_cg(l1: int, l2: int, l3: int) -> np.ndarray:
+    """Real-basis Clebsch-Gordan tensor [2l1+1, 2l2+1, 2l3+1], normalized to
+    unit Frobenius norm (learned path weights absorb overall scale; the
+    reference's e3nn TensorProduct normalizes per path similarly)."""
+    C = _cg_complex(l1, l2, l3)
+    U1 = _real_to_complex(l1)
+    U2 = _real_to_complex(l2)
+    U3 = _real_to_complex(l3)
+    M = np.einsum("am,bn,co,mno->abc", U1, U2, np.conj(U3), C)
+    re, im = np.real(M), np.imag(M)
+    if np.linalg.norm(im) > 1e-9 * max(np.linalg.norm(re), 1e-30):
+        assert np.linalg.norm(re) < 1e-9 * np.linalg.norm(im), (
+            f"real CG ({l1},{l2},{l3}) is neither purely real nor imaginary"
+        )
+        out = im
+    else:
+        out = re
+    norm = np.linalg.norm(out)
+    if norm < 1e-12:
+        return np.zeros_like(out)
+    return (out / norm).astype(np.float64)
+
+
+def tp_paths(
+    lmax_in1: int, lmax_in2: int, lmax_out: int
+) -> List[Tuple[int, int, int]]:
+    """All coupling paths (l1, l2, l3) with |l1-l2| <= l3 <= l1+l2 and a
+    nonvanishing real CG tensor."""
+    paths = []
+    for l1 in range(lmax_in1 + 1):
+        for l2 in range(lmax_in2 + 1):
+            for l3 in range(abs(l1 - l2), min(l1 + l2, lmax_out) + 1):
+                if np.linalg.norm(real_cg(l1, l2, l3)) > 1e-8:
+                    paths.append((l1, l2, l3))
+    return paths
+
+
+def couple(
+    a: jnp.ndarray, b: jnp.ndarray, l1: int, l2: int, l3: int
+) -> jnp.ndarray:
+    """Channelwise CG coupling: a[..., 2l1+1] x b[..., 2l2+1] -> [..., 2l3+1]."""
+    cg = jnp.asarray(real_cg(l1, l2, l3), a.dtype)
+    return jnp.einsum("...a,...b,abc->...c", a, b, cg)

@@ -112,3 +112,29 @@ def pytest_forces_rotation_equivariant(mpnn_type):
     np.testing.assert_allclose(
         np.asarray(f0) @ q.T, np.asarray(f1), rtol=1e-3, atol=1e-4
     )
+
+
+@pytest.mark.parametrize("mpnn_type", ["MACE", "DimeNet", "PNAPlus"])
+def pytest_energy_force_smoke(mpnn_type):
+    """Remaining force-capable models run the energy+force objective without
+    error and reduce the loss (reference bar: the example exits 0,
+    tests/test_forces_equivariant.py:18-29)."""
+    over = {}
+    if mpnn_type == "MACE":
+        over = dict(
+            num_radial=6, max_ell=2, node_max_ell=1, correlation=2,
+            radial_type="bessel", envelope_exponent=5,
+        )
+    elif mpnn_type == "DimeNet":
+        over = dict(
+            num_radial=6, num_spherical=3, envelope_exponent=5,
+            basis_emb_size=4, int_emb_size=8, out_emb_size=8,
+            num_before_skip=1, num_after_skip=1,
+        )
+    elif mpnn_type == "PNAPlus":
+        over = dict(num_radial=5, envelope_exponent=5)
+    config = lj_config(mpnn_type, num_epoch=5, **over)
+    config["Dataset"]["lennard_jones"]["number_configurations"] = 24
+    model, state, hist, config, loaders, _ = run_training(config)
+    assert np.isfinite(hist["train"][-1])
+    assert hist["train"][-1] < hist["train"][0]

@@ -1,0 +1,134 @@
+"""MACE-specific tests: O(3) invariance of predictions, multihead decode,
+higher correlation orders (reference: MACE rows of tests/test_graphs.py and
+the equivariant subset :262-266)."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+)
+from hydragnn_tpu.models import create_model, init_model
+
+
+def _mace_setup(correlation=2, max_ell=2, heads="single", hidden=8):
+    raw = deterministic_graph_dataset(40, seed=97)
+    raw = MinMax.fit(raw).apply(raw)
+    if heads == "multi":
+        voi = VariablesOfInterest(
+            [0], ["sum_x_x2_x3", "x"], ["graph", "node"], [0, 0], [1, 1, 1], [1]
+        )
+        names, types, index = ["sum_x_x2_x3", "x"], ["graph", "node"], [0, 0]
+        weights = [1.0, 1.0]
+    else:
+        voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+        names, types, index = ["sum_x_x2_x3"], ["graph"], [0]
+        weights = [1.0]
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "MACE",
+                "hidden_dim": hidden,
+                "num_conv_layers": 2,
+                "radius": 2.0,
+                "num_radial": 6,
+                "max_ell": max_ell,
+                "node_max_ell": 1,
+                "correlation": correlation,
+                "radial_type": "bessel",
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 4,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [10, 10],
+                    },
+                    **(
+                        {
+                            "node": {
+                                "num_headlayers": 2,
+                                "dim_headlayers": [10, 10],
+                                "type": "mlp",
+                            }
+                        }
+                        if heads == "multi"
+                        else {}
+                    ),
+                },
+                "task_weights": weights,
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": names,
+                "output_index": index,
+                "type": types,
+            },
+            "Training": {
+                "batch_size": 8,
+                "num_epoch": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(tr, 8, seed=0)
+    model = create_model(config)
+    batch = next(iter(loader))
+    variables = init_model(model, batch, seed=0)
+    return model, variables, batch
+
+
+def _rotate(batch, seed=0):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    rot = np.asarray(batch.pos) @ q.T
+    return batch.replace(pos=rot.astype(np.float32))
+
+
+@pytest.mark.parametrize("correlation", [1, 2, 3])
+def pytest_mace_rotation_invariance(correlation):
+    model, variables, batch = _mace_setup(correlation=correlation)
+    out = model.apply(variables, batch, train=False)
+    out_r = model.apply(variables, _rotate(batch), train=False)
+    np.testing.assert_allclose(
+        np.asarray(out["sum_x_x2_x3"]),
+        np.asarray(out_r["sum_x_x2_x3"]),
+        atol=5e-4,
+    )
+
+
+def pytest_mace_multihead_shapes_and_invariance():
+    model, variables, batch = _mace_setup(heads="multi")
+    out = model.apply(variables, batch, train=False)
+    assert out["sum_x_x2_x3"].shape == (batch.num_graphs, 1)
+    assert out["x"].shape == (batch.num_nodes, 1)
+    out_r = model.apply(variables, _rotate(batch), train=False)
+    np.testing.assert_allclose(
+        np.asarray(out["x"]), np.asarray(out_r["x"]), atol=5e-4
+    )
+
+
+def pytest_mace_translation_invariance():
+    model, variables, batch = _mace_setup()
+    out = model.apply(variables, batch, train=False)
+    shifted = batch.replace(pos=batch.pos + np.float32(7.5))
+    out_t = model.apply(variables, shifted, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out["sum_x_x2_x3"]),
+        np.asarray(out_t["sum_x_x2_x3"]),
+        atol=5e-4,
+    )

@@ -1,0 +1,80 @@
+"""Self-consistency tests for the O(3) algebra underlying MACE.
+
+The reference leans on e3nn for correctness of spherical harmonics and
+Wigner/CG tensors (hydragnn/utils/model/mace_utils/tools/cg.py); here we
+verify our from-scratch versions numerically:
+- component normalization + orthogonality of the real spherical harmonics,
+- equivariance of the real CG tensors under rotation, with Wigner D matrices
+  fitted numerically from the spherical harmonics themselves.
+"""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.ops.o3 import (
+    irrep_slice,
+    real_cg,
+    real_sph_harm,
+    sh_dim,
+    tp_paths,
+)
+
+
+def _random_rotation(rng):
+    a = rng.normal(size=(3, 3))
+    q, _ = np.linalg.qr(a)
+    if np.linalg.det(q) < 0:
+        q[:, 0] *= -1
+    return q
+
+
+def _wigner_d(l, rot, n=4000, seed=0):
+    """Fit D_l with Y_l(R v) = D_l @ Y_l(v) by least squares over samples."""
+    rng = np.random.default_rng(seed)
+    v = rng.normal(size=(n, 3))
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    sl = irrep_slice(l)
+    y = np.asarray(real_sph_harm(v, l))[:, sl]
+    yr = np.asarray(real_sph_harm(v @ rot.T, l))[:, sl]
+    d, res, *_ = np.linalg.lstsq(y, yr, rcond=None)
+    return d.T
+
+
+def pytest_sh_orthogonality_and_component_norm():
+    rng = np.random.default_rng(1)
+    v = rng.normal(size=(200000, 3))
+    v /= np.linalg.norm(v, axis=1, keepdims=True)
+    y = np.asarray(real_sph_harm(v, 3))
+    gram = y.T @ y / v.shape[0]
+    # component normalization: diagonal = 1; orthogonality: off-diagonal = 0
+    np.testing.assert_allclose(gram, np.eye(sh_dim(3)), atol=2e-2)
+
+
+def pytest_sh_polynomial_identity():
+    # l=1 block is sqrt(3) * (y, z, x) of the normalized vector
+    v = np.array([[1.0, 2.0, -0.5]])
+    u = v / np.linalg.norm(v)
+    y = np.asarray(real_sph_harm(v, 1))[0]
+    np.testing.assert_allclose(
+        y[1:], np.sqrt(3.0) * np.array([u[0, 1], u[0, 2], u[0, 0]]), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("path", tp_paths(3, 3, 3))
+def pytest_real_cg_equivariance(path):
+    l1, l2, l3 = path
+    rng = np.random.default_rng(l1 * 16 + l2 * 4 + l3)
+    rot = _random_rotation(rng)
+    d1, d2, d3 = _wigner_d(l1, rot), _wigner_d(l2, rot), _wigner_d(l3, rot)
+    cg = real_cg(l1, l2, l3)
+    lhs = np.einsum("ap,bq,abc->pqc", d1, d2, cg)
+    rhs = np.einsum("pqr,cr->pqc", cg, d3)
+    np.testing.assert_allclose(lhs, rhs, atol=2e-4)
+
+
+def pytest_wigner_d_orthogonal():
+    rng = np.random.default_rng(3)
+    rot = _random_rotation(rng)
+    for l in range(4):
+        d = _wigner_d(l, rot)
+        np.testing.assert_allclose(d @ d.T, np.eye(2 * l + 1), atol=1e-5)

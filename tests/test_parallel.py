@@ -167,7 +167,7 @@ def pytest_dp_energy_force_training():
             },
             "Training": {
                 "batch_size": 16,
-                "num_epoch": 2,
+                "num_epoch": 5,
                 "compute_grad_energy": True,
                 "Optimizer": {"type": "AdamW", "learning_rate": 0.005},
             },
@@ -187,7 +187,7 @@ def pytest_dp_energy_force_training():
 
     rng = jax.random.PRNGKey(0)
     losses = []
-    for epoch in range(5):
+    for epoch in range(config["NeuralNetwork"]["Training"]["num_epoch"]):
         loader.set_epoch(epoch)
         for batch in loader:
             rng, sub = jax.random.split(rng)

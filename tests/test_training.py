@@ -50,6 +50,16 @@ def make_config(mpnn_type, heads="single", num_epoch=40, num_configs=150, **arch
             "type": ["graph", "node", "node", "node"],
             "denormalize_output": False,
         }
+    if mpnn_type == "MACE":
+        # reference CI MACE hyperparameters (tests/inputs/ci.json:33-45)
+        arch.update(
+            num_radial=6,
+            max_ell=2,
+            node_max_ell=1,
+            correlation=2,
+            radial_type="bessel",
+            envelope_exponent=5,
+        )
     arch.update(arch_over)
     return {
         "Verbosity": {"level": 0},
@@ -120,7 +130,7 @@ def _check_thresholds(config, tmp_path, monkeypatch):
 @pytest.mark.parametrize(
     "mpnn_type",
     ["GIN", "SAGE", "PNA", "MFC", "GAT", "CGCNN",
-     "SchNet", "PNAPlus", "EGNN", "PAINN", "PNAEq", "DimeNet"],
+     "SchNet", "PNAPlus", "EGNN", "PAINN", "PNAEq", "DimeNet", "MACE"],
 )
 def pytest_train_singlehead(mpnn_type, tmp_path, monkeypatch):
     _check_thresholds(make_config(mpnn_type), tmp_path, monkeypatch)
