@@ -133,33 +133,59 @@ def _(config: str, datasets=None, verbosity: Optional[int] = None):
 @run_training.register
 def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     """(reference: run_training.py:62-182)"""
-    config, loaders, mm = prepare_data(config, datasets)
+    from .utils import MetricsWriter, Timer, print_timers, setup_log
+    from .utils import tracer as tr
+
+    # fresh per-run accumulators (class/module-level state would otherwise
+    # report cumulative totals across repeated runs in one process)
+    Timer.reset()
+    tr.reset()
+    with Timer("load_data"):
+        config, loaders, mm = prepare_data(config, datasets)
     train_loader, val_loader, test_loader = loaders
     verbosity = (
         verbosity if verbosity is not None else config["Verbosity"].get("level", 0)
     )
     log_name = get_log_name_config(config)
+    if verbosity > 0:
+        setup_log(log_name)
     save_config(config, log_name)
 
-    model = create_model(config)
-    variables = init_model(model, next(iter(train_loader)), seed=0)
+    with Timer("create_model"):
+        model = create_model(config)
+        variables = init_model(model, next(iter(train_loader)), seed=0)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
     state = TrainState.create(variables, tx)
 
+    writer = MetricsWriter(log_name)
+
+    def log_fn(epoch, scalars):
+        # per-epoch scalars (reference: train_validate_test.py:198-205)
+        writer.add_scalars(
+            {f"loss/{k}": v for k, v in scalars.items() if k != "lr"}, epoch
+        )
+        writer.add_scalar("lr", scalars.get("lr", 0.0), epoch)
+
     save_fn = lambda s: save_model(s, log_name)
-    state, hist = train_validate_test(
-        model,
-        state,
-        tx,
-        train_loader,
-        val_loader,
-        test_loader,
-        config,
-        log_name=log_name,
-        verbosity=verbosity,
-        save_fn=save_fn,
-    )
+    try:
+        with Timer("train_validate_test"):
+            state, hist = train_validate_test(
+                model,
+                state,
+                tx,
+                train_loader,
+                val_loader,
+                test_loader,
+                config,
+                log_name=log_name,
+                verbosity=verbosity,
+                save_fn=save_fn,
+                log_fn=log_fn,
+            )
+    finally:
+        writer.close()
     save_model(state, log_name)
+    print_timers(verbosity)
     return model, state, hist, config, loaders, mm
 
 

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.graph import GraphBatch
-from ..ops.o3 import irrep_slice, real_cg, real_sph_harm, sh_dim, tp_paths
+from ..ops.o3 import couple, irrep_slice, real_sph_harm, sh_dim, tp_paths
 from ..ops.radial import RadialEmbedding, edge_vectors
 from ..ops.segment import masked_global_mean_pool
 from .base import ModelConfig, NodeHeadConfig
@@ -120,12 +120,8 @@ class MACEInteraction(nn.Module):
         hs = h_up[batch.senders]  # [E, C, (lin+1)^2]
         msg = jnp.zeros((sh.shape[0], c, sh_dim(self.max_ell)), h.dtype)
         for p, (l1, l2, l3) in enumerate(paths):
-            cg = jnp.asarray(real_cg(l1, l2, l3), h.dtype)
-            contrib = jnp.einsum(
-                "eca,eb,abm->ecm",
-                hs[:, :, irrep_slice(l1)],
-                sh[:, irrep_slice(l2)],
-                cg,
+            contrib = couple(
+                hs[:, :, irrep_slice(l1)], sh[:, None, irrep_slice(l2)], l1, l2, l3
             )
             contrib = contrib * tp_w[:, p, :, None]
             msg = msg.at[:, :, irrep_slice(l3)].add(contrib)
@@ -164,13 +160,13 @@ class SymmetricProduct(nn.Module):
                 new_lmax = min(self.lmax_keep, lmax_b + lmax_a)
                 nb = jnp.zeros((n, c, sh_dim(new_lmax)), a.dtype)
                 for l1, l2, l3 in tp_paths(lmax_b, lmax_a, new_lmax):
-                    cg = jnp.asarray(real_cg(l1, l2, l3), a.dtype)
                     nb = nb.at[:, :, irrep_slice(l3)].add(
-                        jnp.einsum(
-                            "nca,ncb,abm->ncm",
+                        couple(
                             b[:, :, irrep_slice(l1)],
                             a[:, :, irrep_slice(l2)],
-                            cg,
+                            l1,
+                            l2,
+                            l3,
                         )
                     )
                 b, lmax_b = nb, new_lmax
@@ -241,8 +237,9 @@ class MACEModel(nn.Module):
         n_layers = cfg.num_conv_layers
 
         assert batch.z is not None, "MACE requires atomic numbers (batch.z)"
-        z = jnp.clip(batch.z.astype(jnp.int32) - 1, 0, NUM_ELEMENTS - 1)
-        node_attrs = jax.nn.one_hot(z, NUM_ELEMENTS, dtype=batch.pos.dtype)
+        z = jnp.clip(batch.z.astype(jnp.int32), 0, NUM_ELEMENTS)
+        z_idx = jnp.clip(z - 1, 0, NUM_ELEMENTS - 1)  # one-hot slot for Z
+        node_attrs = jax.nn.one_hot(z_idx, NUM_ELEMENTS, dtype=batch.pos.dtype)
         node_attrs = node_attrs * batch.node_mask.astype(batch.pos.dtype)[:, None]
 
         vec, length = edge_vectors(

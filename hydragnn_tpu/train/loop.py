@@ -96,11 +96,26 @@ def _weighted_avg(entries: List[Tuple[float, Dict[str, float], int]]):
 
 
 def train_epoch(loader, step_fn, state, rng):
+    from ..utils import tracer as tr
+
     entries = []
-    for i, batch in enumerate(loader):
+    it = iter(loader)
+    for i in range(len(loader)):
+        # dataload span covers host batching + H2D staging (the reference's
+        # per-step data.to(device), train_validate_test.py:506-514; here the
+        # jitted step overlaps with the next host batch via async dispatch)
+        tr.start("dataload")
+        try:
+            batch = next(it)
+        except StopIteration:
+            tr.stop("dataload")
+            break
+        tr.stop("dataload")
         rng, sub = jax.random.split(rng)
+        tr.start("train_step")
         state, tot, tasks = step_fn(state, batch, sub)
         n = int(np.asarray(batch.graph_mask).sum())
+        tr.stop("train_step")
         entries.append((float(tot), {k: float(v) for k, v in tasks.items()}, n))
         max_batches = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
         if max_batches is not None and i + 1 >= int(max_batches):
@@ -192,42 +207,63 @@ def train_validate_test(
         else None
     )
 
+    from ..utils import tracer as tr
+    from ..utils.profile import Profiler
+    from ..utils.walltime import should_stop
+
+    profiler = Profiler(config.get("Profile"), log_dir=f"./logs/{log_name}/profile")
+    check_remaining = training.get("CheckRemainingTime", False)
+    tr.enable()
+
     rng = jax.random.PRNGKey(seed)
     hist: Dict[str, List[float]] = {"train": [], "val": [], "test": [], "lr": []}
-    for epoch in range(num_epoch):
-        t0 = time.time()
-        train_loader.set_epoch(epoch)
-        state, tr_loss, tr_tasks, rng = train_epoch(train_loader, step_fn, state, rng)
-        hist["train"].append(tr_loss)
+    try:
+        for epoch in range(num_epoch):
+            t0 = time.time()
+            profiler.epoch_begin(epoch)
+            train_loader.set_epoch(epoch)
+            with tr.timer("train"):
+                state, tr_loss, tr_tasks, rng = train_epoch(
+                    train_loader, step_fn, state, rng
+                )
+            hist["train"].append(tr_loss)
 
-        if do_valtest:
-            va_loss, _ = evaluate(val_loader, eval_fn, state)
-            te_loss, _ = evaluate(test_loader, eval_fn, state)
-        else:
-            va_loss = te_loss = tr_loss
-        hist["val"].append(va_loss)
-        hist["test"].append(te_loss)
+            if do_valtest:
+                with tr.timer("validate"):
+                    va_loss, _ = evaluate(val_loader, eval_fn, state)
+                with tr.timer("test"):
+                    te_loss, _ = evaluate(test_loader, eval_fn, state)
+            else:
+                va_loss = te_loss = tr_loss
+            hist["val"].append(va_loss)
+            hist["test"].append(te_loss)
+            profiler.epoch_end(epoch)
 
-        new_lr = scheduler.step(va_loss, state.learning_rate)
-        if new_lr != state.learning_rate:
-            state = state.with_learning_rate(new_lr)
-        hist["lr"].append(state.learning_rate)
+            new_lr = scheduler.step(va_loss, state.learning_rate)
+            if new_lr != state.learning_rate:
+                state = state.with_learning_rate(new_lr)
+            hist["lr"].append(state.learning_rate)
 
-        if log_fn is not None:
-            log_fn(
-                epoch,
-                {"train": tr_loss, "val": va_loss, "test": te_loss, "lr": state.learning_rate},
-            )
-        if verbosity > 0:
-            print(
-                f"[{log_name}] epoch {epoch}: train {tr_loss:.5f} val {va_loss:.5f} "
-                f"test {te_loss:.5f} lr {state.learning_rate:.2e} ({time.time()-t0:.1f}s)"
-            )
+            if log_fn is not None:
+                log_fn(
+                    epoch,
+                    {"train": tr_loss, "val": va_loss, "test": te_loss, "lr": state.learning_rate},
+                )
+            if verbosity > 0:
+                print(
+                    f"[{log_name}] epoch {epoch}: train {tr_loss:.5f} val {va_loss:.5f} "
+                    f"test {te_loss:.5f} lr {state.learning_rate:.2e} ({time.time()-t0:.1f}s)"
+                )
 
-        if checkpointer is not None:
-            checkpointer(state, va_loss, epoch)
-        if stopper is not None and stopper(va_loss):
-            break
+            if checkpointer is not None:
+                checkpointer(state, va_loss, epoch)
+            if stopper is not None and stopper(va_loss):
+                break
+            # SLURM walltime-aware stop (reference: train_validate_test.py:257-264)
+            if check_remaining and should_stop(time.time() - t0):
+                break
+    finally:
+        profiler.close()
     return state, hist
 
 

@@ -1,0 +1,32 @@
+"""Observability and run-support utilities (SURVEY §5.1, §5.5):
+region tracer, phase timers, device profiler, leveled printing, metric
+writer, SLURM walltime stop."""
+
+from . import tracer
+from .printing import (
+    iterate_tqdm,
+    print_distributed,
+    print_master,
+    setup_log,
+)
+from .profile import Profiler, peak_memory_stats, print_peak_memory
+from .timers import Timer, print_timers
+from .walltime import parse_slurm_remaining, query_remaining_seconds, should_stop
+from .writer import MetricsWriter
+
+__all__ = [
+    "MetricsWriter",
+    "Profiler",
+    "Timer",
+    "iterate_tqdm",
+    "parse_slurm_remaining",
+    "peak_memory_stats",
+    "print_distributed",
+    "print_master",
+    "print_peak_memory",
+    "print_timers",
+    "query_remaining_seconds",
+    "setup_log",
+    "should_stop",
+    "tracer",
+]

@@ -1,0 +1,68 @@
+"""Verbosity-leveled, process-aware printing and run logging
+(reference: hydragnn/utils/print/print_utils.py).
+
+Levels 0-4 as in the reference (print_utils.py:20-27); ``print_distributed``
+prints on process 0 only unless level >= 4 (rank-prefixed everywhere,
+print_utils.py:42-53); ``setup_log`` attaches python logging to
+``./logs/<name>/run.log`` + console (print_utils.py:63-91).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def print_master(*args, verbosity_level: int = 2, verbosity: int = 2) -> None:
+    if verbosity >= verbosity_level and _process_index() == 0:
+        print(*args)
+
+
+def print_distributed(verbosity: int, *args) -> None:
+    """(reference: print_utils.py:42-53)"""
+    if verbosity >= 4:
+        print(f"[rank {_process_index()}]", *args)
+    elif verbosity >= 1 and _process_index() == 0:
+        print(*args)
+
+
+def iterate_tqdm(iterable: Iterable, verbosity: int, **kwargs):
+    """Rank-gated progress iterator (reference: print_utils.py:56-60)."""
+    if verbosity >= 2 and _process_index() == 0:
+        try:
+            from tqdm import tqdm
+
+            return tqdm(iterable, **kwargs)
+        except ImportError:
+            return iterable
+    return iterable
+
+
+def setup_log(name: str, path: str = "./logs") -> logging.Logger:
+    """(reference: print_utils.py:63-91)"""
+    run_dir = os.path.join(path, name)
+    os.makedirs(run_dir, exist_ok=True)
+    logger = logging.getLogger("hydragnn_tpu")
+    logger.setLevel(logging.INFO)
+    logger.handlers.clear()
+    fmt = logging.Formatter(
+        f"%(asctime)s [rank {_process_index()}] %(levelname)s: %(message)s"
+    )
+    fh = logging.FileHandler(os.path.join(run_dir, "run.log"))
+    fh.setFormatter(fmt)
+    logger.addHandler(fh)
+    ch = logging.StreamHandler(sys.stdout)
+    ch.setFormatter(fmt)
+    logger.addHandler(ch)
+    return logger

@@ -1,0 +1,71 @@
+"""Device profiler wrapper — the torch.profiler/Kineto analog
+(reference: hydragnn/utils/profiling_and_tracing/profile.py:9-70).
+
+Captures one configured epoch into a TensorBoard-compatible xprof trace via
+``jax.profiler`` (reference semantics: config ``"Profile": {"enable": 1,
+"target_epoch": N}`` profiles that epoch only; a null context otherwise).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+
+class Profiler:
+    def __init__(self, config: Optional[Dict[str, Any]] = None, log_dir: str = "./logs/profile"):
+        config = config or {}
+        self.enabled = bool(config.get("enable", 0))
+        self.target_epoch = int(config.get("target_epoch", 0))
+        self.log_dir = config.get("log_dir", log_dir)
+        self._active = False
+
+    def setup(self, config: Optional[Dict[str, Any]]) -> "Profiler":
+        """(reference: profile.py:30-44 reads the Profile config section)"""
+        if config:
+            self.enabled = bool(config.get("enable", 0))
+            self.target_epoch = int(config.get("target_epoch", self.target_epoch))
+            self.log_dir = config.get("log_dir", self.log_dir)
+        return self
+
+    def epoch_begin(self, epoch: int) -> None:
+        if self.enabled and epoch == self.target_epoch and not self._active:
+            import jax
+
+            os.makedirs(self.log_dir, exist_ok=True)
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+
+    def epoch_end(self, epoch: int) -> None:
+        if self._active and epoch == self.target_epoch:
+            import jax
+
+            jax.effects_barrier()
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+
+
+def peak_memory_stats() -> Dict[str, float]:
+    """Per-device peak memory in bytes (reference prints
+    torch.cuda.max_memory_allocated, distributed.py:354-361)."""
+    import jax
+
+    out = {}
+    for d in jax.local_devices():
+        stats = d.memory_stats() or {}
+        out[str(d)] = float(stats.get("peak_bytes_in_use", 0))
+    return out
+
+
+def print_peak_memory(verbosity: int = 1, prefix: str = "") -> None:
+    if verbosity <= 0:
+        return
+    for dev, peak in peak_memory_stats().items():
+        print(f"{prefix}{dev}: peak memory {peak / 2**20:.1f} MiB")

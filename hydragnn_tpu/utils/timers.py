@@ -1,0 +1,88 @@
+"""Coarse phase timers with cross-process reduction
+(reference: hydragnn/utils/profiling_and_tracing/time_utils.py:22-138).
+
+``Timer`` accumulates wall time per named phase in class-level state; on
+``print_timers`` the per-process totals are reduced to min/avg/max across
+JAX processes (the torch.distributed all-reduce of the reference,
+time_utils.py:48-83) — serial fallback when running single-process.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import numpy as np
+
+
+class Timer:
+    _totals: Dict[str, float] = {}
+    _counts: Dict[str, int] = {}
+
+    def __init__(self, name: str):
+        self.name = name
+        self._start = None
+
+    def start(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def stop(self) -> float:
+        assert self._start is not None, f"Timer {self.name} not started"
+        dt = time.perf_counter() - self._start
+        Timer._totals[self.name] = Timer._totals.get(self.name, 0.0) + dt
+        Timer._counts[self.name] = Timer._counts.get(self.name, 0) + 1
+        self._start = None
+        return dt
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._totals.clear()
+        cls._counts.clear()
+
+    @classmethod
+    def totals(cls) -> Dict[str, float]:
+        return dict(cls._totals)
+
+
+def _reduce_across_processes(values: np.ndarray) -> Dict[str, np.ndarray]:
+    """min/avg/max over JAX processes; identity when single-process."""
+    import jax
+
+    if jax.process_count() == 1:
+        return {"min": values, "avg": values, "max": values}
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(values)  # [P, K]
+    return {
+        "min": gathered.min(axis=0),
+        "avg": gathered.mean(axis=0),
+        "max": gathered.max(axis=0),
+    }
+
+
+def print_timers(verbosity: int = 1) -> None:
+    """(reference: time_utils.py:95-138; table printed on process 0 only,
+    after the collective reduction every process must join)"""
+    if verbosity <= 0 or not Timer._totals:
+        return
+    import jax
+
+    names = sorted(Timer._totals)
+    vals = np.asarray([Timer._totals[n] for n in names])
+    red = _reduce_across_processes(vals)
+    if jax.process_index() != 0:
+        return
+    width = max(len(n) for n in names)
+    print(f"{'timer'.ljust(width)}  count  min(s)      avg(s)      max(s)")
+    for i, n in enumerate(names):
+        print(
+            f"{n.ljust(width)}  {Timer._counts[n]:<5d}"
+            f"  {red['min'][i]:<10.4f}  {red['avg'][i]:<10.4f}  {red['max'][i]:<10.4f}"
+        )

@@ -1,0 +1,158 @@
+"""Region tracer facade — the GPTL/Score-P analog
+(reference: hydragnn/utils/profiling_and_tracing/tracer.py:35-167).
+
+The reference fans ``tr.start/stop`` out to GPTL and Score-P C libraries with
+optional ``torch.cuda.synchronize`` + MPI barrier per span. Here the backend
+is (a) an in-process accumulator (count/total/min/max per region) and (b)
+optional ``jax.profiler.TraceAnnotation`` so regions appear in xprof/
+TensorBoard device traces. ``sync=True`` drains the async JAX dispatch queue
+(``jax.effects_barrier``) before timestamping — the device-sync analog of the
+reference's ``cudasync=True`` (tracer.py:106-127) — controlled globally by
+``HYDRAGNN_TRACE_LEVEL`` exactly like the reference's train-loop spans
+(train_validate_test.py:477-498).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import time
+from typing import Dict, Optional
+
+_enabled = False
+_regions: Dict[str, Dict[str, float]] = {}
+_open: Dict[str, float] = {}
+_annotations: Dict[str, object] = {}
+
+
+def _sync_devices() -> None:
+    """Wait for all previously enqueued device work: enqueue a trivial op on
+    each local device's (FIFO) compute stream and block on it —
+    ``jax.effects_barrier`` alone would skip pure computations."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        for d in jax.local_devices():
+            jax.block_until_ready(jax.device_put(jnp.zeros(()), d) + 1)
+    except Exception:
+        pass
+
+
+def _trace_level() -> int:
+    return int(os.getenv("HYDRAGNN_TRACE_LEVEL", "0"))
+
+
+def initialize() -> None:
+    """(reference: tracer.py:35-60 registers GPTL/Score-P if importable)"""
+    reset()
+
+
+def reset() -> None:
+    _regions.clear()
+    _open.clear()
+    _annotations.clear()
+
+
+def enable() -> None:
+    global _enabled
+    _enabled = True
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def start(name: str, sync: Optional[bool] = None) -> None:
+    """Open a region (reference: tracer.py:106-116)."""
+    if not _enabled:
+        return
+    if sync is None:
+        sync = _trace_level() > 0
+    if sync:
+        _sync_devices()
+    try:
+        import jax
+
+        ann = jax.profiler.TraceAnnotation(name)
+        ann.__enter__()
+        _annotations[name] = ann
+    except Exception:
+        pass
+    _open[name] = time.perf_counter()
+
+
+def stop(name: str, sync: Optional[bool] = None) -> None:
+    """Close a region and accumulate (reference: tracer.py:118-127)."""
+    if not _enabled or name not in _open:
+        return
+    if sync is None:
+        sync = _trace_level() > 0
+    if sync:
+        _sync_devices()
+    dt = time.perf_counter() - _open.pop(name)
+    ann = _annotations.pop(name, None)
+    if ann is not None:
+        try:
+            ann.__exit__(None, None, None)
+        except Exception:
+            pass
+    rec = _regions.setdefault(
+        name, {"count": 0.0, "total": 0.0, "min": float("inf"), "max": 0.0}
+    )
+    rec["count"] += 1
+    rec["total"] += dt
+    rec["min"] = min(rec["min"], dt)
+    rec["max"] = max(rec["max"], dt)
+
+
+@contextlib.contextmanager
+def timer(name: str, sync: Optional[bool] = None):
+    """(reference: tracer.py:158-167)"""
+    start(name, sync)
+    try:
+        yield
+    finally:
+        stop(name, sync)
+
+
+def profile(name: str):
+    """Decorator opening a region around the call (reference: tracer.py:145-155)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with timer(name):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+def get_regions() -> Dict[str, Dict[str, float]]:
+    return {k: dict(v) for k, v in _regions.items()}
+
+
+def print_report(prefix: str = "") -> None:
+    """Per-process region dump (the GPTL ``pr_file`` analog,
+    reference: examples/multibranch/train.py:507-514)."""
+    if not _regions:
+        return
+    width = max(len(k) for k in _regions)
+    print(f"{prefix}{'region'.ljust(width)}  count     total(s)    avg(s)      max(s)")
+    for name, r in sorted(_regions.items()):
+        avg = r["total"] / max(r["count"], 1)
+        print(
+            f"{prefix}{name.ljust(width)}  {int(r['count']):<8d}"
+            f"  {r['total']:<10.4f}  {avg:<10.4f}  {r['max']:<10.4f}"
+        )
+
+
+def save_report(path: str) -> None:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(get_regions(), f, indent=2)

@@ -1,0 +1,69 @@
+"""SLURM walltime-aware early stop
+(reference: hydragnn/utils/distributed/distributed.py:380-419; train-loop hook
+train_validate_test.py:257-264, config key ``CheckRemainingTime``).
+
+Process 0 queries ``squeue -h -j $SLURM_JOB_ID -o %L`` for the remaining
+allocation, compares it to the last epoch's duration (x a safety factor) and
+the decision is broadcast to all JAX processes so every rank stops at the
+same epoch.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+
+def parse_slurm_remaining(text: str) -> Optional[float]:
+    """'[D-]HH:MM:SS' / 'MM:SS' -> seconds; None when unparseable
+    (e.g. 'INVALID', 'UNLIMITED')."""
+    text = text.strip()
+    if not text or not text[0].isdigit():
+        return None
+    days = 0
+    if "-" in text:
+        d, text = text.split("-", 1)
+        days = int(d)
+    parts = [int(p) for p in text.split(":")]
+    while len(parts) < 3:
+        parts.insert(0, 0)
+    h, m, s = parts[-3:]
+    return float(((days * 24 + h) * 60 + m) * 60 + s)
+
+
+def query_remaining_seconds() -> Optional[float]:
+    job = os.getenv("SLURM_JOB_ID")
+    if not job:
+        return None
+    try:
+        out = subprocess.run(
+            ["squeue", "-h", "-j", job, "-o", "%L"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+        ).stdout
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return parse_slurm_remaining(out)
+
+
+def should_stop(last_epoch_seconds: float, safety_factor: float = 2.0) -> bool:
+    """True when the remaining walltime cannot fit another epoch
+    (reference: check_remaining, distributed.py:394-419)."""
+    import jax
+
+    decision = 0.0
+    if jax.process_index() == 0:
+        remaining = query_remaining_seconds()
+        if remaining is not None and remaining < safety_factor * last_epoch_seconds:
+            decision = 1.0
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        decision = float(
+            multihost_utils.broadcast_one_to_all(np.asarray(decision))
+        )
+    return decision > 0.5

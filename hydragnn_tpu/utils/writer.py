@@ -1,0 +1,59 @@
+"""Training-metric writer: TensorBoard scalars + JSONL fallback
+(reference: rank-0 SummaryWriter, hydragnn/utils/model/model.py:109-115;
+per-epoch scalars train_validate_test.py:198-205).
+
+Writes every scalar to ``scalars.jsonl`` always (machine-readable, no deps)
+and mirrors to a torch ``SummaryWriter`` when tensorboard is importable.
+Process 0 only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+
+class MetricsWriter:
+    def __init__(self, log_name: str, path: str = "./logs"):
+        try:
+            import jax
+
+            self._rank0 = jax.process_index() == 0
+        except Exception:
+            self._rank0 = True
+        self.run_dir = os.path.join(path, log_name)
+        self._jsonl = None
+        self._tb = None
+        if not self._rank0:
+            return
+        os.makedirs(self.run_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(self.run_dir, "scalars.jsonl"), "a")
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir=self.run_dir)
+        except Exception:
+            self._tb = None
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        if self._jsonl is None:
+            return
+        self._jsonl.write(
+            json.dumps({"tag": tag, "value": float(value), "step": int(step)}) + "\n"
+        )
+        self._jsonl.flush()
+        if self._tb is not None:
+            self._tb.add_scalar(tag, float(value), step)
+
+    def add_scalars(self, scalars: Dict[str, float], step: int) -> None:
+        for tag, v in scalars.items():
+            self.add_scalar(tag, v, step)
+
+    def close(self) -> None:
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None

@@ -1,0 +1,115 @@
+"""Observability subsystem tests (SURVEY §5.1, §5.5): tracer regions, phase
+timers, metric writer, walltime parsing, peak-memory stats, run logging."""
+
+import json
+import os
+import time
+
+import numpy as np
+
+from hydragnn_tpu.utils import (
+    MetricsWriter,
+    Profiler,
+    Timer,
+    parse_slurm_remaining,
+    peak_memory_stats,
+    print_timers,
+    setup_log,
+    tracer as tr,
+)
+
+
+def pytest_tracer_accumulates_regions():
+    tr.reset()
+    tr.enable()
+    for _ in range(3):
+        with tr.timer("region_a"):
+            time.sleep(0.002)
+    tr.start("region_b")
+    tr.stop("region_b")
+    regions = tr.get_regions()
+    assert regions["region_a"]["count"] == 3
+    assert regions["region_a"]["total"] >= 0.006
+    assert regions["region_a"]["max"] >= regions["region_a"]["min"]
+    assert regions["region_b"]["count"] == 1
+    tr.disable()
+    tr.start("after_disable")
+    tr.stop("after_disable")
+    assert "after_disable" not in tr.get_regions()
+    tr.reset()
+
+
+def pytest_tracer_profile_decorator_and_report(tmp_path, capsys):
+    tr.reset()
+    tr.enable()
+
+    @tr.profile("decorated")
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2
+    tr.print_report()
+    out = capsys.readouterr().out
+    assert "decorated" in out
+    path = str(tmp_path / "trace.json")
+    tr.save_report(path)
+    assert json.load(open(path))["decorated"]["count"] == 1
+    tr.reset()
+
+
+def pytest_timer_totals_and_print(capsys):
+    Timer.reset()
+    with Timer("phase_x"):
+        time.sleep(0.002)
+    t = Timer("phase_x").start()
+    time.sleep(0.002)
+    t.stop()
+    assert Timer.totals()["phase_x"] >= 0.004
+    print_timers(1)
+    out = capsys.readouterr().out
+    assert "phase_x" in out
+    Timer.reset()
+
+
+def pytest_metrics_writer_jsonl(tmp_path):
+    w = MetricsWriter("run_x", path=str(tmp_path))
+    w.add_scalar("loss/train", 1.5, 0)
+    w.add_scalars({"loss/val": 2.5, "lr": 0.01}, 1)
+    w.close()
+    lines = [
+        json.loads(l)
+        for l in open(tmp_path / "run_x" / "scalars.jsonl")
+    ]
+    tags = {(l["tag"], l["step"]): l["value"] for l in lines}
+    assert tags[("loss/train", 0)] == 1.5
+    assert tags[("loss/val", 1)] == 2.5
+
+
+def pytest_walltime_parser():
+    assert parse_slurm_remaining("1-02:03:04") == 93784.0
+    assert parse_slurm_remaining("02:03:04") == 7384.0
+    assert parse_slurm_remaining("3:04") == 184.0
+    assert parse_slurm_remaining("INVALID") is None
+    assert parse_slurm_remaining("") is None
+    assert parse_slurm_remaining("UNLIMITED") is None
+
+
+def pytest_peak_memory_and_profiler(tmp_path):
+    stats = peak_memory_stats()
+    assert len(stats) >= 1
+    p = Profiler({"enable": 1, "target_epoch": 0, "log_dir": str(tmp_path / "prof")})
+    p.epoch_begin(0)
+    import jax.numpy as jnp
+
+    _ = (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+    p.epoch_end(0)
+    # xprof trace directory created and non-empty
+    found = [f for _, _, fs in os.walk(tmp_path / "prof") for f in fs]
+    assert found, "no profiler trace written"
+
+
+def pytest_setup_log_writes_file(tmp_path):
+    logger = setup_log("logrun", path=str(tmp_path))
+    logger.info("hello-world")
+    text = open(tmp_path / "logrun" / "run.log").read()
+    assert "hello-world" in text
