@@ -65,6 +65,12 @@ def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
         from .data.datasets import SimplePickleDataset
 
         return list(SimplePickleDataset(ds["path"]["total"], ds["name"]))
+    if fmt == "columnar":
+        from .data.columnar import ColumnarDataset
+
+        return list(
+            ColumnarDataset(ds["path"]["total"], mode=ds.get("mode", "mmap"))
+        )
     raise ValueError(f"unknown Dataset.format {fmt!r}")
 
 

@@ -8,10 +8,20 @@ from .pipeline import (
     select_input_columns,
     split_dataset,
 )
+from .columnar import ColumnarDataset, ColumnarWriter
+from .datasets import AbstractBaseDataset, SimplePickleDataset, SimplePickleWriter
+from .ddstore import DDStore, DistDataset
 from .lappe import add_dataset_pe, add_graph_pe, laplacian_pe
 from .synthetic import deterministic_graph_dataset, lennard_jones_dataset
 
 __all__ = [
+    "AbstractBaseDataset",
+    "ColumnarDataset",
+    "ColumnarWriter",
+    "DDStore",
+    "DistDataset",
+    "SimplePickleDataset",
+    "SimplePickleWriter",
     "Graph",
     "GraphBatch",
     "PadSpec",

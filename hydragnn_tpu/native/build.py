@@ -1,0 +1,46 @@
+"""Build the native C++ components with g++ (cached .so next to the source).
+
+The reference links against prebuilt C++ libraries (ADIOS2, pyddstore,
+GPTL — SURVEY §2.3); here the native runtime pieces are compiled on first
+use from the sources in this directory.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_lock = threading.Lock()
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_library(name: str = "ddstore") -> str:
+    """Compile ``<name>.cpp`` -> ``_<name>.so`` if missing/stale; return path."""
+    src = os.path.join(_HERE, f"{name}.cpp")
+    out = os.path.join(_HERE, f"_{name}.so")
+    with _lock:
+        if (
+            os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)
+        ):
+            return out
+        cmd = [
+            "g++",
+            "-O3",
+            "-std=c++17",
+            "-shared",
+            "-fPIC",
+            "-o",
+            out,
+            src,
+            "-lrt",
+            "-pthread",
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise RuntimeError("g++ not available to build native library") from e
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+    return out

@@ -1,0 +1,187 @@
+// Shared-memory sample store — the TPU-host analog of ORNL's DDStore
+// (reference: pyddstore used by hydragnn/utils/datasets/distdataset.py:1-183;
+// a C++/MPI one-sided remote-memory object store holding datasets larger
+// than a single process can). On TPU pods every host feeds only its own
+// devices and datasets are sharded per host (data/columnar.py), so the
+// cross-node MPI RMA plane collapses to an intra-host concern: many loader
+// processes sharing one pinned copy of the samples. This store provides
+// that: a POSIX shared-memory arena with a slot table indexed directly by
+// sample id (ids are dense dataset indices, so lookup is O(1)), atomic
+// space reservation with no partial-failure leaks, and epoch_begin/end
+// fences kept API-compatible with DDStore's windowed access
+// (train loop brackets: train_validate_test.py:480-563).
+//
+// Build: g++ -O3 -shared -fPIC -o _ddstore.so ddstore.cpp -lrt
+// (driven by hydragnn_tpu/native/build.py; loaded via ctypes).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x44445354'2d545055ULL;  // "DDST-TPU"
+
+struct Header {
+  uint64_t magic;
+  int64_t capacity;    // payload bytes
+  int64_t max_items;   // slot-table size; valid ids are [0, max_items)
+  std::atomic<int64_t> bump;       // next free payload offset
+  std::atomic<int64_t> num_items;  // successfully published items
+  std::atomic<int64_t> epoch;      // epoch_begin/end counter
+};
+
+struct Slot {
+  std::atomic<int64_t> state;  // 0 = empty, 1 = published (set last)
+  int64_t offset;
+  int64_t length;
+};
+
+struct Store {
+  Header* hdr;
+  Slot* slots;
+  char* payload;
+  size_t mapped;
+  int fd;
+  char name[256];
+};
+
+size_t total_bytes(int64_t capacity, int64_t max_items) {
+  return sizeof(Header) + sizeof(Slot) * (size_t)max_items + (size_t)capacity;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Remove a named store (explicit cleanup of stale segments from crashed
+// runs). Returns 0 on success.
+int dds_unlink(const char* name) { return shm_unlink(name); }
+
+// Create (create=1, fails with nullptr when the name already exists — the
+// caller decides whether to dds_unlink a stale segment first) or attach
+// (create=0) a named store. Returns nullptr on failure.
+void* dds_open(const char* name, int64_t capacity, int64_t max_items,
+               int create) {
+  int fd;
+  size_t bytes = 0;
+  if (create) {
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;  // EEXIST: never clobber silently
+    bytes = total_bytes(capacity, max_items);
+    if (ftruncate(fd, (off_t)bytes) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0) {
+      close(fd);
+      return nullptr;
+    }
+    bytes = (size_t)st.st_size;
+  }
+  void* base = mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (base == MAP_FAILED) {
+    close(fd);
+    return nullptr;
+  }
+  Store* s = new Store;
+  s->hdr = (Header*)base;
+  s->mapped = bytes;
+  s->fd = fd;
+  strncpy(s->name, name, sizeof(s->name) - 1);
+  s->name[sizeof(s->name) - 1] = 0;
+  if (create) {
+    s->hdr->capacity = capacity;
+    s->hdr->max_items = max_items;
+    s->hdr->bump.store(0);
+    s->hdr->num_items.store(0);
+    s->hdr->epoch.store(0);
+  } else if (s->hdr->magic != kMagic) {
+    munmap(base, bytes);
+    close(fd);
+    delete s;
+    return nullptr;
+  }
+  s->slots = (Slot*)((char*)base + sizeof(Header));
+  s->payload =
+      (char*)base + sizeof(Header) + sizeof(Slot) * (size_t)s->hdr->max_items;
+  if (create) {
+    for (int64_t i = 0; i < max_items; ++i) s->slots[i].state.store(0);
+    s->hdr->magic = kMagic;  // publish header last: attachers check magic
+  }
+  return s;
+}
+
+// Store a blob under id in [0, max_items). Returns 0 on success, -1 when the
+// payload arena is full, -2 when id is out of range, -3 when id is already
+// published. Space is reserved with a CAS loop so failed puts leak nothing.
+int dds_put(void* h, int64_t id, const void* buf, int64_t nbytes) {
+  Store* s = (Store*)h;
+  if (id < 0 || id >= s->hdr->max_items) return -2;
+  if (s->slots[id].state.load()) return -3;
+  int64_t off = s->hdr->bump.load();
+  do {
+    if (off + nbytes > s->hdr->capacity) return -1;
+  } while (!s->hdr->bump.compare_exchange_weak(off, off + nbytes));
+  memcpy(s->payload + off, buf, (size_t)nbytes);
+  s->slots[id].offset = off;
+  s->slots[id].length = nbytes;
+  s->slots[id].state.store(1);  // publish last
+  s->hdr->num_items.fetch_add(1);
+  return 0;
+}
+
+// Size of blob id, or -1 when absent.
+int64_t dds_get_size(void* h, int64_t id) {
+  Store* s = (Store*)h;
+  if (id < 0 || id >= s->hdr->max_items || !s->slots[id].state.load())
+    return -1;
+  return s->slots[id].length;
+}
+
+// One-sided fetch (the DDStore get analog, distdataset.py:159-183).
+// Copies at most nbytes into out; returns bytes copied or -1 when absent.
+int64_t dds_get(void* h, int64_t id, void* out, int64_t nbytes) {
+  Store* s = (Store*)h;
+  if (id < 0 || id >= s->hdr->max_items || !s->slots[id].state.load())
+    return -1;
+  int64_t len = s->slots[id].length < nbytes ? s->slots[id].length : nbytes;
+  memcpy(out, s->payload + s->slots[id].offset, (size_t)len);
+  return len;
+}
+
+int64_t dds_count(void* h) { return ((Store*)h)->hdr->num_items.load(); }
+
+int64_t dds_max_items(void* h) { return ((Store*)h)->hdr->max_items; }
+
+int64_t dds_used_bytes(void* h) { return ((Store*)h)->hdr->bump.load(); }
+
+// Epoch window fences (DDStore epoch_begin/end semantics; here the store is
+// always resident so these only bump a counter readers can observe).
+void dds_epoch_begin(void* h) { ((Store*)h)->hdr->epoch.fetch_add(1); }
+void dds_epoch_end(void* h) {}
+
+int64_t dds_epoch(void* h) { return ((Store*)h)->hdr->epoch.load(); }
+
+void dds_close(void* h, int unlink_shm) {
+  Store* s = (Store*)h;
+  char name[256];
+  strncpy(name, s->name, sizeof(name));
+  munmap((void*)s->hdr, s->mapped);
+  close(s->fd);
+  if (unlink_shm) shm_unlink(name);
+  delete s;
+}
+
+}  // extern "C"
