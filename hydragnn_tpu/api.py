@@ -68,8 +68,35 @@ def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
     if fmt == "columnar":
         from .data.columnar import ColumnarDataset
 
+        # samples are materialized as host Graphs for the split/normalize
+        # pipeline; mmap/shmem modes bound the *raw array* residency during
+        # the read, not the materialized working set
         return list(
             ColumnarDataset(ds["path"]["total"], mode=ds.get("mode", "mmap"))
+        )
+    if fmt in ("LSMS", "XYZ", "CFG"):
+        from .data.raw import finalize_graphs, load_raw_dataset
+
+        arch = config["NeuralNetwork"]["Architecture"]
+        kwargs = {}
+        if fmt == "LSMS":
+            nf = ds.get("node_features", {})
+            gf = ds.get("graph_features", {})
+            if "column_index" in nf:
+                kwargs["node_feature_cols"] = nf["column_index"]
+                kwargs["node_feature_dims"] = nf["dim"]
+            if "column_index" in gf:
+                kwargs["graph_feature_cols"] = gf["column_index"]
+                kwargs["graph_feature_dims"] = gf["dim"]
+            kwargs["charge_density_correction"] = ds.get(
+                "charge_density_correction", False
+            )
+        raw = load_raw_dataset(ds["path"]["total"], fmt, **kwargs)
+        return finalize_graphs(
+            raw,
+            radius=arch.get("radius", 5.0) or 5.0,
+            max_neighbours=arch.get("max_neighbours"),
+            periodic=arch.get("periodic_boundary_conditions", False),
         )
     raise ValueError(f"unknown Dataset.format {fmt!r}")
 
@@ -191,6 +218,23 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     finally:
         writer.close()
     save_model(state, log_name)
+    if config.get("Visualization", {}).get("create_plots"):
+        # parity/error/history plots (reference: train_validate_test.py:100-126,
+        # 268-313 drives postprocess/visualizer.py)
+        from .postprocess import Visualizer
+
+        _, _, preds, trues = test_model(
+            model,
+            state,
+            test_loader,
+            compute_grad_energy=config["NeuralNetwork"]["Training"].get(
+                "compute_grad_energy", False
+            ),
+        )
+        viz = Visualizer(log_name)
+        viz.create_scatter_plots(trues, preds)
+        viz.create_error_histograms(trues, preds)
+        viz.plot_history(hist)
     print_timers(verbosity)
     return model, state, hist, config, loaders, mm
 

@@ -11,6 +11,14 @@ from .pipeline import (
 from .columnar import ColumnarDataset, ColumnarWriter
 from .datasets import AbstractBaseDataset, SimplePickleDataset, SimplePickleWriter
 from .ddstore import DDStore, DistDataset
+from .descriptors import atomic_descriptors, smiles_to_graph
+from .raw import (
+    finalize_graphs,
+    load_cfg_file,
+    load_lsms_file,
+    load_raw_dataset,
+    load_xyz_file,
+)
 from .lappe import add_dataset_pe, add_graph_pe, laplacian_pe
 from .synthetic import deterministic_graph_dataset, lennard_jones_dataset
 
@@ -39,4 +47,11 @@ __all__ = [
     "split_dataset",
     "deterministic_graph_dataset",
     "lennard_jones_dataset",
+    "atomic_descriptors",
+    "smiles_to_graph",
+    "finalize_graphs",
+    "load_cfg_file",
+    "load_lsms_file",
+    "load_raw_dataset",
+    "load_xyz_file",
 ]

@@ -233,10 +233,11 @@ def _shared_memory_array(path: str, dtype: np.dtype, shape: tuple) -> np.ndarray
     import time
     from multiprocessing import shared_memory
 
-    name = (
-        "hgnn_"
-        + hashlib.sha1(os.path.abspath(path).encode()).hexdigest()[:24]
-    )
+    # key the segment on path + size + mtime so a regenerated dataset gets
+    # a fresh segment instead of serving (or crashing on) a stale one
+    st = os.stat(path)
+    key = f"{os.path.abspath(path)}:{st.st_size}:{st.st_mtime_ns}"
+    name = "hgnn_" + hashlib.sha1(key.encode()).hexdigest()[:24]
     nbytes = max(int(np.prod(shape)) * dtype.itemsize, 1)
     if name in _SHM_CACHE:
         shm = _SHM_CACHE[name]
@@ -254,7 +255,9 @@ def _shared_memory_array(path: str, dtype: np.dtype, shape: tuple) -> np.ndarray
             while shm.buf[nbytes] != 1:
                 if time.monotonic() > deadline:
                     raise TimeoutError(
-                        f"shared segment {name!r} never became ready"
+                        f"shared segment {name!r} never became ready — a "
+                        "creator likely crashed mid-copy; remove "
+                        f"/dev/shm/{name} and retry"
                     )
                 time.sleep(0.05)
         _SHM_CACHE[name] = shm

@@ -168,20 +168,43 @@ class DistDataset(AbstractBaseDataset):
         import time
 
         populate = dataset is not None if populate is None else populate
-        self.store = DDStore(
-            name,
-            capacity_bytes=capacity_bytes,
-            max_items=max_items,
-            create=populate,
-            overwrite=overwrite,
-        )
+        if populate:
+            self.store = DDStore(
+                name,
+                capacity_bytes=capacity_bytes,
+                max_items=max_items,
+                create=True,
+                overwrite=overwrite,
+            )
+        else:
+            # retry attachment too: a concurrently-starting creator may not
+            # have finished dds_open yet (half-initialized header rejected)
+            deadline = time.monotonic() + attach_timeout_s
+            while True:
+                try:
+                    self.store = DDStore(
+                        name,
+                        capacity_bytes=capacity_bytes,
+                        max_items=max_items,
+                        create=False,
+                    )
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.05)
         manifest_id = self.store.max_items - 1
         if populate:
             assert dataset is not None
-            n = 0
+            n = len(dataset)
+            if n > manifest_id:
+                raise ValueError(
+                    f"dataset has {n} samples but the store holds at most "
+                    f"{manifest_id} (the last slot is the manifest); raise "
+                    "max_items"
+                )
             for i, g in enumerate(dataset):
                 self.store.put(i, _pack_graph(g))
-                n += 1
             self.store.put(manifest_id, pickle.dumps({"len": n}))
             self._len = n
         else:

@@ -1,0 +1,266 @@
+"""Raw simulation-output loaders: LSMS, XYZ and AtomEye/extended CFG
+(reference: hydragnn/preprocess/lsms_raw_dataset_loader.py:38-106,
+cfg_raw_dataset_loader.py:30-106, utils/datasets/{lsmsdataset,cfgdataset,
+xyzdataset}.py). The reference parses with ASE where available; here the
+three text formats are parsed directly (ASE is not in the image) and edges
+are built afterwards with the package's own radius-graph machinery.
+
+All loaders return edge-less ``Graph`` records (senders/receivers empty);
+``finalize_graphs`` attaches radius-graph connectivity (open or PBC), which
+is the reference's serialized-loader step
+(hydragnn/preprocess/serialized_dataset_loader.py:134-150).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .graph import Graph
+from .neighbors import radius_graph, radius_graph_pbc
+
+ATOMIC_SYMBOLS = (
+    "H He Li Be B C N O F Ne Na Mg Al Si P S Cl Ar K Ca Sc Ti V Cr Mn Fe Co "
+    "Ni Cu Zn Ga Ge As Se Br Kr Rb Sr Y Zr Nb Mo Tc Ru Rh Pd Ag Cd In Sn Sb "
+    "Te I Xe Cs Ba La Ce Pr Nd Pm Sm Eu Gd Tb Dy Ho Er Tm Yb Lu Hf Ta W Re "
+    "Os Ir Pt Au Hg Tl Pb Bi Po At Rn Fr Ra Ac Th Pa U Np Pu Am Cm Bk Cf Es "
+    "Fm Md No Lr Rf Db Sg Bh Hs Mt Ds Rg Cn Nh Fl Mc Lv Ts Og"
+).split()
+SYMBOL_TO_Z = {s: i + 1 for i, s in enumerate(ATOMIC_SYMBOLS)}
+
+
+def _empty_edges():
+    return np.zeros((0,), np.int32), np.zeros((0,), np.int32)
+
+
+def load_lsms_file(
+    path: str,
+    node_feature_dims: Sequence[int] = (1, 1),
+    node_feature_cols: Sequence[int] = (0, 5),
+    graph_feature_dims: Sequence[int] = (1,),
+    graph_feature_cols: Sequence[int] = (0,),
+    charge_density_correction: bool = False,
+) -> Graph:
+    """One LSMS text sample: line 0 = graph features, then one line per atom
+    with columns [feat0, feat1, x, y, z, feat5, ...]
+    (reference: lsms_raw_dataset_loader.py:38-88).
+
+    ``charge_density_correction=True`` subtracts the proton count from the
+    second selected feature (reference: :89-106) — only enable it when the
+    selected columns are exactly [protons, charge density]. Atomic numbers
+    ``z`` are taken from the first selected column only when that column is
+    the proton column (index 0); otherwise ``z`` is left unset.
+    """
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    head = lines[0].split()
+    g_feature = []
+    for dim, col in zip(graph_feature_dims, graph_feature_cols):
+        for icomp in range(dim):
+            g_feature.append(float(head[col + icomp]))
+    pos = []
+    feats = []
+    for line in lines[1:]:
+        if not line.strip():
+            continue
+        tok = line.split()
+        pos.append([float(tok[2]), float(tok[3]), float(tok[4])])
+        row = []
+        for dim, col in zip(node_feature_dims, node_feature_cols):
+            for icomp in range(dim):
+                row.append(float(tok[col + icomp]))
+        feats.append(row)
+    x = np.asarray(feats, np.float32)
+    if charge_density_correction:
+        assert x.shape[1] >= 2, (
+            "charge_density_correction needs [protons, charge] columns"
+        )
+        # charge density -> net charge (reference: :89-106)
+        x[:, 1] = x[:, 1] - x[:, 0]
+    senders, receivers = _empty_edges()
+    z = x[:, 0].astype(np.int32) if node_feature_cols[0] == 0 else None
+    return Graph(
+        x=x,
+        pos=np.asarray(pos, np.float32),
+        senders=senders,
+        receivers=receivers,
+        graph_y=np.asarray(g_feature, np.float32),
+        z=z,
+    )
+
+
+def load_xyz_file(path: str) -> Graph:
+    """Standard (ext)XYZ: natoms, comment (graph features as floats if
+    parseable), then ``Symbol x y z [extra...]`` rows
+    (reference: utils/datasets/xyzdataset.py)."""
+    with open(path, encoding="utf-8") as f:
+        lines = [l for l in f.read().splitlines()]
+    n = int(lines[0].split()[0])
+    comment = lines[1].split()
+    # treat the comment as graph targets only when it is purely numeric —
+    # extxyz metadata lines (Lattice=..., Properties=...) are not targets
+    graph_y = []
+    try:
+        graph_y = [float(tok) for tok in comment]
+    except ValueError:
+        graph_y = []
+    zs, pos, extras = [], [], []
+    for line in lines[2 : 2 + n]:
+        tok = line.split()
+        sym = tok[0]
+        z = SYMBOL_TO_Z.get(sym)
+        if z is None:
+            z = int(float(sym))
+        zs.append(z)
+        pos.append([float(tok[1]), float(tok[2]), float(tok[3])])
+        extras.append([float(t) for t in tok[4:]])
+    x = np.asarray(zs, np.float32)[:, None]
+    if extras and extras[0]:
+        x = np.concatenate([x, np.asarray(extras, np.float32)], axis=1)
+    senders, receivers = _empty_edges()
+    return Graph(
+        x=x,
+        pos=np.asarray(pos, np.float32),
+        senders=senders,
+        receivers=receivers,
+        graph_y=np.asarray(graph_y, np.float32) if graph_y else None,
+        z=np.asarray(zs, np.int32),
+    )
+
+
+def load_cfg_file(path: str) -> Graph:
+    """AtomEye extended CFG: ``Number of particles``, ``H0(i,j)`` cell matrix,
+    ``entry_count``, optional ``auxiliary[k]`` names, then per-species blocks
+    of (mass line, symbol line, one scaled-coordinate row per atom)
+    (reference reads it via ASE, cfg_raw_dataset_loader.py:66-106; node
+    features follow the reference layout [Z, mass, aux...]). A sibling
+    ``<name>.bulk`` file supplies graph features when present."""
+    h0 = np.zeros((3, 3))
+    n = None
+    entry_count = 3
+    aux_count = 0
+    rows: List[List[float]] = []
+    masses: List[float] = []
+    zs: List[int] = []
+    cur_mass = None
+    cur_z = None
+    with open(path, encoding="utf-8") as f:
+        for raw_line in f:
+            line = raw_line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if line.startswith("Number of particles"):
+                n = int(line.split("=")[1])
+            elif line.startswith("H0("):
+                ij = line[3:6]
+                i, j = int(ij[0]) - 1, int(ij[2]) - 1
+                h0[i, j] = float(line.split("=")[1].split()[0])
+            elif line.startswith("entry_count"):
+                entry_count = int(line.split("=")[1])
+                aux_count = entry_count - 3
+            elif line.startswith((".NO_VELOCITY", "A =", "R =", "auxiliary")):
+                continue
+            else:
+                tok = line.split()
+                if len(tok) == 1 and tok[0] in SYMBOL_TO_Z:
+                    cur_z = SYMBOL_TO_Z[tok[0]]
+                elif len(tok) == 1:
+                    cur_mass = float(tok[0])
+                elif len(tok) >= 3:
+                    assert cur_z is not None, "species symbol missing in CFG"
+                    rows.append([float(t) for t in tok[: 3 + aux_count]])
+                    masses.append(cur_mass if cur_mass is not None else 0.0)
+                    zs.append(cur_z)
+    assert n is not None and len(rows) == n, f"CFG parse failed for {path}"
+    scaled = np.asarray(rows, np.float64)
+    pos = scaled[:, :3] @ h0  # scaled -> cartesian
+    aux = scaled[:, 3:]
+    x = np.concatenate(
+        [
+            np.asarray(zs, np.float32)[:, None],
+            np.asarray(masses, np.float32)[:, None],
+            aux.astype(np.float32),
+        ],
+        axis=1,
+    )
+    graph_y = None
+    bulk = os.path.splitext(path)[0] + ".bulk"
+    if os.path.exists(bulk):
+        graph_y = np.asarray(
+            [float(open(bulk, encoding="utf-8").readline().split()[0])], np.float32
+        )
+    senders, receivers = _empty_edges()
+    return Graph(
+        x=x,
+        pos=pos.astype(np.float32),
+        senders=senders,
+        receivers=receivers,
+        graph_y=graph_y,
+        z=np.asarray(zs, np.int32),
+        cell=h0.astype(np.float32),
+    )
+
+
+_LOADERS = {"LSMS": load_lsms_file, "XYZ": load_xyz_file, "CFG": load_cfg_file}
+# LSMS files carry no conventional extension, so every regular file is tried
+_EXTS = {"XYZ": (".xyz", ".extxyz"), "CFG": (".cfg",)}
+
+
+def load_raw_dataset(path: str, fmt: str, **loader_kwargs) -> List[Graph]:
+    """Load every raw file under ``path`` with the format's parser
+    (reference: AbstractRawDataLoader.load_raw_data,
+    preprocess/raw_dataset_loader.py:29-277). Raises when a directory mixes
+    samples with and without graph targets — downstream normalization cannot
+    represent that."""
+    fmt = fmt.upper()
+    loader = _LOADERS[fmt]
+    graphs = []
+    for name in sorted(os.listdir(path)):
+        full = os.path.join(path, name)
+        if not os.path.isfile(full) or name.endswith(".bulk"):
+            continue
+        if fmt in _EXTS and not name.lower().endswith(_EXTS[fmt]):
+            continue
+        graphs.append(loader(full, **loader_kwargs))
+    with_y = [g.graph_y is not None for g in graphs]
+    if any(with_y) and not all(with_y):
+        missing = [i for i, w in enumerate(with_y) if not w][:5]
+        raise ValueError(
+            f"{sum(not w for w in with_y)} of {len(graphs)} raw samples have "
+            f"no graph targets (first sample indices {missing}); provide "
+            "targets for every file or none"
+        )
+    return graphs
+
+
+def finalize_graphs(
+    graphs: Sequence[Graph],
+    radius: float,
+    max_neighbours: Optional[int] = None,
+    periodic: bool = False,
+) -> List[Graph]:
+    """Attach radius-graph edges (open or PBC) to edge-less raw graphs
+    (reference: serialized_dataset_loader.py:134-150)."""
+    out = []
+    for g in graphs:
+        if periodic:
+            assert g.cell is not None, "PBC radius graph needs a cell"
+            senders, receivers, shifts = radius_graph_pbc(
+                g.pos, g.cell, radius, max_neighbours or 1000
+            )
+            out.append(
+                dataclasses.replace(
+                    g, senders=senders, receivers=receivers, edge_shifts=shifts
+                )
+            )
+        else:
+            senders, receivers = radius_graph(
+                g.pos, radius, max_neighbours or 1000
+            )
+            out.append(
+                dataclasses.replace(g, senders=senders, receivers=receivers)
+            )
+    return out

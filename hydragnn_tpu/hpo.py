@@ -1,0 +1,170 @@
+"""Hyperparameter optimization glue
+(reference: hydragnn/utils/hpo/deephyper.py:5-177, which carves SLURM node
+lists into per-trial srun launch commands for DeepHyper/Optuna studies).
+
+TPU-native equivalents:
+- ``parse_slurm_nodelist`` — generic SLURM nodelist expansion (the
+  reference hardcodes frontier/perlmutter name shapes; this parses any
+  ``prefix[a-b,c,...]`` pattern);
+- ``suggest_config`` / ``run_hpo`` — an in-process search driver over the
+  JSON config (random search by default, Optuna TPE when importable) whose
+  objective is the best validation loss from ``run_training``. Each TPU
+  trial runs on the local chips; multi-host studies launch one driver per
+  pod slice with a distinct ``trial_offset``.
+
+Search-space spec: a dict mapping a "/"-separated config path to either a
+list of categorical choices or a ("loguniform"|"uniform", low, high) tuple,
+e.g. ``{"NeuralNetwork/Architecture/hidden_dim": [32, 64, 128],
+"NeuralNetwork/Training/Optimizer/learning_rate":
+("loguniform", 1e-4, 1e-1)}``.
+"""
+
+from __future__ import annotations
+
+import copy
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def parse_slurm_nodelist(node_list: str) -> List[str]:
+    """Expand 'prefix[0001-0003,0007]' (possibly mixed with bare hostnames)
+    to explicit host names (reference: read_node_list, deephyper.py:13-45)."""
+    # split on top-level commas only (commas inside [...] separate ranges)
+    items: List[str] = []
+    depth = 0
+    cur = ""
+    for ch in node_list:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            items.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        items.append(cur)
+
+    out: List[str] = []
+    for item in items:
+        item = item.strip()
+        if not item:
+            continue
+        m = re.fullmatch(r"([^\[\]]+)\[([^\]]+)\]", item)
+        if m is None:
+            out.append(item)
+            continue
+        prefix, body = m.group(1), m.group(2)
+        for part in body.split(","):
+            if "-" in part:
+                a, b = part.split("-")
+                width = len(a)
+                for i in range(int(a), int(b) + 1):
+                    out.append(f"{prefix}{str(i).zfill(width)}")
+            else:
+                out.append(f"{prefix}{part}")
+    return out
+
+
+def _set_path(config: Dict[str, Any], path: str, value: Any) -> None:
+    keys = path.split("/")
+    node = config
+    for k in keys[:-1]:
+        node = node[k]
+    node[keys[-1]] = value
+
+
+def suggest_config(
+    base_config: Dict[str, Any],
+    search_space: Dict[str, Any],
+    rng: np.random.Generator,
+) -> Dict[str, Any]:
+    """One random draw from the search space applied to a config copy."""
+    config = copy.deepcopy(base_config)
+    for path, spec in search_space.items():
+        if isinstance(spec, (list, tuple)) and spec and spec[0] in (
+            "uniform",
+            "loguniform",
+        ):
+            kind, lo, hi = spec
+            if kind == "loguniform":
+                value = float(math.exp(rng.uniform(math.log(lo), math.log(hi))))
+            else:
+                value = float(rng.uniform(lo, hi))
+        else:
+            value = spec[int(rng.integers(len(spec)))]
+        _set_path(config, path, value)
+    return config
+
+
+def run_hpo(
+    base_config: Dict[str, Any],
+    search_space: Dict[str, Any],
+    num_trials: int = 10,
+    seed: int = 0,
+    trial_offset: int = 0,
+    objective: Optional[Callable[[Dict[str, Any]], float]] = None,
+    use_optuna: Optional[bool] = None,
+) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Run an HPO study; returns (best_config, trial records).
+
+    ``objective(config) -> loss`` defaults to training the config with the
+    public API and reporting the best validation loss. With Optuna available
+    (and not disabled), the sampler is TPE; otherwise pure random search.
+    """
+    if objective is None:
+
+        def objective(config: Dict[str, Any]) -> float:
+            from .api import run_training
+
+            _, _, hist, *_ = run_training(config)
+            return float(np.min(hist["val"]))
+
+    if use_optuna is None:
+        try:
+            import optuna  # noqa: F401
+
+            use_optuna = True
+        except ImportError:
+            use_optuna = False
+
+    trials: List[Dict[str, Any]] = []
+
+    if use_optuna:
+        import optuna
+
+        def optuna_objective(trial):
+            config = copy.deepcopy(base_config)
+            for path, spec in search_space.items():
+                name = path.replace("/", ".")
+                if isinstance(spec, (list, tuple)) and spec and spec[0] in (
+                    "uniform",
+                    "loguniform",
+                ):
+                    kind, lo, hi = spec
+                    value = trial.suggest_float(name, lo, hi, log=kind == "loguniform")
+                else:
+                    value = trial.suggest_categorical(name, list(spec))
+                _set_path(config, path, value)
+            loss = objective(config)
+            trials.append({"config": config, "loss": loss})
+            return loss
+
+        study = optuna.create_study(
+            sampler=optuna.samplers.TPESampler(seed=seed + trial_offset)
+        )
+        study.optimize(optuna_objective, n_trials=num_trials)
+        best = min(trials, key=lambda t: t["loss"])
+        return best["config"], trials
+
+    rng = np.random.default_rng(seed + trial_offset)
+    for _ in range(num_trials):
+        config = suggest_config(base_config, search_space, rng)
+        loss = objective(config)
+        trials.append({"config": config, "loss": loss})
+    best = min(trials, key=lambda t: t["loss"])
+    return best["config"], trials

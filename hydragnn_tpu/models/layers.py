@@ -69,11 +69,15 @@ class MaskedBatchNorm(nn.Module):
         ra_var = self.variable(
             "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
         )
+        ra_count = self.variable(
+            "batch_stats", "count", lambda: jnp.zeros((), jnp.float32)
+        )
         scale = self.param("scale", nn.initializers.ones, (features,))
         bias = self.param("bias", nn.initializers.zeros, (features,))
 
         if train:
             if mask is None:
+                n = jnp.asarray(float(x.shape[0]), x.dtype)
                 mean = jnp.mean(x, axis=0)
                 var = jnp.var(x, axis=0)
             else:
@@ -82,8 +86,17 @@ class MaskedBatchNorm(nn.Module):
                 mean = jnp.sum(x * m, axis=0) / n
                 var = jnp.sum(((x - mean) ** 2) * m, axis=0) / n
             if not self.is_initializing():
-                ra_mean.value = self.momentum * ra_mean.value + (1 - self.momentum) * mean
-                ra_var.value = self.momentum * ra_var.value + (1 - self.momentum) * var
+                # count-weighted EMA: a remainder batch with few real rows
+                # moves the running stats proportionally less (plain
+                # equal-weight EMA lets one tiny ragged batch poison eval
+                # statistics; for constant batch sizes this reduces exactly
+                # to the torch BatchNorm1d update the reference relies on)
+                c_new = self.momentum * ra_count.value + (1 - self.momentum) * n
+                w_old = self.momentum * ra_count.value / jnp.maximum(c_new, 1e-8)
+                w_new = 1.0 - w_old
+                ra_mean.value = w_old * ra_mean.value + w_new * mean
+                ra_var.value = w_old * ra_var.value + w_new * var
+                ra_count.value = c_new
         else:
             mean, var = ra_mean.value, ra_var.value
 

@@ -1,0 +1,13 @@
+from .postprocess import (
+    output_denormalize,
+    unscale_features_by_num_nodes,
+    unscale_features_by_num_nodes_config,
+)
+from .visualizer import Visualizer
+
+__all__ = [
+    "Visualizer",
+    "output_denormalize",
+    "unscale_features_by_num_nodes",
+    "unscale_features_by_num_nodes_config",
+]

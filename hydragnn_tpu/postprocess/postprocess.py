@@ -1,0 +1,55 @@
+"""Prediction denormalization
+(reference: hydragnn/postprocess/postprocess.py:13-55), vectorized over the
+per-head arrays the tpu test path produces instead of the reference's
+nested python loops."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def output_denormalize(y_minmax, true_values, predicted_values):
+    """Undo per-head min-max scaling in place on lists/arrays of per-head
+    values (reference: postprocess.py:13-26)."""
+    for ihead in range(len(y_minmax)):
+        ymin = np.asarray(y_minmax[ihead][0])
+        ymax = np.asarray(y_minmax[ihead][1])
+        predicted_values[ihead] = (
+            np.asarray(predicted_values[ihead]) * (ymax - ymin) + ymin
+        )
+        true_values[ihead] = np.asarray(true_values[ihead]) * (ymax - ymin) + ymin
+    return true_values, predicted_values
+
+
+def unscale_features_by_num_nodes(
+    datasets_list: List, scaled_index_list: Sequence[int], nodes_num_list
+):
+    """Multiply per-graph-scaled heads back by node counts
+    (reference: postprocess.py:29-40)."""
+    nodes = np.asarray(nodes_num_list)
+    for dataset in datasets_list:
+        for scaled_index in scaled_index_list:
+            vals = np.asarray(dataset[scaled_index])
+            dataset[scaled_index] = vals * nodes.reshape(
+                (-1,) + (1,) * (vals.ndim - 1)
+            )
+    return datasets_list
+
+
+def unscale_features_by_num_nodes_config(config, datasets_list, nodes_num_list):
+    """(reference: postprocess.py:43-55)"""
+    var_config = config["NeuralNetwork"]["Variables_of_interest"]
+    output_names = var_config["output_names"]
+    scaled_feature_index = [
+        i for i in range(len(output_names)) if "_scaled_num_nodes" in output_names[i]
+    ]
+    if scaled_feature_index:
+        assert var_config[
+            "denormalize_output"
+        ], "Cannot unscale features without 'denormalize_output'"
+        datasets_list = unscale_features_by_num_nodes(
+            datasets_list, scaled_feature_index, nodes_num_list
+        )
+    return datasets_list

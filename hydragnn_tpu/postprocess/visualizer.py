@@ -1,0 +1,83 @@
+"""Result visualization: parity plots, error histograms, loss history
+(reference: hydragnn/postprocess/visualizer.py:24-742, trimmed to the plots
+the train loop actually drives: create_scatter_plots, plot_history,
+create_error_histograms). matplotlib is imported lazily so headless
+installs without it still train."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def _plt():
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    return plt
+
+
+class Visualizer:
+    """(reference: Visualizer, visualizer.py:24-120 constructor semantics:
+    one instance per run directory, plots written under <dir>/plots)."""
+
+    def __init__(self, model_with_config_name: str):
+        self.outdir = os.path.join("logs", model_with_config_name, "plots")
+        os.makedirs(self.outdir, exist_ok=True)
+
+    def create_scatter_plots(
+        self,
+        trues: Dict[str, np.ndarray],
+        preds: Dict[str, np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+    ) -> None:
+        """Per-head parity scatter (reference: visualizer.py scatter plots)."""
+        plt = _plt()
+        names = output_names or list(trues)
+        for name in names:
+            t = np.asarray(trues[name]).ravel()
+            p = np.asarray(preds[name]).ravel()
+            fig, ax = plt.subplots(figsize=(4, 4))
+            ax.scatter(t, p, s=4, alpha=0.5)
+            lo, hi = float(min(t.min(), p.min())), float(max(t.max(), p.max()))
+            ax.plot([lo, hi], [lo, hi], "k--", linewidth=1)
+            ax.set_xlabel(f"true {name}")
+            ax.set_ylabel(f"predicted {name}")
+            rmse = float(np.sqrt(np.mean((t - p) ** 2)))
+            ax.set_title(f"{name} (RMSE {rmse:.4f})")
+            fig.tight_layout()
+            fig.savefig(os.path.join(self.outdir, f"parity_{name}.png"), dpi=120)
+            plt.close(fig)
+
+    def create_error_histograms(
+        self, trues: Dict[str, np.ndarray], preds: Dict[str, np.ndarray]
+    ) -> None:
+        plt = _plt()
+        for name in trues:
+            err = (np.asarray(preds[name]) - np.asarray(trues[name])).ravel()
+            fig, ax = plt.subplots(figsize=(4, 3))
+            ax.hist(err, bins=40)
+            ax.set_xlabel(f"{name} error")
+            ax.set_ylabel("count")
+            fig.tight_layout()
+            fig.savefig(os.path.join(self.outdir, f"error_hist_{name}.png"), dpi=120)
+            plt.close(fig)
+
+    def plot_history(self, hist: Dict[str, Sequence[float]]) -> None:
+        """Loss curves (reference: visualizer.py plot_history)."""
+        plt = _plt()
+        fig, ax = plt.subplots(figsize=(5, 3.5))
+        for key in ("train", "val", "test"):
+            if key in hist and len(hist[key]):
+                ax.plot(hist[key], label=key)
+        ax.set_xlabel("epoch")
+        ax.set_ylabel("loss")
+        ax.set_yscale("log")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "history.png"), dpi=120)
+        plt.close(fig)

@@ -1,0 +1,86 @@
+"""Example smoke tests: run each example driver as a subprocess
+(reference: tests/test_examples.py:18-79 smoke-runs qm9/md17 examples), plus
+the HPO search driver."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(rel, *args, timeout=420):
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, rel), *args],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=_REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    return out.stdout
+
+
+def pytest_example_synthetic():
+    out = _run_example(
+        "examples/synthetic/train.py", "--mpnn_type", "GIN", "--num_epoch", "3"
+    )
+    assert "test loss" in out
+
+
+def pytest_example_lennard_jones():
+    out = _run_example(
+        "examples/LennardJones/LennardJones.py",
+        "--mpnn_type", "SchNet", "--num_epoch", "5", "--num_configs", "32",
+    )
+    assert "force corr" in out
+
+
+def pytest_example_multibranch():
+    out = _run_example("examples/multibranch/train.py", "--epochs", "2")
+    assert "epoch 1:" in out
+
+
+def pytest_hpo_random_search():
+    from hydragnn_tpu.hpo import parse_slurm_nodelist, run_hpo, suggest_config
+
+    assert parse_slurm_nodelist("frontier[00001-00003,00007]") == [
+        "frontier00001",
+        "frontier00002",
+        "frontier00003",
+        "frontier00007",
+    ]
+    assert parse_slurm_nodelist("nid001,nid002") == ["nid001", "nid002"]
+    assert parse_slurm_nodelist("nid001,nid[003-004]") == [
+        "nid001",
+        "nid003",
+        "nid004",
+    ]
+
+    base = {"NeuralNetwork": {"Architecture": {"hidden_dim": 8},
+                              "Training": {"Optimizer": {"learning_rate": 1e-3}}}}
+    space = {
+        "NeuralNetwork/Architecture/hidden_dim": [8, 16, 32],
+        "NeuralNetwork/Training/Optimizer/learning_rate": ("loguniform", 1e-4, 1e-1),
+    }
+    rng = np.random.default_rng(0)
+    cfg = suggest_config(base, space, rng)
+    assert cfg["NeuralNetwork"]["Architecture"]["hidden_dim"] in (8, 16, 32)
+    lr = cfg["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"]
+    assert 1e-4 <= lr <= 1e-1
+
+    # objective: distance of the drawn hyperparams to a target optimum
+    def objective(config):
+        a = config["NeuralNetwork"]["Architecture"]["hidden_dim"]
+        lr = config["NeuralNetwork"]["Training"]["Optimizer"]["learning_rate"]
+        return abs(a - 16) + abs(np.log10(lr) + 2)
+
+    best, trials = run_hpo(
+        base, space, num_trials=25, seed=1, objective=objective, use_optuna=False
+    )
+    assert len(trials) == 25
+    assert best["NeuralNetwork"]["Architecture"]["hidden_dim"] == 16

@@ -1,0 +1,213 @@
+"""Raw-format loaders, atomic descriptors, postprocess, and visualizer tests
+(reference: tests/test_graphs.py:91-126 exercises the LSMS raw path;
+tests/test_atomicdescriptors.py; postprocess driven by run_prediction)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data import (
+    atomic_descriptors,
+    finalize_graphs,
+    load_cfg_file,
+    load_lsms_file,
+    load_raw_dataset,
+    load_xyz_file,
+)
+from hydragnn_tpu.postprocess import (
+    Visualizer,
+    output_denormalize,
+    unscale_features_by_num_nodes,
+)
+
+
+def _write_lsms(path):
+    # graph feature 12.5; atoms: [Z, charge, x, y, z, extra]
+    lines = ["12.5 0.0 0.0\n"]
+    for i, (z, q) in enumerate([(26, 26.2), (27, 26.9), (26, 26.1)]):
+        lines.append(f"{z} {q} {i*1.0} 0.0 0.0 {0.1*i}\n")
+    with open(path, "w") as f:
+        f.writelines(lines)
+
+
+def pytest_lsms_loader(tmp_path):
+    p = str(tmp_path / "sample0")
+    _write_lsms(p)
+    g = load_lsms_file(
+        p,
+        node_feature_dims=(1, 1),
+        node_feature_cols=(0, 1),
+        graph_feature_dims=(1,),
+        graph_feature_cols=(0,),
+        charge_density_correction=True,
+    )
+    assert g.num_nodes == 3
+    np.testing.assert_allclose(g.graph_y, [12.5])
+    # charge-density correction: column1 - column0
+    np.testing.assert_allclose(g.x[:, 1], [0.2, -0.1, 0.1], atol=1e-5)
+    np.testing.assert_array_equal(g.z, [26, 27, 26])
+    assert g.num_edges == 0
+
+
+def pytest_xyz_loader(tmp_path):
+    p = str(tmp_path / "mol.xyz")
+    with open(p, "w") as f:
+        f.write("3\n-7.5\nO 0.0 0.0 0.0\nH 0.96 0.0 0.0\nH -0.24 0.93 0.0\n")
+    g = load_xyz_file(p)
+    assert g.num_nodes == 3
+    np.testing.assert_array_equal(g.z, [8, 1, 1])
+    np.testing.assert_allclose(g.graph_y, [-7.5])
+
+
+def pytest_cfg_loader(tmp_path):
+    p = str(tmp_path / "crystal.cfg")
+    with open(p, "w") as f:
+        f.write(
+            "Number of particles = 2\n"
+            "A = 1.0 Angstrom\n"
+            "H0(1,1) = 4.0 A\nH0(1,2) = 0.0 A\nH0(1,3) = 0.0 A\n"
+            "H0(2,1) = 0.0 A\nH0(2,2) = 4.0 A\nH0(2,3) = 0.0 A\n"
+            "H0(3,1) = 0.0 A\nH0(3,2) = 0.0 A\nH0(3,3) = 4.0 A\n"
+            ".NO_VELOCITY.\n"
+            "entry_count = 4\n"
+            "auxiliary[0] = c_peratom\n"
+            "55.845\nFe\n"
+            "0.0 0.0 0.0 1.5\n"
+            "0.5 0.5 0.5 2.5\n"
+        )
+    with open(str(tmp_path / "crystal.bulk"), "w") as f:
+        f.write("170.0\n")
+    g = load_cfg_file(p)
+    assert g.num_nodes == 2
+    np.testing.assert_array_equal(g.z, [26, 26])
+    np.testing.assert_allclose(g.pos[1], [2.0, 2.0, 2.0])
+    np.testing.assert_allclose(g.x[:, 1], [55.845, 55.845])  # mass column
+    np.testing.assert_allclose(g.x[:, 2], [1.5, 2.5])  # aux column
+    np.testing.assert_allclose(g.graph_y, [170.0])
+    assert g.cell is not None
+
+
+def pytest_raw_dir_and_finalize(tmp_path):
+    for i in range(3):
+        _write_lsms(str(tmp_path / f"s{i}"))
+    graphs = load_raw_dataset(
+        str(tmp_path),
+        "LSMS",
+        node_feature_dims=(1, 1),
+        node_feature_cols=(0, 1),
+        graph_feature_dims=(1,),
+        graph_feature_cols=(0,),
+    )
+    assert len(graphs) == 3
+    done = finalize_graphs(graphs, radius=1.5)
+    assert all(g.num_edges > 0 for g in done)
+    # PBC variant via the CFG sample's cell
+    with_cell = [g for g in done]
+
+
+def pytest_lsms_through_training(tmp_path, monkeypatch):
+    """Raw LSMS dir -> radius graph -> training via the public API
+    (reference path: tests/test_graphs.py:91-126)."""
+    raw_dir = tmp_path / "lsms_raw"
+    raw_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(24):
+        lines = []
+        n = 4
+        pos = rng.uniform(0, 2.0, (n, 3))
+        zs = rng.integers(1, 4, n)
+        total = float(zs.sum())
+        lines.append(f"{total} 0 0\n")
+        for j in range(n):
+            lines.append(
+                f"{zs[j]} 0.0 {pos[j,0]} {pos[j,1]} {pos[j,2]} 0.0\n"
+            )
+        with open(raw_dir / f"cfg{i}", "w") as f:
+            f.writelines(lines)
+    monkeypatch.chdir(tmp_path)
+    from hydragnn_tpu.api import run_training
+
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "lsms_unit",
+            "format": "LSMS",
+            "path": {"total": str(raw_dir)},
+            "node_features": {"dim": [1, 1], "column_index": [0, 1]},
+            "graph_features": {"dim": [1], "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.5,
+                "max_neighbours": 10,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["total_z"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": 4,
+                "batch_size": 8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+        "Visualization": {"create_plots": True},
+    }
+    model, state, hist, config, loaders, mm = run_training(config)
+    assert hist["train"][-1] < hist["train"][0]
+    from hydragnn_tpu.config import get_log_name_config
+
+    plots = tmp_path / "logs" / get_log_name_config(config) / "plots"
+    assert (plots / "parity_total_z.png").exists()
+    assert (plots / "history.png").exists()
+
+
+def pytest_atomic_descriptors():
+    d = atomic_descriptors([1, 6, 26])
+    assert d.shape == (3, 4 + 8 + 18)
+    # hydrogen: period 1 one-hot, group 1 one-hot
+    assert d[0, 4] == 1.0 and d[0, 12] == 1.0
+    # carbon: period 2, group 14
+    assert d[1, 5] == 1.0 and d[1, 12 + 13] == 1.0
+    scalars = atomic_descriptors([26], one_hot_period_group=False)
+    assert scalars.shape == (1, 4)
+    assert 0 < scalars[0, 0] <= 1
+
+
+def pytest_output_denormalize_and_unscale():
+    y_minmax = [(2.0, 10.0)]
+    trues = [np.asarray([[0.0], [1.0]])]
+    preds = [np.asarray([[0.5], [0.25]])]
+    t, p = output_denormalize(y_minmax, trues, preds)
+    np.testing.assert_allclose(t[0], [[2.0], [10.0]])
+    np.testing.assert_allclose(p[0], [[6.0], [4.0]])
+    ds = unscale_features_by_num_nodes([[np.asarray([1.0, 2.0])]], [0], [4.0, 8.0])
+    np.testing.assert_allclose(ds[0][0], [4.0, 16.0])
+
+
+def pytest_visualizer_outputs(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    viz = Visualizer("vizrun")
+    trues = {"e": np.linspace(0, 1, 20)}
+    preds = {"e": np.linspace(0, 1, 20) + 0.01}
+    viz.create_scatter_plots(trues, preds)
+    viz.create_error_histograms(trues, preds)
+    viz.plot_history({"train": [3.0, 2.0, 1.0], "val": [3.1, 2.2, 1.4]})
+    base = tmp_path / "logs" / "vizrun" / "plots"
+    for f in ("parity_e.png", "error_hist_e.png", "history.png"):
+        assert (base / f).exists()
