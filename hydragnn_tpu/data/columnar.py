@@ -112,8 +112,10 @@ class ColumnarWriter:
             np.save(os.path.join(self.shard_dir, f"{safe}.counts.npy"), counts)
             meta["fields"][k] = {"dtype": dtype.str, "suffix": suffix}
         for name, v in self._attrs.items():
+            # np.generic covers numpy scalars (e.g. np.float32 minmax stats),
+            # which json.dump rejects just like ndarrays
             meta["attrs"][name] = (
-                v.tolist() if isinstance(v, np.ndarray) else v
+                v.tolist() if isinstance(v, (np.ndarray, np.generic)) else v
             )
         with open(os.path.join(self.shard_dir, "meta.json"), "w") as f:
             json.dump(meta, f)
@@ -135,6 +137,7 @@ class ColumnarDataset(AbstractBaseDataset):
         assert mode in ("mmap", "preload", "shmem"), mode
         self.path = path
         self.mode = mode
+        self._shm_names: List[str] = []
         shards = sorted(
             d for d in os.listdir(path) if d.startswith("shard")
         )
@@ -173,7 +176,41 @@ class ColumnarDataset(AbstractBaseDataset):
             return np.memmap(path, dtype=dtype, mode="r", shape=shape)
         if self.mode == "preload":
             return np.fromfile(path, dtype=dtype).reshape(shape)
-        return _shared_memory_array(path, dtype, shape)
+        arr, name = _shared_memory_array(path, dtype, shape)
+        self._shm_names.append(name)
+        return arr
+
+    def close(self, unlink: bool = False) -> None:
+        """Release the shared-memory segments backing this dataset (mirrors
+        DDStore.close, data/ddstore.py). The creating process unlinks its
+        segments so regenerated datasets don't accumulate /dev/shm residency;
+        attachers only detach unless ``unlink=True`` forces removal. After
+        close, arrays previously returned by ``get`` must not be used."""
+        import gc
+
+        # the dataset's own field arrays are np.frombuffer views into shm.buf;
+        # they must be dropped before SharedMemory.close() or it raises
+        # BufferError ("cannot close: exported pointers exist")
+        self._shards = []
+        gc.collect()
+        for name in self._shm_names:
+            entry = _SHM_CACHE.pop(name, None)
+            if entry is None:
+                continue
+            shm, created = entry
+            # unlink first so /dev/shm residency is reclaimed even if a caller
+            # still holds array views (the OS frees the pages once every
+            # attached process exits)
+            if created or unlink:
+                try:
+                    shm.unlink()
+                except FileNotFoundError:
+                    pass
+            try:
+                shm.close()
+            except BufferError:
+                pass  # caller-held views keep the mapping alive until GC
+        self._shm_names = []
 
     def __len__(self) -> int:
         return self._total
@@ -216,10 +253,11 @@ class ColumnarDataset(AbstractBaseDataset):
         )
 
 
+# name -> (SharedMemory, created_by_this_process)
 _SHM_CACHE: Dict[str, Any] = {}
 
 
-def _shared_memory_array(path: str, dtype: np.dtype, shape: tuple) -> np.ndarray:
+def _shared_memory_array(path: str, dtype: np.dtype, shape: tuple):
     """One copy per host in POSIX shared memory, attached by name
     (reference: adiosdataset.py:594-644 SharedMemory + local-comm bcast).
 
@@ -240,17 +278,29 @@ def _shared_memory_array(path: str, dtype: np.dtype, shape: tuple) -> np.ndarray
     name = "hgnn_" + hashlib.sha1(key.encode()).hexdigest()[:24]
     nbytes = max(int(np.prod(shape)) * dtype.itemsize, 1)
     if name in _SHM_CACHE:
-        shm = _SHM_CACHE[name]
+        shm, _ = _SHM_CACHE[name]
     else:
+        created = False
         try:
             shm = shared_memory.SharedMemory(
                 name=name, create=True, size=nbytes + 1
             )
+            created = True
             data = np.fromfile(path, dtype=dtype).reshape(shape)
             np.frombuffer(shm.buf, dtype=dtype, count=data.size)[:] = data.ravel()
             shm.buf[nbytes] = 1  # readiness sentinel, set last
         except FileExistsError:
             shm = shared_memory.SharedMemory(name=name, create=False)
+            # CPython's resource tracker registers attached segments too (on
+            # <3.13) and would unlink them when *this* process exits, pulling
+            # the segment out from under sibling loader processes — only the
+            # creator should own cleanup
+            try:
+                from multiprocessing import resource_tracker
+
+                resource_tracker.unregister(shm._name, "shared_memory")
+            except Exception:
+                pass
             deadline = time.monotonic() + 300.0
             while shm.buf[nbytes] != 1:
                 if time.monotonic() > deadline:
@@ -260,7 +310,8 @@ def _shared_memory_array(path: str, dtype: np.dtype, shape: tuple) -> np.ndarray
                         f"/dev/shm/{name} and retry"
                     )
                 time.sleep(0.05)
-        _SHM_CACHE[name] = shm
-    return np.frombuffer(shm.buf, dtype=dtype, count=int(np.prod(shape))).reshape(
+        _SHM_CACHE[name] = (shm, created)
+    arr = np.frombuffer(shm.buf, dtype=dtype, count=int(np.prod(shape))).reshape(
         shape
     )
+    return arr, name

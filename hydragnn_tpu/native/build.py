@@ -2,11 +2,15 @@
 
 The reference links against prebuilt C++ libraries (ADIOS2, pyddstore,
 GPTL — SURVEY §2.3); here the native runtime pieces are compiled on first
-use from the sources in this directory.
+use from the sources in this directory. Rebuilds are keyed on a hash of the
+source content (stored in ``_<name>.so.hash``), not file mtimes — git does
+not preserve mtimes on checkout, so an mtime check could skip a needed
+rebuild or trust a foreign binary after a fresh clone.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 import subprocess
 import threading
@@ -15,16 +19,24 @@ _lock = threading.Lock()
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+def _source_digest(src: str) -> str:
+    h = hashlib.sha256()
+    with open(src, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
 def build_library(name: str = "ddstore") -> str:
     """Compile ``<name>.cpp`` -> ``_<name>.so`` if missing/stale; return path."""
     src = os.path.join(_HERE, f"{name}.cpp")
     out = os.path.join(_HERE, f"_{name}.so")
+    stamp = out + ".hash"
+    digest = _source_digest(src)
     with _lock:
-        if (
-            os.path.exists(out)
-            and os.path.getmtime(out) >= os.path.getmtime(src)
-        ):
-            return out
+        if os.path.exists(out) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    return out
         cmd = [
             "g++",
             "-O3",
@@ -43,4 +55,6 @@ def build_library(name: str = "ddstore") -> str:
             raise RuntimeError("g++ not available to build native library") from e
         except subprocess.CalledProcessError as e:
             raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+        with open(stamp, "w") as f:
+            f.write(digest)
     return out

@@ -29,7 +29,11 @@ namespace {
 constexpr uint64_t kMagic = 0x44445354'2d545055ULL;  // "DDST-TPU"
 
 struct Header {
-  uint64_t magic;
+  // Cross-process readiness flag: written last by the creator with release
+  // ordering, checked by attachers with acquire — guarantees capacity /
+  // max_items / slot states are visible once magic reads valid, even on
+  // weakly-ordered CPUs.
+  std::atomic<uint64_t> magic;
   int64_t capacity;    // payload bytes
   int64_t max_items;   // slot-table size; valid ids are [0, max_items)
   std::atomic<int64_t> bump;       // next free payload offset
@@ -107,7 +111,7 @@ void* dds_open(const char* name, int64_t capacity, int64_t max_items,
     s->hdr->bump.store(0);
     s->hdr->num_items.store(0);
     s->hdr->epoch.store(0);
-  } else if (s->hdr->magic != kMagic) {
+  } else if (s->hdr->magic.load(std::memory_order_acquire) != kMagic) {
     munmap(base, bytes);
     close(fd);
     delete s;
@@ -118,7 +122,8 @@ void* dds_open(const char* name, int64_t capacity, int64_t max_items,
       (char*)base + sizeof(Header) + sizeof(Slot) * (size_t)s->hdr->max_items;
   if (create) {
     for (int64_t i = 0; i < max_items; ++i) s->slots[i].state.store(0);
-    s->hdr->magic = kMagic;  // publish header last: attachers check magic
+    // publish header last: attachers acquire-check magic
+    s->hdr->magic.store(kMagic, std::memory_order_release);
   }
   return s;
 }

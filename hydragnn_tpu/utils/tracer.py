@@ -22,8 +22,11 @@ from typing import Dict, Optional
 
 _enabled = False
 _regions: Dict[str, Dict[str, float]] = {}
-_open: Dict[str, float] = {}
-_annotations: Dict[str, object] = {}
+# per-name stacks so re-entrant start(name) nests instead of overwriting
+_open: Dict[str, list] = {}
+# one global LIFO of (name, TraceAnnotation): xprof annotations are scoped
+# C++ objects and must exit in strict nesting order
+_ann_stack: list = []
 
 
 def _sync_devices() -> None:
@@ -52,7 +55,12 @@ def initialize() -> None:
 def reset() -> None:
     _regions.clear()
     _open.clear()
-    _annotations.clear()
+    while _ann_stack:
+        _, ann = _ann_stack.pop()
+        try:
+            ann.__exit__(None, None, None)
+        except Exception:
+            pass
 
 
 def enable() -> None:
@@ -78,27 +86,36 @@ def start(name: str, sync: Optional[bool] = None) -> None:
 
         ann = jax.profiler.TraceAnnotation(name)
         ann.__enter__()
-        _annotations[name] = ann
+        _ann_stack.append((name, ann))
     except Exception:
         pass
-    _open[name] = time.perf_counter()
+    _open.setdefault(name, []).append(time.perf_counter())
 
 
 def stop(name: str, sync: Optional[bool] = None) -> None:
     """Close a region and accumulate (reference: tracer.py:118-127)."""
-    if not _enabled or name not in _open:
+    if not _enabled or not _open.get(name):
         return
     if sync is None:
         sync = _trace_level() > 0
     if sync:
         _sync_devices()
-    dt = time.perf_counter() - _open.pop(name)
-    ann = _annotations.pop(name, None)
-    if ann is not None:
-        try:
-            ann.__exit__(None, None, None)
-        except Exception:
-            pass
+    starts = _open[name]
+    dt = time.perf_counter() - starts.pop()
+    if not starts:
+        del _open[name]
+    # unwind annotations in strict LIFO order: an out-of-nesting stop closes
+    # the inner (still-open) annotations early rather than corrupting the
+    # xprof span tree by exiting out of order
+    if any(n == name for n, _ in _ann_stack):
+        while _ann_stack:
+            top_name, ann = _ann_stack.pop()
+            try:
+                ann.__exit__(None, None, None)
+            except Exception:
+                pass
+            if top_name == name:
+                break
     rec = _regions.setdefault(
         name, {"count": 0.0, "total": 0.0, "min": float("inf"), "max": 0.0}
     )

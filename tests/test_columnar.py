@@ -55,6 +55,36 @@ def pytest_columnar_roundtrip(tmp_path, mode):
         _assert_graph_equal(graphs[i], ds[i])
 
 
+def pytest_columnar_shmem_close_unlinks(tmp_path):
+    """close() must release the creator's /dev/shm segments without raising
+    even though the dataset's own field arrays are views into the buffers
+    (ADVICE r1: shmem residency accumulation)."""
+    graphs = lennard_jones_dataset(6, seed=9)
+    ColumnarWriter(str(tmp_path / "ds")).add(graphs).save()
+    ds = ColumnarDataset(str(tmp_path / "ds"), mode="shmem")
+    _assert_graph_equal(graphs[0], ds[0])
+    names = list(ds._shm_names)
+    assert names
+    ds.close()
+    from hydragnn_tpu.data.columnar import _SHM_CACHE
+
+    for n in names:
+        assert n not in _SHM_CACHE
+        assert not os.path.exists(f"/dev/shm/{n}")
+    assert ds._shm_names == []
+
+
+def pytest_columnar_writer_numpy_scalar_attr(tmp_path):
+    """np.float32 scalar attrs must JSON-serialize (ADVICE r1 item 5)."""
+    graphs = lennard_jones_dataset(3, seed=10)
+    w = ColumnarWriter(str(tmp_path / "ds"))
+    w.add(graphs)
+    w.add_global("y_max", np.float32(3.5))
+    w.save()
+    ds = ColumnarDataset(str(tmp_path / "ds"))
+    assert ds.attrs["y_max"] == 3.5
+
+
 def pytest_columnar_multishard(tmp_path):
     """Per-process shard writes, merged read (the collective-write analog)."""
     graphs = deterministic_graph_dataset(10, seed=4)
