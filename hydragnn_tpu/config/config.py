@@ -121,8 +121,15 @@ def update_config(
     training = config["NeuralNetwork"]["Training"]
     var = config["NeuralNetwork"]["Variables_of_interest"]
 
-    graph_size_variable = check_if_graph_size_variable(trainset, valset, testset)
+    # one pass over the datasets: size variability + the static per-graph
+    # node bound (the latter lets GPS attention run per-graph dense
+    # [B, Nmax, C] instead of batch-wide [N, N] — reference semantics:
+    # to_dense_batch in hydragnn/globalAtt/gps.py:125-141)
+    sizes = {g.num_nodes for ds in (trainset, valset, testset) for g in ds}
+    env = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
+    graph_size_variable = bool(int(env)) if env is not None else len(sizes) > 1
     arch["graph_size_variable"] = graph_size_variable
+    arch["max_nodes_per_graph"] = max(sizes, default=0)
 
     # GPS defaults (reference: config_utils.py:40-47)
     arch.setdefault("global_attn_engine", None)
@@ -131,6 +138,8 @@ def update_config(
     arch.setdefault("pe_dim", 0)
 
     training.setdefault("compute_grad_energy", False)
+    # pad-spec bucketing (SURVEY §5.7): >1 builds a SpecLadder in the loaders
+    training.setdefault("num_pad_buckets", 4 if graph_size_variable else 1)
 
     # ---- outputs (reference: update_config_NN_outputs, config_utils.py:219-260)
     voi = voi_from_config(config)

@@ -75,6 +75,10 @@ class ModelConfig:
     global_attn_type: str = ""
     global_attn_heads: int = 0
     pe_dim: int = 0
+    # static bound on nodes per graph (data-derived); >0 lets GPS multihead
+    # attention use the per-graph dense [B, Nmax] layout instead of the
+    # batch-wide [N, N] mask
+    max_nodes_per_graph: int = 0
     dropout: float = 0.25
     # --- geometry / radial basis
     edge_dim: int = 0
@@ -185,6 +189,7 @@ class HydraModel(nn.Module):
                     heads=cfg.global_attn_heads,
                     dropout=cfg.dropout,
                     attn_type=cfg.global_attn_type or "multihead",
+                    max_nodes_per_graph=cfg.max_nodes_per_graph,
                 )
             convs.append(mpnn)
         self.graph_convs = convs

@@ -98,6 +98,7 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         global_attn_type=arch.get("global_attn_type") or "",
         global_attn_heads=int(arch.get("global_attn_heads") or 0),
         pe_dim=int(arch.get("pe_dim") or 0),
+        max_nodes_per_graph=int(arch.get("max_nodes_per_graph") or 0),
         edge_dim=int(arch.get("edge_dim") or 0),
         radius=arch.get("radius"),
         num_gaussians=arch.get("num_gaussians"),

@@ -4,13 +4,17 @@
 dense-batch global attention via ``to_dense_batch``/``key_padding_mask``, sum
 of local+global, 2-layer MLP block, three norms.)
 
-TPU re-design: ``to_dense_batch`` produces a data-dependent [B, Nmax, C]
-layout; here attention runs directly over the flat padded node array with a
-*same-graph* mask (node i attends to j iff node_graph[i] == node_graph[j] and
-both are real). Static shapes, one fused masked attention per batch instead of
-per-graph dense repacking. The ``performer`` variant exploits the
-block-diagonal structure exactly: linear attention's KV moments are
-segment-sums per graph, giving O(N) work with no [N, N] materialization.
+TPU re-design: attention is block-diagonal over graphs. With a static
+per-graph node bound ``max_nodes_per_graph`` (data-derived at config
+completion, like the reference's ``to_dense_batch`` Nmax) the multihead path
+gathers nodes into a per-graph dense ``[G, Nmax, C]`` layout inside jit —
+cost G*Nmax^2, matching the reference's per-graph dense attention
+(gps.py:125-141) — then scatters back to the flat node array. Shapes stay
+static because graphs are laid out contiguously by the batcher. Without the
+bound it falls back to one masked attention over the flat padded batch
+(cost N^2). The ``performer`` variant exploits the block-diagonal structure
+exactly: linear attention's KV moments are segment-sums per graph, giving
+O(N) work with no attention matrix at all.
 """
 
 from __future__ import annotations
@@ -28,11 +32,22 @@ from .layers import MaskedBatchNorm
 
 class MultiheadSelfAttention(nn.Module):
     """torch.nn.MultiheadAttention equivalent (in-proj QKV, out-proj),
-    masked to same-graph pairs."""
+    restricted to same-graph pairs.
+
+    With ``max_nodes_per_graph > 0`` the block-diagonal structure is
+    exploited: nodes are gathered per graph into [G, Nmax, H, d] and dense
+    attention runs within each graph — B*Nmax^2 work, the reference's
+    ``to_dense_batch`` semantics (gps.py:125-141). The gather/scatter indices
+    derive from ``node_graph`` alone (graphs are contiguous in the flat
+    layout), so everything stays static-shaped under jit. Numerics match the
+    flat-masked fallback exactly: every real node attends to exactly the real
+    nodes of its own graph either way.
+    """
 
     channels: int
     heads: int
     dropout: float = 0.0
+    max_nodes_per_graph: int = 0
 
     @nn.compact
     def __call__(self, x, batch: GraphBatch, train: bool = False):
@@ -42,21 +57,56 @@ class MultiheadSelfAttention(nn.Module):
         d = C // H
         qkv = nn.Dense(3 * C)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(-1, H, d)
-        k = k.reshape(-1, H, d)
-        v = v.reshape(-1, H, d)
-        # same-graph attention mask [N, N]
-        same = (batch.node_graph[:, None] == batch.node_graph[None, :]) & (
-            batch.node_mask[:, None] & batch.node_mask[None, :]
-        )
-        logits = jnp.einsum("ihd,jhd->hij", q, k) / jnp.sqrt(d).astype(x.dtype)
-        logits = jnp.where(same[None], logits, jnp.finfo(x.dtype).min)
-        probs = jax.nn.softmax(logits, axis=-1)
-        # rows with no valid key (padding nodes) produce uniform garbage;
-        # they are masked out downstream.
-        if self.dropout > 0 and train:
-            probs = nn.Dropout(self.dropout, deterministic=not train)(probs)
-        out = jnp.einsum("hij,jhd->ihd", probs, v).reshape(-1, C)
+        scale = jnp.sqrt(d).astype(x.dtype)
+
+        if self.max_nodes_per_graph > 0:
+            N = x.shape[0]
+            G = batch.num_graphs
+            Nmax = self.max_nodes_per_graph
+            counts = batch.nodes_per_graph  # [G]
+            starts = jnp.concatenate(
+                [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+            )
+            slot = jnp.arange(Nmax, dtype=jnp.int32)
+            valid = (slot[None, :] < counts[:, None]) & batch.graph_mask[:, None]
+            # flat node id of slot r in graph g; invalid slots hit the last
+            # node, which the pad spec guarantees is a padding node
+            idx = jnp.where(valid, starts[:, None] + slot[None, :], N - 1)
+            qg = q[idx].reshape(G, Nmax, H, d)
+            kg = k[idx].reshape(G, Nmax, H, d)
+            vg = v[idx].reshape(G, Nmax, H, d)
+            logits = jnp.einsum("gihd,gjhd->ghij", qg, kg) / scale
+            logits = jnp.where(
+                valid[:, None, None, :], logits, jnp.finfo(x.dtype).min
+            )
+            probs = jax.nn.softmax(logits, axis=-1)
+            if self.dropout > 0 and train:
+                probs = nn.Dropout(self.dropout, deterministic=not train)(probs)
+            og = jnp.einsum("ghij,gjhd->gihd", probs, vg).reshape(G * Nmax, C)
+            out = jnp.zeros((N, C), x.dtype).at[idx.reshape(-1)].add(
+                og * valid.reshape(-1, 1)
+            )
+            # a real graph larger than the static bound would be silently
+            # truncated (its overflow nodes never gathered); poison the output
+            # instead so the error surfaces as NaN loss, not wrong numbers
+            overflow = jnp.any((counts > Nmax) & batch.graph_mask)
+            out = jnp.where(overflow, jnp.nan, out)
+        else:
+            qf = q.reshape(-1, H, d)
+            kf = k.reshape(-1, H, d)
+            vf = v.reshape(-1, H, d)
+            # same-graph attention mask [N, N]
+            same = (batch.node_graph[:, None] == batch.node_graph[None, :]) & (
+                batch.node_mask[:, None] & batch.node_mask[None, :]
+            )
+            logits = jnp.einsum("ihd,jhd->hij", qf, kf) / scale
+            logits = jnp.where(same[None], logits, jnp.finfo(x.dtype).min)
+            probs = jax.nn.softmax(logits, axis=-1)
+            # rows with no valid key (padding nodes) produce uniform garbage;
+            # they are masked out downstream.
+            if self.dropout > 0 and train:
+                probs = nn.Dropout(self.dropout, deterministic=not train)(probs)
+            out = jnp.einsum("hij,jhd->ihd", probs, vf).reshape(-1, C)
         return nn.Dense(C)(out)
 
 
@@ -97,6 +147,7 @@ class GPSConv(nn.Module):
     heads: int = 1
     dropout: float = 0.0
     attn_type: str = "multihead"
+    max_nodes_per_graph: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch: GraphBatch, train: bool = False):
@@ -113,9 +164,12 @@ class GPSConv(nn.Module):
         if self.attn_type == "performer":
             h = PerformerSelfAttention(self.channels, self.heads)(inv, batch, train)
         elif self.attn_type == "multihead":
-            h = MultiheadSelfAttention(self.channels, self.heads, self.dropout)(
-                inv, batch, train
-            )
+            h = MultiheadSelfAttention(
+                self.channels,
+                self.heads,
+                self.dropout,
+                self.max_nodes_per_graph,
+            )(inv, batch, train)
         else:
             raise ValueError(f"attn_type {self.attn_type!r} not supported")
         h = nn.Dropout(self.dropout, deterministic=not train)(h)
