@@ -20,7 +20,7 @@ from .config import (
     update_config,
     voi_from_config,
 )
-from .data.graph import Graph, PadSpec
+from .data.graph import Graph, PadSpec, SpecLadder
 from .data.pipeline import (
     GraphLoader,
     MinMax,
@@ -141,11 +141,18 @@ def prepare_data(
         mm = None
 
     config = update_config(config, trainset, valset, testset)
-    batch_size = config["NeuralNetwork"]["Training"]["batch_size"]
-    spec = PadSpec.for_dataset(
+    training = config["NeuralNetwork"]["Training"]
+    arch = config["NeuralNetwork"]["Architecture"]
+    batch_size = training["batch_size"]
+    # bucketed pad specs when graph sizes vary (SURVEY §5.7): a few jit
+    # specializations instead of one worst-case padding for every batch
+    # (default set by update_config)
+    num_buckets = int(training["num_pad_buckets"])
+    spec = SpecLadder.for_dataset(
         trainset + valset + testset,
         batch_size,
-        with_triplets=config["NeuralNetwork"]["Architecture"]["mpnn_type"] == "DimeNet",
+        num_buckets=num_buckets,
+        with_triplets=arch["mpnn_type"] == "DimeNet",
     )
     train_loader = GraphLoader(trainset, batch_size, spec=spec, shuffle=True, seed=0)
     val_loader = GraphLoader(valset, batch_size, spec=spec, shuffle=False)

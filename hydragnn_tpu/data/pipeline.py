@@ -18,6 +18,8 @@ from .graph import (
     Graph,
     GraphBatch,
     PadSpec,
+    SpecLadder,
+    _triplet_count,
     batch_graphs,
     batch_graphs_np,
     graph_batch_from_np,
@@ -193,11 +195,17 @@ class GraphLoader:
         host_index: int = 0,
         drop_last: bool = False,
         num_shards: int = 1,
+        num_buckets: int = 1,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
         local indices, ready for ``shard_map`` data parallelism (``spec`` then
-        describes one shard of batch_size/num_shards graphs)."""
+        describes one shard of batch_size/num_shards graphs).
+
+        ``spec`` may be a single ``PadSpec`` (every batch padded to it) or a
+        ``SpecLadder`` (each batch padded to the smallest fitting level);
+        ``num_buckets`` > 1 with ``spec=None`` builds a ladder from the data
+        (the variable-graph-size strategy, SURVEY §5.7)."""
         self.graphs = graphs
         self.batch_size = batch_size
         self.num_shards = num_shards
@@ -207,7 +215,16 @@ class GraphLoader:
                 f"{num_shards} (each device takes batch_size/num_shards graphs)"
             )
         per_shard = max(batch_size // num_shards, 1)
-        self.spec = spec or PadSpec.for_dataset(graphs, per_shard)
+        if spec is None:
+            self.ladder = SpecLadder.for_dataset(
+                graphs, per_shard, num_buckets=num_buckets
+            )
+        elif isinstance(spec, SpecLadder):
+            self.ladder = spec
+        else:
+            self.ladder = SpecLadder((spec,))
+        # worst-case spec, kept for callers sizing buffers off loader.spec
+        self.spec = self.ladder.specs[-1]
         self.shuffle = shuffle
         self.seed = seed
         self.host_count = host_count
@@ -244,15 +261,25 @@ class GraphLoader:
 
     def _make(self, graphs: List[Graph]) -> GraphBatch:
         if self.num_shards == 1:
-            return batch_graphs(graphs, self.spec)
+            return batch_graphs(graphs, self.ladder.select_for(graphs))
         shards = [graphs[s :: self.num_shards] for s in range(self.num_shards)]
-        arrs = [batch_graphs_np(s, self.spec) for s in shards if s]
+        # one spec for the whole stacked batch: the smallest level fitting
+        # the largest shard (all shards must share static shapes)
+        with_trip = bool(self.spec.n_triplets)
+        spec = self.ladder.select(
+            max(sum(g.num_nodes for g in s) for s in shards if s),
+            max(sum(g.num_edges for g in s) for s in shards if s),
+            max((sum(_triplet_count(g) for g in s) for s in shards if s), default=0)
+            if with_trip
+            else 0,
+        )
+        arrs = [batch_graphs_np(s, spec) for s in shards if s]
         template = {k: np.zeros_like(v) for k, v in arrs[0].items()}
         # padding edges must still point at the dummy node slot
-        template["senders"] = np.full_like(arrs[0]["senders"], self.spec.n_nodes - 1)
+        template["senders"] = np.full_like(arrs[0]["senders"], spec.n_nodes - 1)
         template["receivers"] = template["senders"].copy()
         template["node_graph"] = np.full_like(
-            arrs[0]["node_graph"], self.spec.n_graphs - 1
+            arrs[0]["node_graph"], spec.n_graphs - 1
         )
         while len(arrs) < self.num_shards:
             arrs.append(template)
