@@ -1,12 +1,19 @@
-"""Benchmark: training throughput (graphs/sec/chip) on the current device.
+"""Benchmark: training throughput (graphs/sec/chip) + MFU on the current chip.
 
-Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
-North-star metric per BASELINE.md: OC20 S2EF graphs/sec/chip at force-MAE
-parity; until the OC20 pipeline lands, this measures the same quantity on the
-synthetic molecular workload with a production-shaped model (PNA, hidden 64,
-3 conv layers — the reference CI architecture family scaled up).
-``vs_baseline`` is vs the round-1 recorded value (RECORDED_BASELINE); 1.0
-means parity with the first measurement.
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Headline metric (BASELINE.md north star): OC20-S2EF-shaped training
+throughput with the SC25 production model shape — EGNN hidden 866, 4 conv
+layers, radius 5, max 20 neighbours, energy (graph) + forces (node) heads
+with 3x889 MLPs, MAE loss, task weights [1, 100]
+(reference: examples/multibranch/multibranch_GFM260_SC25.json). The dataset
+is the OC20-shaped generator (lognormal ~73-atom slabs, capped degree ~20 —
+the real data is not downloadable in this image) through the full bucketed
+loader pipeline. MFU = XLA-counted step FLOPs / elapsed / chip peak (bf16).
+
+``vs_baseline`` regresses the round-1 recorded measurement honestly: the
+same synthetic-PNA workload round 1 measured (68,055 graphs/sec/chip) is
+re-run and its ratio reported.
 """
 
 import json
@@ -14,51 +21,205 @@ import os
 import sys
 import time
 
-# graphs/sec/chip recorded at round 1 on the v5e chip; update when re-baselined
-RECORDED_BASELINE = None
+# graphs/sec/chip recorded at round 1 (BENCH_r01.json) on this chip for the
+# synthetic-PNA workload; used for the vs_baseline regression ratio
+RECORDED_BASELINE = 68055.28
+
+# peak dense bf16 FLOP/s by TPU generation (public figures)
+_PEAK_FLOPS = {
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5": 197e12,  # v5e / "TPU v5 lite"
+    "v4": 275e12,
+}
 
 
-def main():
+def _peak_flops(device_kind: str) -> float:
+    kind = device_kind.lower()
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def _flops_of(step, *args) -> float:
+    """XLA's own FLOP count for one compiled step (fwd+bwd+opt)."""
+    try:
+        cost = step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def _production_workload():
+    """SC25-shaped EGNN on the OC20-shaped dataset, via the real pipeline."""
+    from hydragnn_tpu.api import prepare_data
+    from hydragnn_tpu.data.pipeline import split_dataset
+    from hydragnn_tpu.data.synthetic import oc20_shaped_dataset
+
+    batch_size = int(os.getenv("BENCH_BATCH_SIZE", "32"))
+    hidden = int(os.getenv("BENCH_HIDDEN", "866"))
+    head_dim = int(os.getenv("BENCH_HEAD_DIM", "889"))
+    num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
+    graphs = oc20_shaped_dataset(num_configs)
+    tr, va, te = split_dataset(graphs, 0.9, seed=0)
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "oc20_shaped",
+            "node_features": {
+                "name": ["atomic_number", "cartesian_coordinates", "forces"],
+                "dim": [1, 3, 3],
+            },
+            "graph_features": {"name": ["energy"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "EGNN",
+                "equivariance": True,
+                "radius": 5.0,
+                "max_neighbours": 20,
+                "hidden_dim": hidden,
+                "num_conv_layers": 4,
+                "task_weights": [1.0, 100.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 50,
+                        "num_headlayers": 3,
+                        "dim_headlayers": [head_dim, head_dim, head_dim],
+                    },
+                    "node": {
+                        "num_headlayers": 3,
+                        "dim_headlayers": [head_dim, head_dim, head_dim],
+                        "type": "mlp",
+                    },
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0, 1],
+                "output_names": ["energy", "forces"],
+                "output_index": [0, 2],
+                "type": ["graph", "node"],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "num_epoch": 1,
+                "loss_function_type": "mae",
+                "num_pad_buckets": 3,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+    }
+    config, (train_loader, _, _), _ = prepare_data(config, datasets=(tr, va, te))
+    return config, train_loader
+
+
+def _bench_production():
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    config, loader = _production_workload()
+    batches = list(loader)
+    model = create_model(config)
+    variables = init_model(model, batches[0], seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+    step = make_train_step(model, tx)
+    rng = jax.random.PRNGKey(0)
+
+    # FLOPs per distinct batch shape, from the compiled executables
+    flops_by_shape = {}
+    for b in batches:
+        key = (b.num_nodes, b.num_edges)
+        if key not in flops_by_shape:
+            flops_by_shape[key] = _flops_of(step, state, b, rng)
+
+    # warmup: compile every specialization
+    for b in batches:
+        state, tot, _ = step(state, b, rng)
+    jax.block_until_ready(tot)
+
+    n_passes = int(os.getenv("BENCH_PASSES", "4"))
+    graphs_done = 0
+    flops_done = 0.0
+    t0 = time.perf_counter()
+    for p in range(n_passes):
+        for i, b in enumerate(batches):
+            state, tot, _ = step(state, b, jax.random.fold_in(rng, p * 1000 + i))
+            graphs_done += int(np.asarray(b.graph_mask).sum())
+            flops_done += flops_by_shape[(b.num_nodes, b.num_edges)]
+    jax.block_until_ready(tot)
+    dt = time.perf_counter() - t0
+
+    gps = graphs_done / dt
+    peak = _peak_flops(jax.devices()[0].device_kind)
+    mfu = (flops_done / dt) / peak
+    return {
+        "graphs_per_sec": gps,
+        "mfu": mfu,
+        "flops_per_graph": flops_done / max(graphs_done, 1),
+        "device": jax.devices()[0].device_kind,
+        "peak_flops_assumed": peak,
+        "loss": float(tot),
+    }
+
+
+def _bench_synthetic_pna():
+    """The exact round-1 workload, for the vs_baseline regression ratio."""
     import jax
 
     import __graft_entry__ as ge
     from hydragnn_tpu.models import init_model
     from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
 
-    batch_size = int(os.getenv("BENCH_BATCH_SIZE", "64"))
+    batch_size = 64
     config, model, loader, batch = ge._build(
-        mpnn_type=os.getenv("BENCH_MODEL", "PNA"),
-        hidden_dim=int(os.getenv("BENCH_HIDDEN", "64")),
-        num_conv_layers=int(os.getenv("BENCH_LAYERS", "3")),
-        batch_size=batch_size,
-        num_configs=max(2 * batch_size, 128),
+        mpnn_type="PNA", hidden_dim=64, num_conv_layers=3,
+        batch_size=batch_size, num_configs=128,
     )
     variables = init_model(model, batch, seed=0)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
     state = TrainState.create(variables, tx)
     step = make_train_step(model, tx)
-
     rng = jax.random.PRNGKey(0)
-    # warmup/compile
     state, tot, _ = step(state, batch, rng)
     jax.block_until_ready(tot)
-
-    n_steps = int(os.getenv("BENCH_STEPS", "50"))
+    n_steps = 50
     t0 = time.perf_counter()
     for i in range(n_steps):
         state, tot, _ = step(state, batch, jax.random.fold_in(rng, i))
     jax.block_until_ready(tot)
-    dt = time.perf_counter() - t0
+    return n_steps * batch_size / (time.perf_counter() - t0)
 
-    graphs_per_sec = n_steps * batch_size / dt
-    vs = graphs_per_sec / RECORDED_BASELINE if RECORDED_BASELINE else 1.0
+
+def main():
+    # synthetic leg first: the production leg's HBM footprint in the same
+    # process skews the small workload ~5x (measured), not vice versa
+    syn = _bench_synthetic_pna()
+    prod = _bench_production()
     print(
         json.dumps(
             {
-                "metric": "synthetic PNA train throughput (graphs/sec/chip)",
-                "value": round(graphs_per_sec, 2),
+                "metric": (
+                    "OC20-S2EF-shaped train throughput, SC25 production shape "
+                    "(EGNN hidden 866, 4 conv layers, r=5, max_neigh=20, "
+                    "energy+forces heads)"
+                ),
+                "value": round(prod["graphs_per_sec"], 2),
                 "unit": "graphs/sec/chip",
-                "vs_baseline": round(vs, 3),
+                "vs_baseline": round(syn / RECORDED_BASELINE, 3),
+                "mfu": round(prod["mfu"], 4),
+                "flops_per_graph": round(prod["flops_per_graph"]),
+                "device": prod["device"],
+                "peak_flops_assumed": prod["peak_flops_assumed"],
+                "synthetic_pna_graphs_per_sec": round(syn, 2),
+                "synthetic_pna_round1": RECORDED_BASELINE,
             }
         )
     )

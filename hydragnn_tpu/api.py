@@ -154,7 +154,17 @@ def prepare_data(
         num_buckets=num_buckets,
         with_triplets=arch["mpnn_type"] == "DimeNet",
     )
-    train_loader = GraphLoader(trainset, batch_size, spec=spec, shuffle=True, seed=0)
+    train_loader = GraphLoader(
+        trainset,
+        batch_size,
+        spec=spec,
+        shuffle=True,
+        seed=0,
+        # RandomSampler-with-replacement / fixed-draw modes
+        # (reference: load_data.py:237-274)
+        oversampling=bool(training.get("oversampling", False)),
+        num_samples=training.get("num_samples"),
+    )
     val_loader = GraphLoader(valset, batch_size, spec=spec, shuffle=False)
     test_loader = GraphLoader(testset, batch_size, spec=spec, shuffle=False)
     return config, (train_loader, val_loader, test_loader), mm
@@ -191,11 +201,38 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         setup_log(log_name)
     save_config(config, log_name)
 
+    training = config["NeuralNetwork"]["Training"]
+    arch = config["NeuralNetwork"]["Architecture"]
     with Timer("create_model"):
         model = create_model(config)
         variables = init_model(model, next(iter(train_loader)), seed=0)
-    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    tx = make_optimizer(
+        training["Optimizer"],
+        freeze_conv=bool(arch.get("freeze_conv_layers", False)),
+    )
     state = TrainState.create(variables, tx)
+
+    # resume mid-run (reference: "continue"/"startfrom" keys,
+    # hydragnn/utils/model/model.py:118-125, run_training.py:114) — restore
+    # before any device placement so the loaded host arrays get re-placed
+    if training.get("continue"):
+        startfrom = training.get("startfrom") or log_name
+        state = load_existing_model(state, startfrom)
+
+    # ZeRO-1 analog (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
+    # hydragnn/utils/optimizer/optimizer.py:43-113): shard the large optimizer
+    # moments over the data axis of a device mesh; params stay replicated
+    if training["Optimizer"].get("use_zero_redundancy", False):
+        import jax as _jax
+
+        if len(_jax.devices()) > 1:
+            from .parallel import make_mesh, replicate_state, shard_optimizer_state
+
+            mesh = make_mesh()
+            state = replicate_state(state, mesh)
+            state = state.replace(
+                opt_state=shard_optimizer_state(state.opt_state, mesh)
+            )
 
     writer = MetricsWriter(log_name)
 

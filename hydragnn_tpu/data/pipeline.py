@@ -196,6 +196,8 @@ class GraphLoader:
         drop_last: bool = False,
         num_shards: int = 1,
         num_buckets: int = 1,
+        oversampling: bool = False,
+        num_samples: Optional[int] = None,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -230,6 +232,11 @@ class GraphLoader:
         self.host_count = host_count
         self.host_index = host_index
         self.drop_last = drop_last
+        # RandomSampler-with-replacement / fixed-draw loader modes
+        # (reference: create_dataloaders oversampling + num_samples,
+        # hydragnn/preprocess/load_data.py:237-274)
+        self.oversampling = oversampling
+        self.num_samples = num_samples
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -243,10 +250,16 @@ class GraphLoader:
         return (n + self.batch_size - 1) // self.batch_size
 
     def _local_indices(self) -> np.ndarray:
-        idx = np.arange(len(self.graphs))
-        if self.shuffle:
-            rng = np.random.default_rng(self.seed + self.epoch)
-            rng.shuffle(idx)
+        rng = np.random.default_rng(self.seed + self.epoch)
+        if self.oversampling:
+            n = self.num_samples or len(self.graphs)
+            idx = rng.choice(len(self.graphs), size=n, replace=True)
+        else:
+            idx = np.arange(len(self.graphs))
+            if self.shuffle:
+                rng.shuffle(idx)
+            if self.num_samples is not None:
+                idx = idx[: self.num_samples]
         return idx[self.host_index :: self.host_count]
 
     def __iter__(self) -> Iterator[GraphBatch]:

