@@ -29,6 +29,7 @@ from .data.pipeline import (
     split_dataset,
 )
 from .data.synthetic import deterministic_graph_dataset
+from .data.transforms import apply_dataset_transforms, wants_transforms
 from .models.create import create_model, init_model
 from .train.checkpoint import load_existing_model, save_model
 from .train.loop import test_model, train_validate_test
@@ -108,6 +109,12 @@ def prepare_data(
     (completed config, loaders, minmax)."""
     if datasets is None:
         raw = _load_raw_dataset(config)
+        ds_cfg = config.get("Dataset", {})
+        if wants_transforms(ds_cfg):
+            # load-time geometric transforms (reference:
+            # serialized_dataset_loader.py:130-180). Rotation is shift/cell
+            # aware so applying it after edge construction is exact.
+            (raw,) = apply_dataset_transforms(ds_cfg, raw)
         if config["NeuralNetwork"]["Training"].get("compute_grad_energy", False):
             # energy/forces ride on the graphs directly (no target extraction
             # or minmax scaling — physical units matter); input node-feature
@@ -139,6 +146,13 @@ def prepare_data(
     else:
         trainset, valset, testset = datasets
         mm = None
+        ds_cfg = config.get("Dataset", {})
+        if wants_transforms(ds_cfg):
+            # explicit-datasets path gets the same transform chain, with one
+            # edge-length max shared across the three splits
+            trainset, valset, testset = apply_dataset_transforms(
+                ds_cfg, trainset, valset, testset
+            )
 
     config = update_config(config, trainset, valset, testset)
     training = config["NeuralNetwork"]["Training"]

@@ -198,11 +198,15 @@ def update_config(
 
     # ---- edge dim (reference: update_config_edge_dim, config_utils.py:190-216)
     edge_models = ("PNAPlus", "PNAEq", "PAINN", "GPS", "CGCNN", "SchNet", "EGNN", "DimeNet", "MACE")
-    if "edge_features" in config.get("Dataset", {}) and config["Dataset"]["edge_features"]:
+    from ..data.transforms import descriptor_edge_dim
+
+    _edge_dim = descriptor_edge_dim(config.get("Dataset", {}))
+    if _edge_dim:
         assert (
             arch["mpnn_type"] in edge_models or arch["global_attn_engine"]
         ), "edge features can only be used with edge-aware models"
-        arch["edge_dim"] = len(config["Dataset"]["edge_features"])
+        # edge_features columns + Descriptors columns (Spherical: 3, PPF: 4)
+        arch["edge_dim"] = _edge_dim
     elif arch["mpnn_type"] == "CGCNN":
         arch["edge_dim"] = 0
     else:

@@ -20,6 +20,19 @@ from .raw import (
     load_xyz_file,
 )
 from .lappe import add_dataset_pe, add_graph_pe, laplacian_pe
+from .transforms import (
+    add_edge_lengths,
+    apply_dataset_transforms,
+    wants_transforms,
+    add_point_pair_features,
+    add_spherical_descriptors,
+    apply_post_edge_transforms,
+    apply_pre_edge_transforms,
+    estimate_normals,
+    normalize_edge_attr,
+    normalize_rotation,
+    normalize_rotation_pos,
+)
 from .synthetic import deterministic_graph_dataset, lennard_jones_dataset
 
 __all__ = [
@@ -54,4 +67,15 @@ __all__ = [
     "load_lsms_file",
     "load_raw_dataset",
     "load_xyz_file",
+    "add_edge_lengths",
+    "apply_dataset_transforms",
+    "wants_transforms",
+    "add_point_pair_features",
+    "add_spherical_descriptors",
+    "apply_post_edge_transforms",
+    "apply_pre_edge_transforms",
+    "estimate_normals",
+    "normalize_edge_attr",
+    "normalize_rotation",
+    "normalize_rotation_pos",
 ]

@@ -155,7 +155,7 @@ def pytest_train_gps_attention(mpnn_type, attn_type, tmp_path, monkeypatch):
     tests/test_graphs.py:235-249 runs GPS across edge models)."""
     cfg = make_config(
         mpnn_type,
-        num_epoch=25,
+        num_epoch=30,
         global_attn_engine="GPS",
         global_attn_type=attn_type,
         global_attn_heads=8,

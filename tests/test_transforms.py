@@ -1,0 +1,296 @@
+"""Load-time geometric transforms: rotational normalization invariance,
+edge-length global-max normalization, Spherical / PointPairFeatures
+descriptors (reference: tests/test_rotational_invariance.py:70-110 and
+hydragnn/preprocess/serialized_dataset_loader.py:130-180)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data import (
+    add_edge_lengths,
+    add_point_pair_features,
+    add_spherical_descriptors,
+    apply_post_edge_transforms,
+    apply_pre_edge_transforms,
+    estimate_normals,
+    normalize_edge_attr,
+    normalize_rotation,
+    normalize_rotation_pos,
+    radius_graph,
+)
+from hydragnn_tpu.data.graph import Graph
+from hydragnn_tpu.data.transforms import descriptor_edge_dim
+
+
+def bct_positions():
+    """BCT lattice, 32 nodes (reference: test_rotational_invariance.py:25-49)."""
+    uc_x, uc_y, uc_z = 4, 2, 2
+    lxy, lz = 5.218, 7.058
+    pos = []
+    for x in range(uc_x):
+        for y in range(uc_y):
+            for z in range(uc_z):
+                pos.append((x * lxy, y * lxy, z * lz))
+                pos.append(((x + 0.5) * lxy, (y + 0.5) * lxy, (z + 0.5) * lz))
+    return np.asarray(pos, np.float64)
+
+
+def random_rotation(seed):
+    rng = np.random.default_rng(seed)
+    q, _ = np.linalg.qr(rng.normal(size=(3, 3)))
+    if np.linalg.det(q) < 0:
+        q[:, 0] = -q[:, 0]
+    return q
+
+
+def graph_from_pos(pos, radius=6.0):
+    s, r = radius_graph(pos, radius)
+    return Graph(
+        x=np.zeros((pos.shape[0], 1), np.float32),
+        pos=np.asarray(pos, np.float32),
+        senders=s,
+        receivers=r,
+    )
+
+
+def pytest_normalize_rotation_canonical_frame():
+    """The canonical frame is identical no matter how the input is rotated
+    (stronger than PyG's up-to-axis-sign invariance)."""
+    pos = bct_positions()
+    base = normalize_rotation_pos(pos)
+    for seed in range(3):
+        rot = random_rotation(seed)
+        out = normalize_rotation_pos(pos @ rot)
+        np.testing.assert_allclose(out, base, atol=5e-4)
+
+
+def pytest_normalize_rotation_preserves_distances():
+    pos = bct_positions()
+    g = graph_from_pos(pos)
+    g2 = normalize_rotation(g)
+    d1 = np.linalg.norm(pos[g.senders] - pos[g.receivers], axis=1)
+    p2 = np.asarray(g2.pos, np.float64)
+    d2 = np.linalg.norm(p2[g.senders] - p2[g.receivers], axis=1)
+    np.testing.assert_allclose(d1, d2, rtol=1e-5)
+
+
+def pytest_normalize_rotation_pbc_consistency():
+    """Shift vectors and cell rotate with the positions, so PBC edge
+    displacements are exactly preserved."""
+    from hydragnn_tpu.data import radius_graph_pbc
+
+    pos = bct_positions()[:16]
+    cell = np.diag([10.436, 10.436, 14.116])
+    s, r, shifts = radius_graph_pbc(pos, cell, radius=6.0)
+    g = Graph(
+        x=np.zeros((pos.shape[0], 1), np.float32),
+        pos=pos.astype(np.float32),
+        senders=s,
+        receivers=r,
+        edge_shifts=shifts,
+        cell=cell.astype(np.float32),
+    )
+    g2 = normalize_rotation(g)
+    v1 = pos[r] - pos[s] - shifts
+    p2 = np.asarray(g2.pos, np.float64)
+    v2 = p2[r] - p2[s] - np.asarray(g2.edge_shifts, np.float64)
+    np.testing.assert_allclose(
+        np.linalg.norm(v1, axis=1), np.linalg.norm(v2, axis=1), atol=1e-4
+    )
+
+
+def pytest_edge_length_descriptor_rotation_invariant():
+    """Rotate -> edges -> lengths gives the same lengths: the reference's
+    invariance check (test_rotational_invariance.py:70-110) at float64."""
+    pos = bct_positions()
+    rot = random_rotation(7)
+    g1 = add_edge_lengths(graph_from_pos(pos))
+    g2 = add_edge_lengths(graph_from_pos(pos @ rot))
+    np.testing.assert_allclose(
+        np.sort(g1.edge_attr[:, 0]), np.sort(g2.edge_attr[:, 0]), atol=1e-5
+    )
+
+
+def pytest_normalize_edge_attr_global_max():
+    gs = [add_edge_lengths(graph_from_pos(bct_positions() * s)) for s in (0.5, 1.0)]
+    out = normalize_edge_attr(gs)
+    mx = max(float(np.max(g.edge_attr)) for g in gs)
+    assert np.isclose(max(float(np.max(g.edge_attr)) for g in out), 1.0)
+    np.testing.assert_allclose(out[0].edge_attr, gs[0].edge_attr / mx, rtol=1e-6)
+
+
+def pytest_spherical_descriptors():
+    g = graph_from_pos(bct_positions())
+    out = add_spherical_descriptors(g)
+    assert out.edge_attr.shape == (g.num_edges, 3)
+    rho, theta, phi = out.edge_attr.T
+    assert (rho >= 0).all() and (rho <= 1 + 1e-6).all()
+    assert (theta >= 0).all() and (theta <= 1 + 1e-6).all()
+    assert (phi >= 0).all() and (phi <= 1 + 1e-6).all()
+    # appends after an existing column
+    out2 = add_spherical_descriptors(add_edge_lengths(g))
+    assert out2.edge_attr.shape == (g.num_edges, 4)
+
+
+def sheet_positions():
+    """A wavy 2D sheet in 3D: local neighborhoods have a well-separated
+    smallest covariance eigenvalue, so PCA normals are well-defined (bulk
+    lattices have degenerate local covariance and hence no meaningful
+    normal — as with any PCA normal estimate)."""
+    xs, ys = np.meshgrid(np.arange(8.0), np.arange(8.0))
+    zs = 0.3 * np.sin(xs * 0.7) + 0.2 * np.cos(ys * 0.9)
+    return np.stack([xs.ravel(), ys.ravel(), zs.ravel()], axis=1)
+
+
+def pytest_point_pair_features_rotation_invariant():
+    """PPF (lengths + angles between estimated normals and displacements) is
+    rotation-invariant by construction."""
+    pos = sheet_positions()
+    rot = random_rotation(11)
+    g1 = add_point_pair_features(graph_from_pos(pos, radius=1.8))
+    g2 = add_point_pair_features(graph_from_pos(pos @ rot, radius=1.8))
+    # same edge set, possibly emitted in a different order: compare in a
+    # canonical (sender, receiver) ordering
+    o1 = np.lexsort((g1.receivers, g1.senders))
+    o2 = np.lexsort((g2.receivers, g2.senders))
+    np.testing.assert_array_equal(g1.senders[o1], g2.senders[o2])
+    np.testing.assert_array_equal(g1.receivers[o1], g2.receivers[o2])
+    np.testing.assert_allclose(g1.edge_attr[o1], g2.edge_attr[o2], atol=1e-4)
+
+
+def pytest_estimate_normals_unit_and_equivariant():
+    pos = sheet_positions()
+    g = graph_from_pos(pos, radius=1.8)
+    n1 = estimate_normals(pos, g.senders, g.receivers)
+    np.testing.assert_allclose(np.linalg.norm(n1, axis=1), 1.0, atol=1e-5)
+    rot = random_rotation(3)
+    n2 = estimate_normals(pos @ rot, g.senders, g.receivers)
+    np.testing.assert_allclose(np.abs(np.sum(n2 * (n1 @ rot), axis=1)), 1.0, atol=1e-4)
+
+
+def pytest_descriptor_edge_dim_and_chain():
+    cfg = {
+        "edge_features": ["lengths"],
+        "Descriptors": {"SphericalCoordinates": True, "PointPairFeatures": True},
+    }
+    assert descriptor_edge_dim(cfg) == 8
+    assert descriptor_edge_dim({}) == 0
+    g = graph_from_pos(bct_positions())
+    (out,) = apply_post_edge_transforms(
+        apply_pre_edge_transforms([g], {**cfg, "rotational_invariance": True}), cfg
+    )
+    assert out.edge_attr.shape == (g.num_edges, 8)
+    # length column is globally normalized to max 1
+    assert np.isclose(np.max(out.edge_attr[:, 0]), 1.0)
+
+
+def pytest_unknown_edge_features_rejected():
+    with pytest.raises(ValueError, match="unsupported Dataset.edge_features"):
+        descriptor_edge_dim({"edge_features": ["lengths", "bond_order"]})
+
+
+def pytest_apply_dataset_transforms_shares_global_max():
+    """Split-wise application shares one edge-length max across splits."""
+    from hydragnn_tpu.data import apply_dataset_transforms
+
+    cfg = {"edge_features": ["lengths"]}
+
+    def pair(dist):
+        return Graph(
+            x=np.zeros((2, 1), np.float32),
+            pos=np.array([[0, 0, 0], [dist, 0, 0]], np.float32),
+            senders=np.array([0, 1], np.int32),
+            receivers=np.array([1, 0], np.int32),
+        )
+
+    out_small, out_large = apply_dataset_transforms(cfg, [pair(1.0)], [pair(2.0)])
+    assert np.isclose(np.max(out_small[0].edge_attr), 0.5)
+    assert np.isclose(np.max(out_large[0].edge_attr), 1.0)
+
+
+def pytest_estimate_normals_pbc_shift_aware():
+    """Normals use shift-corrected displacements, so they match the
+    open-boundary result when every atom's neighborhood fits in the cell."""
+    from hydragnn_tpu.data import radius_graph_pbc
+
+    pos = sheet_positions() + np.array([4.0, 4.0, 10.0])
+    cell = np.diag([100.0, 100.0, 100.0])  # huge cell: PBC == open boundary
+    s0, r0 = radius_graph(pos, 1.8)
+    s1, r1, shifts = radius_graph_pbc(pos, cell, radius=1.8)
+    n_open = estimate_normals(pos, s0, r0)
+    n_pbc = estimate_normals(pos, s1, r1, shifts)
+    np.testing.assert_allclose(
+        np.abs(np.sum(n_open * n_pbc, axis=1)), 1.0, atol=1e-5
+    )
+
+
+def pytest_normalize_rotation_rotates_forces():
+    """Force targets co-rotate with positions, so F = -dE/dpos is preserved
+    in the canonical frame (forces transform covariantly)."""
+    from hydragnn_tpu.data import lennard_jones_dataset
+    from hydragnn_tpu.data.transforms import principal_rotation
+
+    g = lennard_jones_dataset(number_configurations=1, seed=3)[0]
+    rot = principal_rotation(g.pos)
+    g2 = normalize_rotation(g)
+    np.testing.assert_allclose(
+        g2.node_targets["forces"],
+        np.asarray(g.node_targets["forces"], np.float64) @ rot,
+        rtol=1e-5,
+    )
+    # energy (graph target) is rotation-invariant and must be untouched
+    for k, v in (g.graph_targets or {}).items():
+        np.testing.assert_array_equal(g2.graph_targets[k], v)
+
+
+def pytest_end_to_end_descriptors_through_training():
+    """Descriptors flow from Dataset config through update_config edge_dim
+    into an edge-aware model and a real training run."""
+    from hydragnn_tpu.api import run_training
+
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "desc_ci",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 40},
+            "rotational_invariance": True,
+            "edge_features": ["lengths"],
+            "Descriptors": {"SphericalCoordinates": True},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1],
+                              "column_index": [0, 6, 7]},
+            "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1],
+                               "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "SchNet",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                            "dim_sharedlayers": 8,
+                                            "num_headlayers": 2,
+                                            "dim_headlayers": [8, 8]}},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 2,
+                "batch_size": 16,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+    model, state, hist, cfg, loaders, mm = run_training(config)
+    assert cfg["NeuralNetwork"]["Architecture"]["edge_dim"] == 4
+    assert np.isfinite(hist["train"][-1])
