@@ -1,0 +1,105 @@
+"""QM9 example: single-head graph-property training through the columnar
+dataset format (reference: examples/qm9/qm9.py:1-160 — QM9 free-energy
+prediction with GPS global attention over SchNet).
+
+The real QM9 download is unavailable in this image (zero egress), so the
+dataset builder takes one of two sources:
+
+- ``--xyz_dir DIR``: a directory of .xyz files (real QM9 geometries exported
+  to plain xyz; the comment line must carry the free-energy value), parsed by
+  the framework's raw XYZ loader, or
+- the default QM9-*shaped* generator (``qm9_shaped_dataset``): molecules with
+  QM9's size/composition statistics and a closed-form geometric target.
+
+Either source is written once through ``ColumnarWriter`` (the ADIOS-writer
+analog) and training then reads it back with ``Dataset.format: "columnar"`` —
+the same at-scale path a real dataset would use.
+
+    python examples/qm9/qm9.py [--mpnn_type SchNet] [--num_samples 1000]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import hydragnn_tpu
+from hydragnn_tpu.data import ColumnarWriter, qm9_shaped_dataset
+from hydragnn_tpu.data.raw import finalize_graphs, load_xyz_file
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_dataset(path, num_samples, radius, max_neighbours, xyz_dir=None):
+    """Write the columnar shard once; later runs reuse it."""
+    if os.path.isdir(path):
+        return
+    if xyz_dir:
+        graphs = []
+        for f in sorted(glob.glob(os.path.join(xyz_dir, "*.xyz"))):
+            g = load_xyz_file(f)
+            if g.graph_y is None or len(g.graph_y) < 1:
+                raise ValueError(
+                    f"{f}: comment line must be numeric graph target(s) "
+                    "(free energy first); raw QM9/GDB9 comment lines like "
+                    "'gdb N ...' need the target values extracted first"
+                )
+            graphs.append(g)
+        graphs = finalize_graphs(graphs, radius=radius, max_neighbours=max_neighbours)
+        # free energy per atom, matching the reference pre-transform
+        # (examples/qm9/qm9.py:27: data.y = data.y[:, 10] / len(data.x))
+        for g in graphs:
+            g.graph_y = (g.graph_y[:1] / g.num_nodes).astype(np.float32)
+    else:
+        graphs = qm9_shaped_dataset(
+            number_configurations=num_samples,
+            radius=radius,
+            max_neighbours=max_neighbours,
+        )
+    ColumnarWriter(path).add(graphs).save()
+    print(f"wrote {len(graphs)} samples -> {path}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mpnn_type", default=None)
+    ap.add_argument("--global_attn_engine", default=None)
+    ap.add_argument("--global_attn_type", default=None)
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--num_samples", type=int, default=1000)
+    ap.add_argument("--xyz_dir", default=None, help="optional real-data xyz directory")
+    args = ap.parse_args()
+
+    with open(os.path.join(_HERE, "qm9.json")) as f:
+        config = json.load(f)
+    arch = config["NeuralNetwork"]["Architecture"]
+    if args.mpnn_type:
+        arch["mpnn_type"] = args.mpnn_type
+    if args.global_attn_engine is not None:
+        arch["global_attn_engine"] = args.global_attn_engine or None
+    if args.global_attn_type:
+        arch["global_attn_type"] = args.global_attn_type
+    if args.num_epoch:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    data_path = os.path.join(os.getcwd(), config["Dataset"]["path"]["total"])
+    config["Dataset"]["path"]["total"] = data_path
+    build_dataset(
+        data_path, args.num_samples, arch["radius"], arch["max_neighbours"],
+        xyz_dir=args.xyz_dir,
+    )
+
+    model, state, hist, config, loaders, mm = hydragnn_tpu.run_training(config)
+    tot, tasks, preds, trues = hydragnn_tpu.run_prediction(config, model_state=state)
+    err = preds["free_energy"] - trues["free_energy"]
+    mae = float(np.mean(np.abs(err)))
+    print(f"test loss {tot:.5f}; free_energy MAE {mae:.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,7 +33,13 @@ from .transforms import (
     normalize_rotation,
     normalize_rotation_pos,
 )
-from .synthetic import deterministic_graph_dataset, lennard_jones_dataset
+from .synthetic import (
+    deterministic_graph_dataset,
+    lennard_jones_dataset,
+    md17_shaped_dataset,
+    oc20_shaped_dataset,
+    qm9_shaped_dataset,
+)
 
 __all__ = [
     "AbstractBaseDataset",
@@ -60,6 +66,9 @@ __all__ = [
     "split_dataset",
     "deterministic_graph_dataset",
     "lennard_jones_dataset",
+    "md17_shaped_dataset",
+    "oc20_shaped_dataset",
+    "qm9_shaped_dataset",
     "atomic_descriptors",
     "smiles_to_graph",
     "finalize_graphs",

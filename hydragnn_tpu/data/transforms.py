@@ -324,21 +324,14 @@ def add_point_pair_features(
 # ---------------------------------------------------------------------------
 
 
-_KNOWN_EDGE_FEATURES = ("lengths",)
-
-
 def descriptor_edge_dim(dataset_cfg: dict) -> int:
-    """Number of edge-attribute columns the configured transform chain emits
-    (lengths: 1, SphericalCoordinates: +3, PointPairFeatures: +4). Unknown
-    ``edge_features`` names raise at config time rather than silently
-    producing an edge_attr narrower than the declared edge_dim."""
+    """Number of edge-attribute columns the model will see: one per
+    ``edge_features`` entry ("lengths" is computed by the transform chain,
+    any other name declares a column already stored in the dataset's
+    edge_attr), +3 for SphericalCoordinates, +4 for PointPairFeatures.
+    ``apply_post_edge_transforms`` checks the declaration against the actual
+    data and raises on mismatch."""
     feats = dataset_cfg.get("edge_features") or []
-    unknown = [f for f in feats if f not in _KNOWN_EDGE_FEATURES]
-    if unknown:
-        raise ValueError(
-            f"unsupported Dataset.edge_features {unknown}; "
-            f"supported: {list(_KNOWN_EDGE_FEATURES)}"
-        )
     dim = len(feats)
     desc = dataset_cfg.get("Descriptors", {})
     if desc.get("SphericalCoordinates"):
@@ -395,18 +388,29 @@ def apply_post_edge_transforms(
     by the cross-process global max (serialized_dataset_loader.py:154-173);
     ``Dataset.Descriptors`` adds the Spherical / PointPairFeatures columns."""
     graphs = list(graphs)
+    feats = dataset_cfg.get("edge_features") or []
     desc = dataset_cfg.get("Descriptors", {})
     if not (
-        dataset_cfg.get("edge_features")
-        or desc.get("SphericalCoordinates")
-        or desc.get("PointPairFeatures")
+        feats or desc.get("SphericalCoordinates") or desc.get("PointPairFeatures")
     ):
         return graphs
+    # edge_features contract: "lengths" is computed here; any other name
+    # declares a column the dataset must already carry in edge_attr
+    stored = [f for f in feats if f != "lengths"]
+    for g in graphs:
+        have = 0 if g.edge_attr is None else int(g.edge_attr.shape[1])
+        if have != len(stored):
+            raise ValueError(
+                f"Dataset.edge_features declares {len(stored)} stored "
+                f"column(s) {stored} but a graph carries edge_attr with "
+                f"{have} column(s); only 'lengths' is computed at load time"
+            )
     # geometry is shared by every descriptor in the chain: compute once per
     # graph (positions/edges never change below this point)
     geos = [_graph_edge_geometry(g) for g in graphs]
-    if dataset_cfg.get("edge_features"):
-        graphs = [add_edge_lengths(g, vl) for g, vl in zip(graphs, geos)]
+    if feats:
+        if "lengths" in feats:
+            graphs = [add_edge_lengths(g, vl) for g, vl in zip(graphs, geos)]
         graphs = normalize_edge_attr(graphs)
     if desc.get("SphericalCoordinates"):
         graphs = [

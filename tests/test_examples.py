@@ -12,13 +12,13 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run_example(rel, *args, timeout=420):
+def _run_example(rel, *args, timeout=420, cwd=None):
     out = subprocess.run(
         [sys.executable, os.path.join(_REPO, rel), *args],
         capture_output=True,
         text=True,
         timeout=timeout,
-        cwd=_REPO,
+        cwd=cwd or _REPO,
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
@@ -38,6 +38,28 @@ def pytest_example_lennard_jones():
         "--mpnn_type", "SchNet", "--num_epoch", "5", "--num_configs", "32",
     )
     assert "force corr" in out
+
+
+def pytest_example_qm9(tmp_path):
+    """qm9 flow: shaped dataset -> ColumnarWriter -> columnar training
+    (reference: tests/test_examples.py smoke-runs examples/qm9)."""
+    out = _run_example(
+        "examples/qm9/qm9.py", "--num_samples", "80", "--num_epoch", "2",
+        cwd=str(tmp_path),
+    )
+    assert "free_energy MAE" in out
+    assert (tmp_path / "dataset" / "qm9_columnar").is_dir()
+
+
+def pytest_example_md17(tmp_path):
+    """md17 flow: energy+force through the columnar format; prints the
+    force MAE that fills the BASELINE.md MD17 row."""
+    out = _run_example(
+        "examples/md17/md17.py", "--num_samples", "48", "--num_epoch", "3",
+        cwd=str(tmp_path),
+    )
+    assert "force MAE" in out
+    assert (tmp_path / "dataset" / "md17_columnar").is_dir()
 
 
 def pytest_example_multibranch():

@@ -186,9 +186,24 @@ def pytest_descriptor_edge_dim_and_chain():
     assert np.isclose(np.max(out.edge_attr[:, 0]), 1.0)
 
 
-def pytest_unknown_edge_features_rejected():
-    with pytest.raises(ValueError, match="unsupported Dataset.edge_features"):
-        descriptor_edge_dim({"edge_features": ["lengths", "bond_order"]})
+def pytest_edge_features_declaration_checked_against_data():
+    """Names other than 'lengths' declare stored edge_attr columns; a
+    mismatch with the actual data raises instead of silently producing an
+    edge_attr narrower/wider than the declared edge_dim."""
+    cfg = {"edge_features": ["lengths", "bond_order"]}
+    assert descriptor_edge_dim(cfg) == 2
+    g = graph_from_pos(bct_positions())  # carries no stored edge_attr
+    with pytest.raises(ValueError, match="declares 1 stored"):
+        apply_post_edge_transforms([g], cfg)
+    # dataset-supplied edge_attr + computed lengths compose
+    g2 = dataclasses.replace(
+        g, edge_attr=np.ones((g.num_edges, 1), np.float32)
+    )
+    (out,) = apply_post_edge_transforms([g2], cfg)
+    assert out.edge_attr.shape == (g.num_edges, 2)
+    # and a stored column the config does not declare is rejected
+    with pytest.raises(ValueError, match="declares 0 stored"):
+        apply_post_edge_transforms([g2], {"edge_features": ["lengths"]})
 
 
 def pytest_apply_dataset_transforms_shares_global_max():
