@@ -1,0 +1,132 @@
+"""LSMS example: binary-alloy (FePt) multi-task training on formation Gibbs
+energy + nodal charge density / magnetic moment (reference:
+examples/lsms/lsms.py + lsms.json — FePt_32atoms multihead PNA run).
+
+Pipeline (all framework components, no downloads):
+  1. generate synthetic FePt LSMS raw files (BCC supercells, random
+     occupations, physically-shaped targets) unless the directory exists,
+  2. convert total energies to formation Gibbs energies
+     (``convert_total_energy_to_formation_gibbs``),
+  3. optionally downselect by composition histogram
+     (``--histogram_cutoff N``),
+  4. train the multihead model with compositional stratified splitting and
+     charge-density correction through ``Dataset.format: "LSMS"``.
+
+    python examples/lsms/lsms.py [--num_configs 96] [--num_epoch 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import hydragnn_tpu
+from hydragnn_tpu.data import (
+    compositional_histogram_cutoff,
+    convert_total_energy_to_formation_gibbs,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+Z_FE, Z_PT = 26.0, 78.0
+E_FE, E_PT = -3.2, -5.1  # per-atom pure-phase energies (Rydberg-ish scale)
+
+
+def generate_raw(dir_path, num_configs, seed=11):
+    """BCC FePt supercells in LSMS text format: header = total energy, atom
+    rows [Z, q, x, y, z, charge_density, magnetic_moment]. Targets are
+    closed-form so the example is learnable: formation enthalpy follows a
+    regular-solution curve -4*w*x*(1-x), charge density is Z plus a
+    composition-dependent net transfer, moments are element-specific."""
+    os.makedirs(dir_path)
+    rng = np.random.default_rng(seed)
+    # 2x2x2 BCC supercell -> 16 sites
+    a = 2.85
+    cells = np.array(
+        [(x, y, z) for x in range(2) for y in range(2) for z in range(2)], float
+    )
+    sites = np.concatenate([cells, cells + 0.5]) * a
+    n = sites.shape[0]
+    for i in range(num_configs):
+        if i == 0:
+            zs = np.full(n, Z_FE)
+        elif i == 1:
+            zs = np.full(n, Z_PT)
+        else:
+            zs = np.where(rng.random(n) < rng.uniform(0.1, 0.9), Z_FE, Z_PT)
+        x_fe = float(np.mean(zs == Z_FE))
+        enthalpy = -4.0 * 0.8 * x_fe * (1.0 - x_fe) * n / 16.0
+        total = float(np.sum(np.where(zs == Z_FE, E_FE, E_PT))) + enthalpy
+        pos = sites + rng.normal(0.0, 0.03, sites.shape)
+        # net charge transfer Fe->Pt grows with the partner concentration
+        q_net = np.where(zs == Z_FE, -0.2 * (1 - x_fe), 0.2 * x_fe)
+        rho = zs + q_net  # raw charge density includes the proton count
+        moment = np.where(zs == Z_FE, 2.2, 0.35)
+        with open(os.path.join(dir_path, f"config_{i:04d}.txt"), "w") as f:
+            f.write(f"{total!r} 0.0\n")
+            for k in range(n):
+                f.write(
+                    f"{zs[k]:.1f} 0.0 {pos[k, 0]:.6f} {pos[k, 1]:.6f} "
+                    f"{pos[k, 2]:.6f} {rho[k]:.6f} {moment[k]:.4f}\n"
+                )
+    print(f"wrote {num_configs} LSMS samples -> {dir_path}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mpnn_type", default=None)
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--num_configs", type=int, default=96)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--histogram_cutoff", type=int, default=0,
+                    help="max samples per composition bin (0 = off)")
+    args = ap.parse_args()
+
+    with open(os.path.join(_HERE, "lsms.json")) as f:
+        config = json.load(f)
+    if args.mpnn_type:
+        config["NeuralNetwork"]["Architecture"]["mpnn_type"] = args.mpnn_type
+    if args.num_epoch:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    raw_dir = os.path.join(os.getcwd(), "dataset", "FePt_raw")
+    data_dir = raw_dir + "_gibbs_energy"
+    # gate on the *converted* dir so a partial first run (generation ok,
+    # conversion failed) is retried rather than skipped forever
+    if not os.path.isdir(data_dir):
+        if not os.path.isdir(raw_dir):
+            generate_raw(raw_dir, args.num_configs)
+        res = convert_total_energy_to_formation_gibbs(
+            raw_dir, [Z_FE, Z_PT], temperature_kelvin=args.temperature,
+            overwrite_data=True,
+        )
+        print(
+            f"formation Gibbs range: [{res.formation_gibbs_energies.min():.4f}, "
+            f"{res.formation_gibbs_energies.max():.4f}] Ry"
+        )
+    if args.histogram_cutoff:
+        kept = compositional_histogram_cutoff(
+            data_dir, [Z_FE, Z_PT], args.histogram_cutoff, num_bins=10,
+            overwrite_data=True,
+        )
+        print(f"histogram cutoff kept {len(kept)} samples")
+        data_dir = data_dir + "_histogram_cutoff"
+    config["Dataset"]["path"]["total"] = data_dir
+
+    model, state, hist, config, loaders, mm = hydragnn_tpu.run_training(config)
+    tot, tasks, preds, trues = hydragnn_tpu.run_prediction(config, model_state=state)
+    mae = {
+        k: float(np.mean(np.abs(preds[k] - trues[k]))) for k in preds
+    }
+    print(
+        "test loss "
+        f"{tot:.5f}; MAE "
+        + ", ".join(f"{k}={v:.4f}" for k, v in mae.items())
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -20,6 +20,12 @@ from .raw import (
     load_xyz_file,
 )
 from .lappe import add_dataset_pe, add_graph_pe, laplacian_pe
+from .lsms import (
+    compositional_histogram_cutoff,
+    compute_formation_enthalpy,
+    convert_total_energy_to_formation_gibbs,
+    mixing_entropy,
+)
 from .transforms import (
     add_edge_lengths,
     apply_dataset_transforms,
@@ -77,6 +83,10 @@ __all__ = [
     "load_raw_dataset",
     "load_xyz_file",
     "add_edge_lengths",
+    "compositional_histogram_cutoff",
+    "compute_formation_enthalpy",
+    "convert_total_energy_to_formation_gibbs",
+    "mixing_entropy",
     "apply_dataset_transforms",
     "wants_transforms",
     "add_point_pair_features",

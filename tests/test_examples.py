@@ -62,6 +62,18 @@ def pytest_example_md17(tmp_path):
     assert (tmp_path / "dataset" / "md17_columnar").is_dir()
 
 
+def pytest_example_lsms(tmp_path):
+    """LSMS flow: raw generation -> formation-Gibbs conversion -> histogram
+    cutoff -> multihead training (reference: examples/lsms)."""
+    out = _run_example(
+        "examples/lsms/lsms.py", "--num_configs", "32", "--num_epoch", "3",
+        "--histogram_cutoff", "6", timeout=560, cwd=str(tmp_path),
+    )
+    assert "formation Gibbs range" in out
+    assert "histogram cutoff kept" in out
+    assert "MAE formation_gibbs_energy" in out
+
+
 def pytest_example_multibranch():
     out = _run_example("examples/multibranch/train.py", "--epochs", "2")
     assert "epoch 1:" in out

@@ -1,0 +1,138 @@
+"""LSMS physics utilities: formation enthalpy / Gibbs conversion and
+compositional histogram cutoff (reference: hydragnn/utils/lsms/
+convert_total_energy_to_formation_gibbs.py, compositional_histogram_cutoff.py
+and tests/test_enthalpy.py)."""
+
+import math
+import os
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data import (
+    compositional_histogram_cutoff,
+    compute_formation_enthalpy,
+    convert_total_energy_to_formation_gibbs,
+    mixing_entropy,
+)
+from hydragnn_tpu.data.lsms import KB_RYDBERG_PER_KELVIN, read_lsms_file
+
+ZA, ZB = 26.0, 78.0  # Fe / Pt
+EA, EB = -3.0, -5.0  # per-atom pure-phase energies (Rydberg)
+
+
+def _write_sample(path, zs, extra_energy=0.0):
+    """LSMS text sample: header = total energy; atom rows
+    [Z, q, x, y, z, rho]. Total energy = linear mixing + extra_energy, so
+    the formation enthalpy of the sample is exactly extra_energy."""
+    zs = np.asarray(zs, float)
+    e = float(np.sum(np.where(zs == ZA, EA, EB))) + extra_energy
+    rng = np.random.default_rng(len(zs))
+    with open(path, "w") as f:
+        f.write(f"{e!r} 0.0\n")
+        for z in zs:
+            x, y, w = rng.uniform(0, 4, 3)
+            f.write(f"{z:.1f} 0.0 {x:.6f} {y:.6f} {w:.6f} {z / 2:.4f}\n")
+    return e
+
+
+@pytest.fixture
+def alloy_dir(tmp_path):
+    d = tmp_path / "FePt"
+    d.mkdir()
+    _write_sample(d / "pureA.txt", [ZA] * 4)
+    _write_sample(d / "pureB.txt", [ZB] * 4)
+    _write_sample(d / "mix1.txt", [ZA, ZA, ZB, ZB], extra_energy=-0.7)
+    _write_sample(d / "mix2.txt", [ZA, ZB, ZB, ZB], extra_energy=0.3)
+    return str(d)
+
+
+def pytest_formation_enthalpy_closed_form():
+    pure = {ZA: EA, ZB: EB}
+    comp, lm, dh, s = compute_formation_enthalpy(
+        np.array([ZA, ZA, ZB, ZB]), 2 * EA + 2 * EB - 0.7, [ZA, ZB], pure
+    )
+    assert comp == 0.5
+    np.testing.assert_allclose(lm, 2 * EA + 2 * EB)
+    np.testing.assert_allclose(dh, -0.7)
+    np.testing.assert_allclose(s, KB_RYDBERG_PER_KELVIN * math.log(6))  # C(4,2)
+
+
+def pytest_gibbs_conversion_rewrites_headers(alloy_dir):
+    res = convert_total_energy_to_formation_gibbs(alloy_dir, [ZA, ZB])
+    assert sorted(res.files) == ["mix1.txt", "mix2.txt", "pureA.txt", "pureB.txt"]
+    by_name = dict(zip(res.files, res.formation_gibbs_energies))
+    np.testing.assert_allclose(by_name["pureA.txt"], 0.0, atol=1e-10)
+    np.testing.assert_allclose(by_name["pureB.txt"], 0.0, atol=1e-10)
+    np.testing.assert_allclose(by_name["mix1.txt"], -0.7, atol=1e-10)
+    np.testing.assert_allclose(by_name["mix2.txt"], 0.3, atol=1e-10)
+    # rewritten files: header energy replaced, atom table untouched
+    e, atoms, _ = read_lsms_file(os.path.join(res.output_dir, "mix1.txt"))
+    np.testing.assert_allclose(e, -0.7, atol=1e-10)
+    _, atoms_orig, _ = read_lsms_file(os.path.join(alloy_dir, "mix1.txt"))
+    np.testing.assert_array_equal(atoms, atoms_orig)
+
+
+def pytest_gibbs_temperature_term(alloy_dir):
+    t = 300.0
+    res = convert_total_energy_to_formation_gibbs(
+        alloy_dir, [ZA, ZB], temperature_kelvin=t, overwrite_data=True
+    )
+    by_name = dict(zip(res.files, res.formation_gibbs_energies))
+    s_mix1 = mixing_entropy(4, 2)
+    np.testing.assert_allclose(by_name["mix1.txt"], -0.7 - t * s_mix1, atol=1e-12)
+    # pure phases have zero mixing entropy: unchanged by temperature
+    np.testing.assert_allclose(by_name["pureA.txt"], 0.0, atol=1e-10)
+
+
+def pytest_mixing_entropy_large_n_finite():
+    """lgamma keeps huge configurations finite where a direct binomial
+    coefficient overflows (improvement over reference :183)."""
+    s = mixing_entropy(20000, 10000)
+    assert np.isfinite(s) and s > 0
+
+
+def pytest_missing_pure_phase_raises(tmp_path):
+    d = tmp_path / "nopure"
+    d.mkdir()
+    _write_sample(d / "mix.txt", [ZA, ZB])
+    with pytest.raises(ValueError, match="single-element"):
+        convert_total_energy_to_formation_gibbs(str(d), [ZA, ZB])
+
+
+def pytest_gibbs_refuses_stale_output(alloy_dir):
+    convert_total_energy_to_formation_gibbs(alloy_dir, [ZA, ZB])
+    with pytest.raises(FileExistsError):
+        convert_total_energy_to_formation_gibbs(alloy_dir, [ZA, ZB])
+
+
+def pytest_find_bin_endpoints_separate():
+    """Pure endmembers (comp 0.0 and 1.0) get their own bins — the reference
+    scan drops every on-edge composition into the last bin (:8-13)."""
+    from hydragnn_tpu.data.lsms import find_bin
+
+    assert find_bin(0.0, 10) == 0
+    assert find_bin(1.0, 10) == 9
+    assert find_bin(0.05, 10) == 0
+    assert find_bin(0.95, 10) == 9
+    assert find_bin(0.5, 10) == 5
+
+
+def pytest_histogram_cutoff(tmp_path):
+    d = tmp_path / "many"
+    d.mkdir()
+    # 6 samples at composition 0.5, 2 at 0.25
+    for i in range(6):
+        _write_sample(d / f"half_{i}.txt", [ZA, ZA, ZB, ZB])
+    for i in range(2):
+        _write_sample(d / f"quarter_{i}.txt", [ZA, ZB, ZB, ZB])
+    kept = compositional_histogram_cutoff(str(d), [ZA, ZB], histogram_cutoff=3,
+                                          num_bins=4)
+    # reference semantics keep at most cutoff-1 samples per bin (:61-65)
+    assert sum(k.startswith("half") for k in kept) == 2
+    assert sum(k.startswith("quarter") for k in kept) == 2
+    out = str(d) + "_histogram_cutoff"
+    assert sorted(os.listdir(out)) == sorted(kept)
+    # second run without overwrite refuses instead of silently mixing
+    with pytest.raises(FileExistsError):
+        compositional_histogram_cutoff(str(d), [ZA, ZB], 3, 4)
