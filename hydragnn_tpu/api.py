@@ -197,9 +197,13 @@ def _(config: str, datasets=None, verbosity: Optional[int] = None):
 @run_training.register
 def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     """(reference: run_training.py:62-182)"""
+    from .parallel import setup_distributed
     from .utils import MetricsWriter, Timer, print_timers, setup_log
     from .utils import tracer as tr
 
+    # multi-host rendezvous first — before anything touches the XLA backend
+    # (reference: run_training.py:71 calls setup_ddp before load/model)
+    setup_distributed()
     # fresh per-run accumulators (class/module-level state would otherwise
     # report cumulative totals across repeated runs in one process)
     Timer.reset()
@@ -311,6 +315,9 @@ def _(config: str, model_state=None, datasets=None):
 def _(config: dict, model_state=None, datasets=None):
     """(reference: run_prediction.py:49-107): rebuild model, restore latest
     checkpoint, evaluate on the test split, optionally denormalize."""
+    from .parallel import setup_distributed
+
+    setup_distributed()  # (reference: run_prediction.py:56)
     config, loaders, mm = prepare_data(config, datasets)
     _, _, test_loader = loaders
     model = create_model(config)

@@ -41,6 +41,17 @@ def read_lsms_file(path: str) -> Tuple[float, np.ndarray, List[str]]:
     return total_energy, atoms, lines
 
 
+def _lsms_files(dir: str) -> List[str]:
+    """Sorted LSMS sample filenames: regular files only, skipping the
+    ``.bulk`` sidecar files real LSMS datasets contain (same filter as the
+    raw loader, data/raw.py / reference: raw_dataset_loader.py)."""
+    return sorted(
+        f
+        for f in os.listdir(dir)
+        if os.path.isfile(os.path.join(dir, f)) and not f.endswith(".bulk")
+    )
+
+
 def _read_energy_and_z(path: str) -> Tuple[float, np.ndarray]:
     """Header energy + Z column only — cheap first-pass parse."""
     with open(path, encoding="utf-8") as f:
@@ -151,7 +162,7 @@ def convert_total_energy_to_formation_gibbs(
     os.makedirs(new_dir)
 
     elements_list = sorted(elements_list)
-    all_files = sorted(os.listdir(dir))
+    all_files = _lsms_files(dir)
 
     # pass 1: per-atom energies of the pure-element configurations (:52-63).
     # Light parse (header + Z column only) — the full atom table is only
@@ -211,6 +222,8 @@ def _plot_conversion(result: GibbsConversionResult) -> None:
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
+    # plots live next to the converted data, so runs on different datasets
+    # from one cwd don't overwrite each other
     for fname, xs, ys, xl, yl in (
         ("linear_mixing_energy.png", result.total_energies,
          result.linear_mixing_energies, "Total energy (Rydberg)",
@@ -226,7 +239,7 @@ def _plot_conversion(result: GibbsConversionResult) -> None:
         plt.scatter(xs, ys, edgecolor="b", facecolor="none")
         plt.xlabel(xl)
         plt.ylabel(yl)
-        plt.savefig(fname)
+        plt.savefig(os.path.join(result.output_dir, fname))
         plt.close()
 
 
@@ -268,7 +281,7 @@ def compositional_histogram_cutoff(
 
     kept: List[str] = []
     bin_counts = np.zeros(num_bins, np.int64)
-    for filename in sorted(os.listdir(dir)):
+    for filename in _lsms_files(dir):
         path = os.path.join(dir, filename)
         _, zs = _read_energy_and_z(path)
         comp, _, _ = _binary_composition(zs, elements_list)

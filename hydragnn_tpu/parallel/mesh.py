@@ -26,6 +26,7 @@ and DCN across slices, chosen by XLA from the mesh axis order.
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -102,11 +103,16 @@ def shard_optimizer_state(state, mesh: Mesh, min_size: int = 1024):
     return jax.tree_util.tree_map(place, state)
 
 
-def local_host_info() -> Tuple[int, int]:
-    """(host_count, host_index) for data sharding across hosts; honours the
-    scheduler envs the reference parses (SLURM/OMPI, distributed.py:86-103)."""
-    if jax.process_count() > 1:
-        return jax.process_count(), jax.process_index()
+def _scheduler_host_info() -> Tuple[int, int]:
+    """(host_count, host_index) from scheduler envs only — safe before the
+    XLA backend exists (the reference parses the same envs, SLURM/OMPI,
+    distributed.py:86-103)."""
+    # Cloud TPU pod VMs expose the slice topology in TPU_* envs. A
+    # single-name value (e.g. "localhost" on one-host setups) carries no
+    # multi-host information — fall through to the scheduler envs then.
+    hosts = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+    if len(hosts) > 1:
+        return len(hosts), int(os.environ.get("TPU_WORKER_ID", 0))
     for count_key, rank_key in (
         ("SLURM_NTASKS", "SLURM_PROCID"),
         ("OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"),
@@ -117,17 +123,44 @@ def local_host_info() -> Tuple[int, int]:
     return 1, 0
 
 
+def local_host_info() -> Tuple[int, int]:
+    """(host_count, host_index) for data sharding across hosts: the live JAX
+    distributed runtime when attached, scheduler envs otherwise."""
+    if jax.process_count() > 1:
+        return jax.process_count(), jax.process_index()
+    return _scheduler_host_info()
+
+
 def setup_distributed() -> None:
     """Initialize the multi-host JAX runtime when launched under a scheduler
     (the analog of setup_ddp's rendezvous, distributed.py:119-198). No-op for
-    single-process runs."""
-    if jax.process_count() > 1:
+    single-process runs.
+
+    Rendezvous resolution order (cf. the reference's master-addr discovery
+    for Summit/SLURM, distributed.py:143-159):
+    1. explicit ``HYDRAGNN_COORDINATOR`` / ``JAX_COORDINATOR_ADDRESS`` plus
+       the scheduler's world size/rank envs,
+    2. bare ``jax.distributed.initialize()`` auto-detection — covers GCE TPU
+       pods (metadata server) and SLURM/OpenMPI clusters JAX knows natively.
+
+    Must run before anything touches the XLA backend — including
+    ``jax.process_count()`` — so the already-initialized guard uses
+    ``jax.distributed.is_initialized()``, which doesn't.
+    """
+    if jax.distributed.is_initialized():
         return
     coord = os.environ.get("HYDRAGNN_COORDINATOR") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
-    count, index = local_host_info()
-    if coord and count > 1:
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=count, process_id=index
-        )
+    count, index = _scheduler_host_info()
+    try:
+        if coord and count > 1:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=count, process_id=index
+            )
+        elif count > 1:
+            jax.distributed.initialize()
+    except RuntimeError as e:
+        # the XLA backend was touched before run_training (interactive use,
+        # tests): train single-host rather than crash, but say so
+        warnings.warn(f"multi-host rendezvous skipped: {e}")

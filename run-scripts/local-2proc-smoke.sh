@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# 2-process CPU validation of the multihost path on one machine — the
+# launchable twin of tests/test_multihost.py (the analog of the reference
+# CI's `mpirun -n 2 --oversubscribe pytest --with-mpi` tier,
+# reference: .github/workflows/CI.yml:63).
+#
+#   ./run-scripts/local-2proc-smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+JAX_PLATFORMS=cpu python -m pytest tests/test_multihost.py -q

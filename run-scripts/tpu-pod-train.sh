@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Launch a training driver on every worker of a Cloud TPU pod slice.
+# The analog of the reference's Frontier job scripts
+# (reference: run-scripts/SC25-multibranch.sh) for GCE TPU VMs: the same
+# command runs on all workers; jax.distributed.initialize() auto-detects
+# the pod topology from the metadata server, so no explicit coordinator is
+# needed (hydragnn_tpu.parallel.setup_distributed falls through to bare
+# initialize()).
+#
+#   ./run-scripts/tpu-pod-train.sh TPU_NAME ZONE DRIVER [ARGS...]
+#   ./run-scripts/tpu-pod-train.sh gfm-v5p-128 us-east5-a examples/multibranch/train.py --epochs 10
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?gce zone}
+DRIVER=${3:?training driver .py}
+shift 3
+
+REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --zone "${ZONE}" \
+  --worker=all \
+  --command "cd ${REPO_DIR} && \
+    HYDRAGNN_VALTEST=${HYDRAGNN_VALTEST:-1} \
+    HYDRAGNN_TRACE_LEVEL=${HYDRAGNN_TRACE_LEVEL:-0} \
+    python ${DRIVER} $*"
