@@ -158,29 +158,52 @@ def prepare_data(
     training = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
     batch_size = training["batch_size"]
+    # multi-host: each process loads a disjoint 1/host_count slice
+    # (DistributedSampler semantics) and stacks one shard per local device
+    # for the global-mesh DP step (docs/MULTIHOST.md)
+    import jax
+
+    from .parallel import local_host_info
+
+    host_count, host_index = local_host_info()
+    num_shards = jax.local_device_count() if jax.process_count() > 1 else 1
+    if batch_size % num_shards != 0:
+        raise ValueError(
+            f"Training.batch_size {batch_size} must be divisible by the "
+            f"{num_shards} local devices on multi-host runs"
+        )
     # bucketed pad specs when graph sizes vary (SURVEY §5.7): a few jit
     # specializations instead of one worst-case padding for every batch
     # (default set by update_config)
     num_buckets = int(training["num_pad_buckets"])
     spec = SpecLadder.for_dataset(
         trainset + valset + testset,
-        batch_size,
+        batch_size // num_shards,
         num_buckets=num_buckets,
         with_triplets=arch["mpnn_type"] == "DimeNet",
+    )
+    shard_kw = dict(
+        spec=spec,
+        host_count=host_count,
+        host_index=host_index,
+        num_shards=num_shards,
     )
     train_loader = GraphLoader(
         trainset,
         batch_size,
-        spec=spec,
         shuffle=True,
         seed=0,
         # RandomSampler-with-replacement / fixed-draw modes
         # (reference: load_data.py:237-274)
         oversampling=bool(training.get("oversampling", False)),
         num_samples=training.get("num_samples"),
+        # multi-host batches must stay full so every process steps in
+        # lockstep with identical shard shapes
+        drop_last=jax.process_count() > 1,
+        **shard_kw,
     )
-    val_loader = GraphLoader(valset, batch_size, spec=spec, shuffle=False)
-    test_loader = GraphLoader(testset, batch_size, spec=spec, shuffle=False)
+    val_loader = GraphLoader(valset, batch_size, shuffle=False, **shard_kw)
+    test_loader = GraphLoader(testset, batch_size, shuffle=False, **shard_kw)
     return config, (train_loader, val_loader, test_loader), mm
 
 
@@ -214,16 +237,26 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     verbosity = (
         verbosity if verbosity is not None else config["Verbosity"].get("level", 0)
     )
+    import jax
+    import numpy as np
+
     log_name = get_log_name_config(config)
     if verbosity > 0:
         setup_log(log_name)
-    save_config(config, log_name)
+    if jax.process_index() == 0:
+        # rank-0 config dump (reference: save_config, config_utils.py:352-358)
+        save_config(config, log_name)
 
+    multihost = jax.process_count() > 1
     training = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
     with Timer("create_model"):
         model = create_model(config)
-        variables = init_model(model, next(iter(train_loader)), seed=0)
+        sample = next(iter(train_loader))
+        if multihost:
+            # loader emits stacked [local_shards, ...] batches: init on one
+            sample = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
+        variables = init_model(model, sample, seed=0)
     tx = make_optimizer(
         training["Optimizer"],
         freeze_conv=bool(arch.get("freeze_conv_layers", False)),
@@ -239,11 +272,11 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
 
     # ZeRO-1 analog (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
     # hydragnn/utils/optimizer/optimizer.py:43-113): shard the large optimizer
-    # moments over the data axis of a device mesh; params stay replicated
-    if training["Optimizer"].get("use_zero_redundancy", False):
-        import jax as _jax
-
-        if len(_jax.devices()) > 1:
+    # moments over the data axis of a device mesh; params stay replicated.
+    # Single-host only: the multi-host shard_map step declares the whole
+    # state replicated, which a ZeRO-sharded opt_state would contradict.
+    if training["Optimizer"].get("use_zero_redundancy", False) and not multihost:
+        if len(jax.devices()) > 1:
             from .parallel import make_mesh, replicate_state, shard_optimizer_state
 
             mesh = make_mesh()
@@ -251,6 +284,26 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             state = state.replace(
                 opt_state=shard_optimizer_state(state.opt_state, mesh)
             )
+
+    # multi-host DP: shard_map the step over the global (branch, data) mesh —
+    # gradients psum across hosts over ICI/DCN, each process feeding the
+    # shards its own host-sharded loader built (docs/MULTIHOST.md)
+    step_fn = eval_fn = None
+    if multihost:
+        from .parallel import make_mesh, promote_batch, replicate_state
+        from .parallel.dp import (
+            make_parallel_eval_step,
+            make_parallel_train_step,
+        )
+
+        mesh = make_mesh()
+        state = replicate_state(state, mesh)
+        cge = training.get("compute_grad_energy", False)
+        _pstep = make_parallel_train_step(model, tx, mesh, cge)
+        _peval = make_parallel_eval_step(model, mesh, cge)
+        step_fn = lambda s, b, r: _pstep(s, promote_batch(b, mesh), r)
+        # evaluate() expects (tot, tasks, aux) like make_eval_step
+        eval_fn = lambda s, b: _peval(s, promote_batch(b, mesh)) + (None,)
 
     writer = MetricsWriter(log_name)
 
@@ -276,11 +329,17 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 verbosity=verbosity,
                 save_fn=save_fn,
                 log_fn=log_fn,
+                step_fn=step_fn,
+                eval_fn=eval_fn,
             )
     finally:
         writer.close()
+    if multihost:
+        # localize the replicated global-mesh state so downstream consumers
+        # (checkpoint serialization, single-host prediction) see host arrays
+        state = jax.tree_util.tree_map(lambda x: np.asarray(x), state)
     save_model(state, log_name)
-    if config.get("Visualization", {}).get("create_plots"):
+    if config.get("Visualization", {}).get("create_plots") and jax.process_index() == 0:
         # parity/error/history plots (reference: train_validate_test.py:100-126,
         # 268-313 drives postprocess/visualizer.py)
         from .postprocess import Visualizer

@@ -42,14 +42,11 @@ def read_lsms_file(path: str) -> Tuple[float, np.ndarray, List[str]]:
 
 
 def _lsms_files(dir: str) -> List[str]:
-    """Sorted LSMS sample filenames: regular files only, skipping the
-    ``.bulk`` sidecar files real LSMS datasets contain (same filter as the
-    raw loader, data/raw.py / reference: raw_dataset_loader.py)."""
-    return sorted(
-        f
-        for f in os.listdir(dir)
-        if os.path.isfile(os.path.join(dir, f)) and not f.endswith(".bulk")
-    )
+    """Sorted LSMS sample filenames — one filtering rule shared with the
+    raw loaders (data/raw.py: raw_sample_files)."""
+    from .raw import raw_sample_files
+
+    return raw_sample_files(dir)
 
 
 def _read_energy_and_z(path: str) -> Tuple[float, np.ndarray]:

@@ -260,6 +260,11 @@ class GraphLoader:
                 rng.shuffle(idx)
             if self.num_samples is not None:
                 idx = idx[: self.num_samples]
+        if self.host_count > 1:
+            # equal shard sizes on every host, so multi-host training steps
+            # stay in lockstep (a one-sample imbalance would leave one host
+            # issuing an extra collective and deadlock the others)
+            idx = idx[: len(idx) // self.host_count * self.host_count]
         return idx[self.host_index :: self.host_count]
 
     def __iter__(self) -> Iterator[GraphBatch]:

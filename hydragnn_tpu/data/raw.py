@@ -209,6 +209,17 @@ _LOADERS = {"LSMS": load_lsms_file, "XYZ": load_xyz_file, "CFG": load_cfg_file}
 _EXTS = {"XYZ": (".xyz", ".extxyz"), "CFG": (".cfg",)}
 
 
+def raw_sample_files(path: str) -> List[str]:
+    """Sorted raw-sample filenames under ``path``: regular files only,
+    skipping ``.bulk`` sidecars (shared by the loaders here and the LSMS
+    physics utilities in data/lsms.py)."""
+    return sorted(
+        name
+        for name in os.listdir(path)
+        if os.path.isfile(os.path.join(path, name)) and not name.endswith(".bulk")
+    )
+
+
 def load_raw_dataset(path: str, fmt: str, **loader_kwargs) -> List[Graph]:
     """Load every raw file under ``path`` with the format's parser
     (reference: AbstractRawDataLoader.load_raw_data,
@@ -218,13 +229,10 @@ def load_raw_dataset(path: str, fmt: str, **loader_kwargs) -> List[Graph]:
     fmt = fmt.upper()
     loader = _LOADERS[fmt]
     graphs = []
-    for name in sorted(os.listdir(path)):
-        full = os.path.join(path, name)
-        if not os.path.isfile(full) or name.endswith(".bulk"):
-            continue
+    for name in raw_sample_files(path):
         if fmt in _EXTS and not name.lower().endswith(_EXTS[fmt]):
             continue
-        graphs.append(loader(full, **loader_kwargs))
+        graphs.append(loader(os.path.join(path, name), **loader_kwargs))
     with_y = [g.graph_y is not None for g in graphs]
     if any(with_y) and not all(with_y):
         missing = [i for i, w in enumerate(with_y) if not w][:5]

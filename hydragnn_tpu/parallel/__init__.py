@@ -4,6 +4,7 @@ from .mesh import (
     batch_sharding,
     local_host_info,
     make_mesh,
+    promote_batch,
     replicate_state,
     replicated,
     setup_distributed,
@@ -17,6 +18,7 @@ __all__ = [
     "batch_sharding",
     "local_host_info",
     "make_mesh",
+    "promote_batch",
     "replicate_state",
     "replicated",
     "setup_distributed",

@@ -76,6 +76,26 @@ def shard_batch(batch, mesh: Mesh):
     return jax.tree_util.tree_map(place, batch)
 
 
+def promote_batch(batch, mesh: Mesh):
+    """Host-local stacked GraphBatch ``[local_shards, ...]`` -> global array
+    ``[global_shards, ...]`` sharded over the mesh's (branch, data) leading
+    axis — the multi-controller input path: each process contributes the
+    shards its own ``GraphLoader(host_count, host_index)`` built, and the
+    shard_map'd step sees one coherent global batch (the DistributedSampler
+    + DDP input contract, reference: load_data.py:256-274).
+
+    No-op on single-process runs (the batch is already addressable).
+    """
+    if jax.process_count() == 1:
+        return batch
+    sharding = batch_sharding(mesh)
+
+    def prom(x):
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(prom, batch)
+
+
 def replicate_state(state, mesh: Mesh):
     rep = replicated(mesh)
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), state)
@@ -123,11 +143,21 @@ def _scheduler_host_info() -> Tuple[int, int]:
     return 1, 0
 
 
+# set when setup_distributed had to skip rendezvous (backend already
+# initialized): the scheduler envs then over-report the connected world
+_rendezvous_skipped = False
+
+
 def local_host_info() -> Tuple[int, int]:
     """(host_count, host_index) for data sharding across hosts: the live JAX
-    distributed runtime when attached, scheduler envs otherwise."""
+    distributed runtime when attached, scheduler envs otherwise. After a
+    skipped rendezvous this reports (1, 0) — the process really is alone, so
+    sharding by the scheduler's world size would silently train on a
+    fraction of the data with no gradient sync."""
     if jax.process_count() > 1:
         return jax.process_count(), jax.process_index()
+    if _rendezvous_skipped:
+        return 1, 0
     return _scheduler_host_info()
 
 
@@ -161,6 +191,13 @@ def setup_distributed() -> None:
         elif count > 1:
             jax.distributed.initialize()
     except RuntimeError as e:
+        if "must be called before" not in str(e):
+            # genuine rendezvous failure (unreachable coordinator, mismatch):
+            # abort — N silently-independent "replicas" would clobber shared
+            # checkpoints and fake the scaling result
+            raise
         # the XLA backend was touched before run_training (interactive use,
         # tests): train single-host rather than crash, but say so
+        global _rendezvous_skipped
+        _rendezvous_skipped = True
         warnings.warn(f"multi-host rendezvous skipped: {e}")

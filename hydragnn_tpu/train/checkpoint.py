@@ -27,7 +27,16 @@ def save_model(
     state: TrainState, log_name: str, path: str = "./logs", epoch: Optional[int] = None
 ) -> str:
     """Serialize state; per-epoch filename + 'latest' pointer file
-    (reference: model.py:63-106, HYDRAGNN_EPOCH env drives per-epoch names)."""
+    (reference: model.py:63-106, HYDRAGNN_EPOCH env drives per-epoch names).
+
+    Rank-gated: on multi-host runs only process 0 writes — every process
+    holds identical replicated state, and concurrent writers on a shared
+    filesystem would corrupt the file (reference: rank-0 save, model.py:63-75).
+    """
+    import jax
+
+    if jax.process_index() != 0:
+        return ""
     if epoch is None:
         env = os.getenv("HYDRAGNN_EPOCH")
         epoch = int(env) if env is not None else None

@@ -181,20 +181,26 @@ def train_validate_test(
     seed: int = 0,
     save_fn: Optional[Callable[[TrainState], None]] = None,
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
+    step_fn: Optional[Callable] = None,
+    eval_fn: Optional[Callable] = None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Outer epoch loop (reference: train_validate_test.py:52-264).
 
     Returns the final state and the loss history. ``HYDRAGNN_VALTEST=0``
     skips val/test epochs (reference :179); ``HYDRAGNN_MAX_NUM_BATCH`` caps
-    timed batches (reference :46-47).
+    timed batches (reference :46-47). ``step_fn``/``eval_fn`` override the
+    default single-host jitted steps (used by the multi-host mesh path,
+    api.py).
     """
     training = config["NeuralNetwork"]["Training"]
     num_epoch = training["num_epoch"]
     do_valtest = os.getenv("HYDRAGNN_VALTEST", "1") != "0"
 
     compute_grad_energy = training.get("compute_grad_energy", False)
-    step_fn = make_train_step(model, tx, compute_grad_energy)
-    eval_fn = make_eval_step(model, compute_grad_energy)
+    if step_fn is None:
+        step_fn = make_train_step(model, tx, compute_grad_energy)
+    if eval_fn is None:
+        eval_fn = make_eval_step(model, compute_grad_energy)
     scheduler = ReduceLROnPlateau()
     stopper = (
         EarlyStopping(patience=training.get("patience", 10))

@@ -18,10 +18,14 @@ shift 3
 
 REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
 
+# printf %q re-quotes driver args so spaces/quotes survive the remote shell
+ARGS=$(printf '%q ' "$@")
+
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
   --zone "${ZONE}" \
   --worker=all \
   --command "cd ${REPO_DIR} && \
+    ${HYDRAGNN_COORDINATOR:+HYDRAGNN_COORDINATOR=${HYDRAGNN_COORDINATOR}} \
     HYDRAGNN_VALTEST=${HYDRAGNN_VALTEST:-1} \
     HYDRAGNN_TRACE_LEVEL=${HYDRAGNN_TRACE_LEVEL:-0} \
-    python ${DRIVER} $*"
+    python ${DRIVER} ${ARGS}"

@@ -20,11 +20,15 @@ REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
 
 echo "strong scaling: EBS=${EFFECTIVE_BATCH_SIZE} hosts=${NUM_HOSTS} per-host bs=${PER_HOST_BS}"
 
+# printf %q re-quotes driver args so spaces/quotes survive the remote shell
+ARGS=$(printf '%q ' "$@")
+
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
   --zone "${ZONE}" \
   --worker=all \
   --command "cd ${REPO_DIR} && \
+    ${HYDRAGNN_COORDINATOR:+HYDRAGNN_COORDINATOR=${HYDRAGNN_COORDINATOR}} \
     HYDRAGNN_VALTEST=0 \
     HYDRAGNN_MAX_NUM_BATCH=${HYDRAGNN_MAX_NUM_BATCH:-5} \
     HYDRAGNN_TRACE_LEVEL=${HYDRAGNN_TRACE_LEVEL:-1} \
-    python ${DRIVER} --batch_size ${PER_HOST_BS} $*"
+    python ${DRIVER} --batch_size ${PER_HOST_BS} ${ARGS}"

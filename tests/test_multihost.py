@@ -16,7 +16,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _CHILD = textwrap.dedent(
     """
     import os, sys
-    sys.path.insert(0, {repo!r})
+    sys.path.insert(0, __REPO__)
     import numpy as np
 
     # rendezvous through the framework entry point (not jax directly):
@@ -83,6 +83,53 @@ _CHILD = textwrap.dedent(
     got = float(np.asarray(total.addressable_data(0)))
     assert got == 8 * 1.0 + 8 * 2.0, got
 
+    # end-to-end: run_training over the global 16-device (2-host) mesh —
+    # host-sharded loaders, shard_map DP step, psum'd grads, rank-0 save
+    from hydragnn_tpu.api import run_training
+
+    cfg = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "mh_ci",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 60},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1],
+                              "column_index": [0, 6, 7]},
+            "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1],
+                               "column_index": [0]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "radius": 2.0, "max_neighbours": 100,
+                "hidden_dim": 8, "num_conv_layers": 2, "task_weights": [1.0],
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                            "dim_sharedlayers": 8,
+                                            "num_headlayers": 2,
+                                            "dim_headlayers": [8, 8]}},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"], "output_index": [0],
+                "type": ["graph"], "denormalize_output": False,
+            },
+            "Training": {"num_epoch": 3, "batch_size": 16,
+                          "Optimizer": {"type": "AdamW",
+                                         "learning_rate": 0.02}},
+        },
+    }
+    model, state, hist, cfg_out, loaders, mm = run_training(cfg)
+    assert len(hist["train"]) == 3
+    assert all(np.isfinite(v) for v in hist["train"]), hist["train"]
+    assert hist["train"][-1] < hist["train"][0], hist["train"]
+    # both hosts computed identical psum'd losses (lockstep check)
+    agreed = multihost_utils.process_allgather(
+        np.asarray(hist["train"], np.float64)
+    )
+    np.testing.assert_allclose(agreed[0], agreed[1], rtol=1e-6)
+    # rank-0-only checkpoint
+    ckpt_exists = os.path.isdir(os.path.join(os.getcwd(), "logs"))
+    assert ckpt_exists == (host_index == 0), (host_index, ckpt_exists)
+
     print("MULTIHOST_OK", host_index)
     """
 )
@@ -93,7 +140,7 @@ def pytest_two_process_distributed(tmp_path):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     script = tmp_path / "child.py"
-    script.write_text(_CHILD.format(repo=_REPO))
+    script.write_text(_CHILD.replace("__REPO__", repr(_REPO)))
     procs = []
     for rank in range(2):
         env = {
@@ -105,6 +152,8 @@ def pytest_two_process_distributed(tmp_path):
             # 8 virtual devices per process -> a 16-device global mesh
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
         }
+        rank_dir = tmp_path / f"rank{rank}"
+        rank_dir.mkdir()
         procs.append(
             subprocess.Popen(
                 [sys.executable, str(script)],
@@ -112,7 +161,7 @@ def pytest_two_process_distributed(tmp_path):
                 stderr=subprocess.STDOUT,
                 text=True,
                 env=env,
-                cwd=_REPO,
+                cwd=str(rank_dir),
             )
         )
     outs = []

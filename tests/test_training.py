@@ -5,6 +5,7 @@ train the full pipeline on BCC synthetic data and assert per-model RMSE /
 sample-MAE thresholds on the (normalized) test split.
 """
 
+import os
 import numpy as np
 import pytest
 
@@ -12,7 +13,17 @@ import hydragnn_tpu
 from hydragnn_tpu.api import run_prediction, run_training
 
 
+# Fast CI tier: HYDRAGNN_CI_FAST=1 runs the same full 13-model matrix with
+# half the epochs and 2x-relaxed thresholds — still fails on broken models
+# (errors on normalized targets sit near 1.0 when learning is broken) but
+# finishes the whole suite in minutes (VERDICT r1 next-steps #10).
+_FAST = os.getenv("HYDRAGNN_CI_FAST") == "1"
+
+
 def make_config(mpnn_type, heads="single", num_epoch=40, num_configs=150, **arch_over):
+    if _FAST:
+        num_epoch = max(num_epoch // 2, 10)
+        num_configs = min(num_configs, 100)
     arch = {
         "mpnn_type": mpnn_type,
         "radius": 2.0,
@@ -119,6 +130,8 @@ def _check_thresholds(config, tmp_path, monkeypatch):
     tot, tasks, preds, trues = run_prediction(cfg, model_state=state)
     mpnn = config["NeuralNetwork"]["Architecture"]["mpnn_type"]
     thr_rmse, thr_mae = THRESHOLDS[mpnn]
+    if _FAST:
+        thr_rmse, thr_mae = 2.0 * thr_rmse, 2.0 * thr_mae
     for name in preds:
         err = preds[name] - trues[name]
         rmse = float(np.sqrt(np.mean(err**2)))
