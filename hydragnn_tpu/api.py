@@ -37,6 +37,21 @@ from .train.optimizer import make_optimizer
 from .train.state import TrainState
 
 
+def _localize_loader(loader: GraphLoader) -> GraphLoader:
+    """Unstacked single-host view of a (possibly device-stacked) loader —
+    prediction/visualization run per host with the plain jitted eval step,
+    which expects batches without the leading device axis."""
+    if loader.num_shards == 1:
+        return loader
+    return GraphLoader(
+        loader.graphs,
+        loader.batch_size,
+        shuffle=False,
+        host_count=loader.host_count,
+        host_index=loader.host_index,
+    )
+
+
 def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
     """Dataset from config. Formats: 'synthetic' (deterministic BCC fixture,
     the analog of the reference's unit_test format) and 'pickle'
@@ -275,6 +290,13 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # moments over the data axis of a device mesh; params stay replicated.
     # Single-host only: the multi-host shard_map step declares the whole
     # state replicated, which a ZeRO-sharded opt_state would contradict.
+    if training["Optimizer"].get("use_zero_redundancy", False) and multihost:
+        import warnings
+
+        warnings.warn(
+            "use_zero_redundancy is ignored on multi-host runs: the "
+            "shard_map DP step keeps optimizer state replicated"
+        )
     if training["Optimizer"].get("use_zero_redundancy", False) and not multihost:
         if len(jax.devices()) > 1:
             from .parallel import make_mesh, replicate_state, shard_optimizer_state
@@ -347,7 +369,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         _, _, preds, trues = test_model(
             model,
             state,
-            test_loader,
+            _localize_loader(test_loader),
             compute_grad_energy=config["NeuralNetwork"]["Training"].get(
                 "compute_grad_energy", False
             ),
@@ -356,6 +378,12 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         viz.create_scatter_plots(trues, preds)
         viz.create_error_histograms(trues, preds)
         viz.plot_history(hist)
+        for name in trues:
+            arr = np.asarray(trues[name])
+            if name == "forces" or (arr.ndim == 2 and arr.shape[-1] == 3):
+                viz.create_parity_plot_per_node_vector(name, trues[name], preds[name])
+            else:
+                viz.create_plot_global_analysis(name, trues[name], preds[name])
     print_timers(verbosity)
     return model, state, hist, config, loaders, mm
 
@@ -379,6 +407,8 @@ def _(config: dict, model_state=None, datasets=None):
     setup_distributed()  # (reference: run_prediction.py:56)
     config, loaders, mm = prepare_data(config, datasets)
     _, _, test_loader = loaders
+    # prediction is per-host (plain jitted eval): drop any device stacking
+    test_loader = _localize_loader(test_loader)
     model = create_model(config)
     if model_state is None:
         variables = init_model(model, next(iter(test_loader)), seed=0)

@@ -58,6 +58,8 @@ def radius_graph_pbc(
     radius: float,
     max_neighbours: Optional[int] = None,
     pbc: Tuple[bool, bool, bool] = (True, True, True),
+    max_attempts: int = 3,
+    radius_multiplier: float = 1.25,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Radius graph under periodic boundary conditions.
 
@@ -68,8 +70,45 @@ def radius_graph_pbc(
     ``pos[s] + shift - pos[r]`` is the true minimum-image displacement
     (the reference stores the same as ``edge_shifts``).
 
+    When some node receives no edge, the radius is expanded by
+    ``radius_multiplier`` and the build retried up to ``max_attempts``
+    times; nodes still isolated after the last attempt get one artificial
+    in-edge from a deterministic partner node (reference retry + fallback:
+    graph_samples_checks_and_updates.py:163-222,284-307 — the reference
+    picks the artificial partner with np.random; here the partner is
+    ``(i + 1) % n`` so rebuilds are reproducible).
+
     Returns (senders, receivers, edge_shifts[e,3]).
     """
+    n = np.asarray(pos).shape[0]
+    r = float(radius)
+    for attempt in range(max_attempts):
+        senders, receivers, shifts = _radius_graph_pbc_once(
+            pos, cell, r, max_neighbours, pbc
+        )
+        if np.unique(receivers).size == n:
+            return senders, receivers, shifts
+        if attempt < max_attempts - 1:
+            r *= radius_multiplier
+    # artificial fallback edges for still-isolated receivers
+    missing = np.setdiff1d(np.arange(n), np.unique(receivers))
+    add_s = np.array([(m + 1) % n if n > 1 else 0 for m in missing], np.int32)
+    senders = np.concatenate([senders, add_s])
+    receivers = np.concatenate([receivers, missing.astype(np.int32)])
+    shifts = np.concatenate(
+        [shifts, np.zeros((missing.size, 3), shifts.dtype)], axis=0
+    )
+    return senders, receivers, shifts
+
+
+def _radius_graph_pbc_once(
+    pos: np.ndarray,
+    cell: np.ndarray,
+    radius: float,
+    max_neighbours: Optional[int],
+    pbc: Tuple[bool, bool, bool],
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One PBC radius-graph build at a fixed radius."""
     pos = np.asarray(pos, np.float64)
     cell = np.asarray(cell, np.float64).reshape(3, 3)
     n = pos.shape[0]

@@ -1,8 +1,8 @@
-"""Result visualization: parity plots, error histograms, loss history
-(reference: hydragnn/postprocess/visualizer.py:24-742, trimmed to the plots
-the train loop actually drives: create_scatter_plots, plot_history,
-create_error_histograms). matplotlib is imported lazily so headless
-installs without it still train."""
+"""Result visualization (reference: hydragnn/postprocess/visualizer.py:24-742):
+parity scatter, error histograms, loss history, three-panel global analysis
+(scatter / conditional mean abs error / error PDF), vector and per-node
+vector parity, and the graph-size histogram. matplotlib is imported lazily
+so headless installs without it still train."""
 
 from __future__ import annotations
 
@@ -66,6 +66,117 @@ class Visualizer:
             fig.tight_layout()
             fig.savefig(os.path.join(self.outdir, f"error_hist_{name}.png"), dpi=120)
             plt.close(fig)
+
+    @staticmethod
+    def _cond_mean_abs_error(t: np.ndarray, p: np.ndarray, bins: int = 25):
+        """Mean |error| conditioned on the true value (reference:
+        __err_condmean, visualizer.py:93-104)."""
+        t = np.asarray(t, np.float64).ravel()
+        err = np.abs(np.asarray(p, np.float64).ravel() - t)
+        edges = np.linspace(t.min(), t.max() + 1e-12, bins + 1)
+        which = np.clip(np.digitize(t, edges) - 1, 0, bins - 1)
+        centers, means = [], []
+        for b in range(bins):
+            m = which == b
+            if m.any():
+                centers.append(0.5 * (edges[b] + edges[b + 1]))
+                means.append(float(err[m].mean()))
+        return np.asarray(centers), np.asarray(means)
+
+    def create_plot_global_analysis(
+        self,
+        varname: str,
+        true_values: np.ndarray,
+        predicted_values: np.ndarray,
+    ) -> None:
+        """Three-panel analysis of one output: parity scatter, conditional
+        mean absolute error vs the true value, and the error PDF
+        (reference: create_plot_global_analysis, visualizer.py:134-279;
+        the vector case adds magnitude and component-sum parity panels)."""
+        plt = _plt()
+        t = np.asarray(true_values, np.float64)
+        p = np.asarray(predicted_values, np.float64)
+        if t.ndim == 1:  # flat series = scalar output, one row per sample
+            t, p = t[:, None], p[:, None]
+        if t.shape[-1] <= 1:
+            fig, axs = plt.subplots(1, 3, figsize=(12, 3.6))
+            tr, pr = t.ravel(), p.ravel()
+            axs[0].scatter(tr, pr, s=4, alpha=0.5)
+            lo, hi = float(min(tr.min(), pr.min())), float(max(tr.max(), pr.max()))
+            axs[0].plot([lo, hi], [lo, hi], "k--", linewidth=1)
+            axs[0].set_title("Scalar output")
+            axs[0].set_xlabel("True")
+            axs[0].set_ylabel("Predicted")
+            xs, ys = self._cond_mean_abs_error(tr, pr)
+            axs[1].plot(xs, ys, "ro")
+            axs[1].set_title("Conditional mean abs. error")
+            axs[1].set_xlabel("True")
+            axs[1].set_ylabel("abs. error")
+            pdf, edges = np.histogram(pr - tr, bins=40, density=True)
+            axs[2].plot(0.5 * (edges[:-1] + edges[1:]), pdf, "ro")
+            axs[2].set_title("Error PDF")
+            axs[2].set_xlabel("Error")
+            axs[2].set_ylabel("PDF")
+        else:
+            # vector output: per-component parity + magnitude + sum
+            k = t.shape[-1]
+            fig, axs = plt.subplots(1, k + 2, figsize=(3.6 * (k + 2), 3.6))
+            for c in range(k):
+                axs[c].scatter(t[:, c], p[:, c], s=4, alpha=0.5)
+                axs[c].set_title(f"component {c}")
+                axs[c].set_xlabel("True")
+                axs[c].set_ylabel("Predicted")
+            tl, pl = np.linalg.norm(t, axis=-1), np.linalg.norm(p, axis=-1)
+            axs[k].scatter(tl, pl, s=4, alpha=0.5)
+            axs[k].set_title("magnitude")
+            ts, ps = t.sum(axis=-1), p.sum(axis=-1)
+            axs[k + 1].scatter(ts, ps, s=4, alpha=0.5)
+            axs[k + 1].set_title("component sum")
+        fig.suptitle(varname)
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, f"analysis_{varname}.png"), dpi=120)
+        plt.close(fig)
+
+    def create_parity_plot_per_node_vector(
+        self,
+        varname: str,
+        true_values: np.ndarray,
+        predicted_values: np.ndarray,
+        max_points: int = 5000,
+    ) -> None:
+        """Component-colored parity for nodal vector outputs (forces etc.;
+        reference: create_parity_plot_per_node_vector, visualizer.py:519-612)."""
+        plt = _plt()
+        t = np.asarray(true_values, np.float64).reshape(-1, 3)
+        p = np.asarray(predicted_values, np.float64).reshape(-1, 3)
+        if t.shape[0] > max_points:
+            sel = np.random.default_rng(0).choice(t.shape[0], max_points, False)
+            t, p = t[sel], p[sel]
+        fig, ax = plt.subplots(figsize=(4.5, 4.5))
+        for c, label in enumerate("xyz"):
+            ax.scatter(t[:, c], p[:, c], s=3, alpha=0.4, label=label)
+        lo, hi = float(min(t.min(), p.min())), float(max(t.max(), p.max()))
+        ax.plot([lo, hi], [lo, hi], "k--", linewidth=1)
+        ax.legend()
+        ax.set_xlabel(f"true {varname}")
+        ax.set_ylabel(f"predicted {varname}")
+        fig.tight_layout()
+        fig.savefig(
+            os.path.join(self.outdir, f"parity_pernode_{varname}.png"), dpi=120
+        )
+        plt.close(fig)
+
+    def num_nodes_plot(self, nodes_num_list: Sequence[int]) -> None:
+        """Histogram of graph sizes in the dataset (reference:
+        num_nodes_plot, visualizer.py:734-742)."""
+        plt = _plt()
+        fig, ax = plt.subplots(figsize=(4, 3))
+        ax.hist(np.asarray(list(nodes_num_list)), bins=30)
+        ax.set_xlabel("num nodes")
+        ax.set_ylabel("count")
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "num_nodes.png"), dpi=120)
+        plt.close(fig)
 
     def plot_history(self, hist: Dict[str, Sequence[float]]) -> None:
         """Loss curves (reference: visualizer.py plot_history)."""

@@ -19,7 +19,11 @@ shift 3
 REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
 
 # printf %q re-quotes driver args so spaces/quotes survive the remote shell
-ARGS=$(printf '%q ' "$@")
+# (guarded: printf with zero operands would emit a spurious '' argument)
+ARGS=""
+if [ "$#" -gt 0 ]; then
+  ARGS=$(printf '%q ' "$@")
+fi
 
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
   --zone "${ZONE}" \

@@ -21,7 +21,11 @@ REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
 echo "strong scaling: EBS=${EFFECTIVE_BATCH_SIZE} hosts=${NUM_HOSTS} per-host bs=${PER_HOST_BS}"
 
 # printf %q re-quotes driver args so spaces/quotes survive the remote shell
-ARGS=$(printf '%q ' "$@")
+# (guarded: printf with zero operands would emit a spurious '' argument)
+ARGS=""
+if [ "$#" -gt 0 ]; then
+  ARGS=$(printf '%q ' "$@")
+fi
 
 gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
   --zone "${ZONE}" \

@@ -74,6 +74,27 @@ def pytest_example_lsms(tmp_path):
     assert "MAE formation_gibbs_energy" in out
 
 
+def pytest_example_ising_model(tmp_path):
+    """Ising flow: lattice generation in LSMS format -> graph-energy
+    training (reference: examples/ising_model)."""
+    out = _run_example(
+        "examples/ising_model/ising_model.py",
+        "--num_configs", "40", "--num_epoch", "4", cwd=str(tmp_path),
+    )
+    assert "total_energy MAE" in out
+
+
+def pytest_example_open_catalyst(tmp_path):
+    """OC20-shaped energy+force flow through columnar storage
+    (reference: examples/open_catalyst_2020)."""
+    out = _run_example(
+        "examples/open_catalyst_2020/open_catalyst_2020.py",
+        "--num_samples", "24", "--num_epoch", "2", timeout=560,
+        cwd=str(tmp_path),
+    )
+    assert "force MAE" in out
+
+
 def pytest_example_multibranch():
     out = _run_example("examples/multibranch/train.py", "--epochs", "2")
     assert "epoch 1:" in out

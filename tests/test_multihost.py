@@ -130,6 +130,13 @@ _CHILD = textwrap.dedent(
     ckpt_exists = os.path.isdir(os.path.join(os.getcwd(), "logs"))
     assert ckpt_exists == (host_index == 0), (host_index, ckpt_exists)
 
+    # prediction localizes the device-stacked loader (per-host plain eval)
+    from hydragnn_tpu.api import run_prediction
+
+    tot, tasks, preds, trues = run_prediction(cfg_out, model_state=state)
+    assert np.isfinite(tot), tot
+    assert preds["sum_x_x2_x3"].shape == trues["sum_x_x2_x3"].shape
+
     print("MULTIHOST_OK", host_index)
     """
 )

@@ -1,0 +1,49 @@
+"""Radius-expansion retry + artificial-edge fallback for PBC graphs
+(reference: RadiusGraphPBC retry loop and _ensure_connected,
+graph_samples_checks_and_updates.py:163-222,284-307)."""
+
+import numpy as np
+
+from hydragnn_tpu.data import radius_graph_pbc
+
+
+def pytest_pbc_retry_expands_radius():
+    """Two atoms 1.5 apart with radius 1.3: the first attempt finds no
+    edges, one 1.25x expansion (-> 1.625) connects them."""
+    pos = np.array([[0.0, 0.0, 0.0], [1.5, 0.0, 0.0]])
+    cell = np.diag([20.0, 20.0, 20.0])
+    s, r, shifts = radius_graph_pbc(pos, cell, radius=1.3)
+    assert np.unique(r).size == 2
+    # real geometric edges, not artificial (shift 0, both directions)
+    assert set(zip(s.tolist(), r.tolist())) == {(0, 1), (1, 0)}
+
+
+def pytest_pbc_artificial_fallback():
+    """An atom too far for any expanded radius still ends with one
+    artificial in-edge, so every receiver appears in the graph."""
+    pos = np.array([[0.0, 0.0, 0.0], [1.0, 0.0, 0.0], [9.0, 9.0, 9.0]])
+    cell = np.diag([50.0, 50.0, 50.0])
+    s, r, shifts = radius_graph_pbc(pos, cell, radius=1.2)
+    assert np.unique(r).size == 3
+    # the isolated node's in-edge is artificial: zero shift, partner (i+1)%n
+    art = np.where(r == 2)[0]
+    assert art.size == 1
+    assert s[art[0]] == 0  # (2 + 1) % 3
+    np.testing.assert_array_equal(shifts[art[0]], [0.0, 0.0, 0.0])
+    # deterministic across rebuilds
+    s2, r2, _ = radius_graph_pbc(pos, cell, radius=1.2)
+    np.testing.assert_array_equal(s, s2)
+    np.testing.assert_array_equal(r, r2)
+
+
+def pytest_pbc_no_retry_when_connected():
+    """A dense periodic crystal connects on the first attempt at the
+    requested radius (no silent radius inflation)."""
+    cell = np.diag([4.0, 4.0, 4.0])
+    grid = np.array([(x, y, z) for x in range(2) for y in range(2)
+                     for z in range(2)], float) * 2.0
+    s, r, shifts = radius_graph_pbc(grid, cell, radius=2.5)
+    assert np.unique(r).size == 8
+    _, length = __import__("hydragnn_tpu.data.neighbors", fromlist=["x"]).\
+        edge_vectors_and_lengths(grid, s, r, shifts)
+    assert float(length.max()) <= 2.5 + 1e-6

@@ -211,3 +211,29 @@ def pytest_visualizer_outputs(tmp_path, monkeypatch):
     base = tmp_path / "logs" / "vizrun" / "plots"
     for f in ("parity_e.png", "error_hist_e.png", "history.png"):
         assert (base / f).exists()
+
+
+def pytest_visualizer_analysis_plots(tmp_path, monkeypatch):
+    """Global analysis (scalar + vector), per-node vector parity, and the
+    graph-size histogram (reference: visualizer.py:134-279,519-612,734-742)."""
+    monkeypatch.chdir(tmp_path)
+    rng = np.random.default_rng(0)
+    viz = Visualizer("vizrun2")
+    scalar = rng.normal(size=(64, 1))
+    viz.create_plot_global_analysis("energy", scalar, scalar + 0.05)
+    # flat (N,) series must route to the scalar branch, not N components
+    viz.create_plot_global_analysis("energy_flat", scalar.ravel(),
+                                    scalar.ravel() + 0.05)
+    vec = rng.normal(size=(40, 3))
+    viz.create_plot_global_analysis("dipole", vec, vec * 1.01)
+    viz.create_parity_plot_per_node_vector("forces", vec, vec + 0.02)
+    viz.num_nodes_plot([8, 8, 16, 16, 16, 32])
+    base = tmp_path / "logs" / "vizrun2" / "plots"
+    for f in (
+        "analysis_energy.png",
+        "analysis_energy_flat.png",
+        "analysis_dipole.png",
+        "parity_pernode_forces.png",
+        "num_nodes.png",
+    ):
+        assert (base / f).exists(), f
