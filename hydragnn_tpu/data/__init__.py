@@ -10,7 +10,12 @@ from .pipeline import (
 )
 from .columnar import ColumnarDataset, ColumnarWriter
 from .datasets import AbstractBaseDataset, SimplePickleDataset, SimplePickleWriter
-from .ddstore import DDStore, DistDataset
+from .ddstore import (
+    DDStore,
+    DistDataset,
+    MultiHostDistDataset,
+    RemoteStoreClient,
+)
 from .descriptors import atomic_descriptors, smiles_to_graph
 from .raw import (
     finalize_graphs,
@@ -53,6 +58,8 @@ __all__ = [
     "ColumnarWriter",
     "DDStore",
     "DistDataset",
+    "MultiHostDistDataset",
+    "RemoteStoreClient",
     "SimplePickleDataset",
     "SimplePickleWriter",
     "Graph",

@@ -6,9 +6,16 @@ window brackets train_validate_test.py:480-563).
 ``DDStore`` is the raw blob store (ctypes over the shared-memory arena);
 ``DistDataset`` wraps any dataset into it: every sample is serialized once
 into the per-host arena (by the creating process) and every loader process
-fetches one-sidedly by index. Cross-host scale-out is by per-host dataset
-shards (data/columnar.py) rather than the reference's MPI RMA window —
-on TPU pods each host only ever feeds its own devices.
+fetches one-sidedly by index.
+
+Cross-host scale-out has two modes:
+- per-host dataset shards (data/columnar.py): each host only ever reads its
+  own slice — the default on TPU pods;
+- ``MultiHostDistDataset``: each host pins only ``1/num_hosts`` of the
+  samples in RAM and fetches the rest from the owning host over the
+  length-prefixed TCP plane in the C++ store (the DCN analog of the
+  reference's MPI one-sided gets, distdataset.py:159-183), for datasets
+  larger than one host's memory under *global* shuffling.
 """
 
 from __future__ import annotations
@@ -25,6 +32,74 @@ from .datasets import AbstractBaseDataset
 from .graph import Graph
 
 
+_LIB = None
+
+
+def _load_lib():
+    """Build/load the native library once with every symbol typed."""
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    from ..native.build import build_library
+
+    lib = ctypes.CDLL(build_library("ddstore"))
+    lib.dds_unlink.restype = ctypes.c_int
+    lib.dds_unlink.argtypes = [ctypes.c_char_p]
+    lib.dds_open.restype = ctypes.c_void_p
+    lib.dds_open.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int,
+    ]
+    lib.dds_put.restype = ctypes.c_int
+    lib.dds_put.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.dds_get_size.restype = ctypes.c_int64
+    lib.dds_get_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dds_get.restype = ctypes.c_int64
+    lib.dds_get.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    for fn in ("dds_count", "dds_max_items", "dds_used_bytes", "dds_epoch"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    for fn in ("dds_epoch_begin", "dds_epoch_end"):
+        getattr(lib, fn).restype = None
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.dds_close.restype = None
+    lib.dds_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dds_serve_start.restype = ctypes.c_void_p
+    lib.dds_serve_start.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int64,
+    ]
+    lib.dds_serve_stop.restype = None
+    lib.dds_serve_stop.argtypes = [ctypes.c_void_p]
+    lib.dds_connect.restype = ctypes.c_void_p
+    lib.dds_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dds_fetch.restype = ctypes.c_int64
+    lib.dds_fetch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.dds_fetch_read.restype = ctypes.c_int64
+    lib.dds_fetch_read.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+    ]
+    lib.dds_disconnect.restype = None
+    lib.dds_disconnect.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
 class DDStore:
     """ctypes facade over the native shared-memory blob store."""
 
@@ -36,42 +111,7 @@ class DDStore:
         create: bool = True,
         overwrite: bool = False,
     ):
-        from ..native.build import build_library
-
-        lib = ctypes.CDLL(build_library("ddstore"))
-        lib.dds_unlink.restype = ctypes.c_int
-        lib.dds_unlink.argtypes = [ctypes.c_char_p]
-        lib.dds_open.restype = ctypes.c_void_p
-        lib.dds_open.argtypes = [
-            ctypes.c_char_p,
-            ctypes.c_int64,
-            ctypes.c_int64,
-            ctypes.c_int,
-        ]
-        lib.dds_put.restype = ctypes.c_int
-        lib.dds_put.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-        ]
-        lib.dds_get_size.restype = ctypes.c_int64
-        lib.dds_get_size.argtypes = [ctypes.c_void_p, ctypes.c_int64]
-        lib.dds_get.restype = ctypes.c_int64
-        lib.dds_get.argtypes = [
-            ctypes.c_void_p,
-            ctypes.c_int64,
-            ctypes.c_void_p,
-            ctypes.c_int64,
-        ]
-        for fn in ("dds_count", "dds_max_items", "dds_used_bytes", "dds_epoch"):
-            getattr(lib, fn).restype = ctypes.c_int64
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        for fn in ("dds_epoch_begin", "dds_epoch_end"):
-            getattr(lib, fn).restype = None
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        lib.dds_close.restype = None
-        lib.dds_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib = _load_lib()
         self._lib = lib
         self.name = name
         if create and overwrite:
@@ -123,7 +163,24 @@ class DDStore:
     def epoch_end(self) -> None:
         self._lib.dds_epoch_end(self._h)
 
+    def serve(self, port: int, id_offset: int = 0) -> None:
+        """Serve published slots on ``port``; wire ids are global
+        (local slot = id - id_offset). The accept loop runs on a C++
+        thread — no GIL involvement on the hot path."""
+        if getattr(self, "_server", None):
+            raise RuntimeError("already serving")
+        srv = self._lib.dds_serve_start(self._h, port, id_offset)
+        if not srv:
+            raise OSError(f"cannot listen on port {port}")
+        self._server = srv
+
+    def stop_serving(self) -> None:
+        if getattr(self, "_server", None):
+            self._lib.dds_serve_stop(self._server)
+            self._server = None
+
     def close(self, unlink: Optional[bool] = None) -> None:
+        self.stop_serving()
         if self._h:
             self._lib.dds_close(
                 self._h, 1 if (self._owner if unlink is None else unlink) else 0
@@ -133,6 +190,54 @@ class DDStore:
     def __del__(self):  # pragma: no cover - interpreter teardown
         try:
             self.close(unlink=False)
+        except Exception:
+            pass
+
+
+class RemoteStoreClient:
+    """Persistent TCP connection fetching blobs from a serving DDStore on
+    another host (the MPI one-sided get analog, distdataset.py:159-183).
+
+    Not thread-safe (the request/response protocol shares one socket and
+    one scratch buffer); fork-safe — a forked loader worker detects the
+    inherited connection via the pid and opens its own, so parent and
+    child never interleave requests on one fd.
+    """
+
+    def __init__(self, host: str, port: int):
+        self._lib = _load_lib()
+        self.host, self.port = host, port
+        self._c = None
+        self._connect()
+
+    def _connect(self) -> None:
+        self._c = self._lib.dds_connect(self.host.encode(), self.port)
+        self._pid = os.getpid()
+        if not self._c:
+            raise ConnectionError(f"cannot connect to {self.host}:{self.port}")
+
+    def get(self, global_id: int) -> bytes:
+        if os.getpid() != self._pid:
+            # inherited across fork: the parent still owns the old socket
+            self._connect()
+        n = self._lib.dds_fetch(self._c, global_id)
+        if n == -2:
+            raise ConnectionError(f"connection to {self.host}:{self.port} lost")
+        if n < 0:
+            raise KeyError(global_id)
+        buf = ctypes.create_string_buffer(int(n))
+        got = self._lib.dds_fetch_read(self._c, buf, n)
+        assert got == n
+        return buf.raw
+
+    def close(self) -> None:
+        if getattr(self, "_c", None):
+            self._lib.dds_disconnect(self._c)
+            self._c = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
         except Exception:
             pass
 
@@ -234,4 +339,97 @@ class DistDataset(AbstractBaseDataset):
         self.store.epoch_end()
 
     def close(self, unlink: Optional[bool] = None) -> None:
+        self.store.close(unlink)
+
+
+class MultiHostDistDataset(AbstractBaseDataset):
+    """Dataset bigger than one host: each host pins a contiguous block of
+    samples in its local shared-memory arena and serves it over TCP; reads
+    outside the local block fetch from the owning host (the DCN analog of
+    the reference's MPI one-sided DDStore window, distdataset.py:26-183,
+    with ``ddstore_width`` replaced by the block partition).
+
+    ``hosts`` lists every host's fetch endpoint in rank order, e.g.
+    ``[("10.0.0.1", 7311), ("10.0.0.2", 7311)]``; ``my_rank`` picks which
+    block this process owns and must populate (``shard`` — the samples whose
+    global ids are ``block_start(my_rank) + i``).
+    """
+
+    def __init__(
+        self,
+        shard: Sequence[Graph],
+        total_len: int,
+        hosts: Sequence,
+        my_rank: int,
+        name: str = "hydragnn_mhdds",
+        capacity_bytes: int = 1 << 28,
+        overwrite: bool = False,
+    ):
+        n_hosts = len(hosts)
+        block = (total_len + n_hosts - 1) // n_hosts
+        # clamp both ends: with a ceil block, trailing ranks can own an
+        # empty range (e.g. 9 samples on 8 hosts leaves rank 5+ nothing)
+        lo = min(my_rank * block, total_len)
+        hi = min(lo + block, total_len)
+        if len(shard) != hi - lo:
+            raise ValueError(
+                f"rank {my_rank} owns global ids [{lo}, {hi}) = {hi - lo} "
+                f"samples, got a shard of {len(shard)}"
+            )
+        self._total = total_len
+        self._block = block
+        self._lo = lo
+        self._hosts = list(hosts)
+        self._rank = my_rank
+        self.store = DDStore(
+            name,
+            capacity_bytes=capacity_bytes,
+            max_items=max(len(shard), 1),
+            create=True,
+            overwrite=overwrite,
+        )
+        for i, g in enumerate(shard):
+            self.store.put(i, _pack_graph(g))
+        self.store.serve(int(self._hosts[my_rank][1]), id_offset=lo)
+        self._clients = {}
+
+    def _client(self, owner: int) -> RemoteStoreClient:
+        c = self._clients.get(owner)
+        if c is None:
+            host, port = self._hosts[owner]
+            c = RemoteStoreClient(host, int(port))
+            self._clients[owner] = c
+        return c
+
+    def get(self, idx: int) -> Graph:
+        if idx < 0:
+            idx += self._total
+        if not 0 <= idx < self._total:
+            raise IndexError(idx)
+        owner = idx // self._block
+        if owner == self._rank:
+            return pickle.loads(self.store.get(idx - self._lo))
+        try:
+            return pickle.loads(self._client(owner).get(idx))
+        except ConnectionError:
+            # evict the dead connection and retry once — a transient reset
+            # (peer restart, network blip) must not poison the cache forever
+            c = self._clients.pop(owner, None)
+            if c is not None:
+                c.close()
+            return pickle.loads(self._client(owner).get(idx))
+
+    def __len__(self) -> int:
+        return self._total
+
+    def epoch_begin(self) -> None:
+        self.store.epoch_begin()
+
+    def epoch_end(self) -> None:
+        self.store.epoch_end()
+
+    def close(self, unlink: Optional[bool] = None) -> None:
+        for c in self._clients.values():
+            c.close()
+        self._clients = {}
         self.store.close(unlink)

@@ -17,10 +17,19 @@
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
 
+#include <arpa/inet.h>
 #include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
 #include <sys/mman.h>
+#include <sys/socket.h>
 #include <sys/stat.h>
 #include <unistd.h>
 
@@ -187,6 +196,215 @@ void dds_close(void* h, int unlink_shm) {
   close(s->fd);
   if (unlink_shm) shm_unlink(name);
   delete s;
+}
+
+// ---------------------------------------------------------------------------
+// Cross-host fetch plane (DCN). The reference DDStore serves datasets across
+// nodes with MPI one-sided gets (distdataset.py:159-183); TPU pods have no
+// MPI plane, so the remote path here is a tiny length-prefixed TCP protocol:
+//   request  : int64 global_id
+//   response : int64 nbytes (-1 when absent), then payload
+// Each host serves its shm arena read-only (published slots only, acquire
+// loads) and fetches other hosts' samples through persistent connections.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = (char*)buf;
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = (const char*)buf;
+  while (n) {
+    ssize_t r = write(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+struct Server {
+  Store* store;
+  int64_t id_offset;  // global id of local slot 0
+  int listen_fd;
+  std::atomic<bool> stop;
+  std::thread accept_thread;
+  // live connection bookkeeping: dds_serve_stop shuts these sockets down
+  // and waits for every connection thread to exit BEFORE the caller can
+  // munmap the arena — no use-after-free on shutdown with in-flight peers
+  std::mutex mu;
+  std::vector<int> conns;
+  std::atomic<int> live{0};
+};
+
+void serve_conn(Server* sv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  int64_t gid;
+  while (!sv->stop.load() && read_full(fd, &gid, sizeof(gid))) {
+    Store* s = sv->store;
+    int64_t id = gid - sv->id_offset;
+    int64_t len = -1;
+    const char* src = nullptr;
+    if (id >= 0 && id < s->hdr->max_items &&
+        s->slots[id].state.load(std::memory_order_acquire)) {
+      len = s->slots[id].length;
+      src = s->payload + s->slots[id].offset;
+    }
+    if (!write_full(fd, &len, sizeof(len))) break;
+    if (len > 0 && !write_full(fd, src, (size_t)len)) break;
+  }
+  close(fd);
+  {
+    std::lock_guard<std::mutex> lock(sv->mu);
+    for (auto it = sv->conns.begin(); it != sv->conns.end(); ++it) {
+      if (*it == fd) {
+        sv->conns.erase(it);
+        break;
+      }
+    }
+  }
+  sv->live.fetch_sub(1);
+}
+
+struct Conn {
+  int fd;
+  std::vector<char> buf;
+};
+
+}  // namespace
+
+// Serve this store's published slots on 0.0.0.0:port; ids received on the
+// wire are global (local slot = id - id_offset). Returns an opaque server
+// handle, or nullptr on bind failure.
+void* dds_serve_start(void* h, int port, int64_t id_offset) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 || listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  Server* sv = new Server;
+  sv->store = (Store*)h;
+  sv->id_offset = id_offset;
+  sv->listen_fd = fd;
+  sv->stop.store(false);
+  sv->accept_thread = std::thread([sv]() {
+    while (!sv->stop.load()) {
+      int c = accept(sv->listen_fd, nullptr, nullptr);
+      if (c < 0) {
+        if (errno == EINTR) continue;
+        break;  // listen socket closed by dds_serve_stop
+      }
+      if (sv->stop.load()) {
+        close(c);
+        break;
+      }
+      {
+        std::lock_guard<std::mutex> lock(sv->mu);
+        sv->conns.push_back(c);
+      }
+      sv->live.fetch_add(1);
+      std::thread(serve_conn, sv, c).detach();
+    }
+  });
+  return sv;
+}
+
+// Blocks until every connection thread has exited, so the caller may
+// safely dds_close (munmap) the store afterwards.
+void dds_serve_stop(void* server) {
+  Server* sv = (Server*)server;
+  sv->stop.store(true);
+  shutdown(sv->listen_fd, SHUT_RDWR);
+  close(sv->listen_fd);
+  if (sv->accept_thread.joinable()) sv->accept_thread.join();
+  while (sv->live.load() > 0) {
+    {
+      std::lock_guard<std::mutex> lock(sv->mu);
+      for (int fd : sv->conns) shutdown(fd, SHUT_RDWR);
+    }
+    usleep(1000);
+  }
+  delete sv;
+}
+
+// Persistent client connection to a serving host. Returns nullptr on
+// connect failure.
+void* dds_connect(const char* host, int port) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof(portstr), "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) return nullptr;
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    return nullptr;
+  }
+  if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    close(fd);
+    freeaddrinfo(res);
+    return nullptr;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Conn* c = new Conn;
+  c->fd = fd;
+  return c;
+}
+
+// Fetch global id into the connection's scratch buffer. Returns the blob
+// length, -1 when the server does not hold the id, -2 on a broken
+// connection.
+int64_t dds_fetch(void* conn, int64_t gid) {
+  // sanity cap on the wire length: a desynced/corrupt stream must surface
+  // as a recoverable broken-connection error, not a std::bad_alloc
+  // terminating the process through the ctypes boundary
+  constexpr int64_t kMaxFetchBytes = int64_t(1) << 33;  // 8 GiB
+  Conn* c = (Conn*)conn;
+  if (!write_full(c->fd, &gid, sizeof(gid))) return -2;
+  int64_t len;
+  if (!read_full(c->fd, &len, sizeof(len))) return -2;
+  if (len == -1) return -1;
+  if (len < 0 || len > kMaxFetchBytes) return -2;
+  c->buf.resize((size_t)len);
+  if (len > 0 && !read_full(c->fd, c->buf.data(), (size_t)len)) return -2;
+  return len;
+}
+
+// Copy the last fetched payload out (up to nbytes); returns bytes copied.
+int64_t dds_fetch_read(void* conn, void* out, int64_t nbytes) {
+  Conn* c = (Conn*)conn;
+  int64_t len =
+      (int64_t)c->buf.size() < nbytes ? (int64_t)c->buf.size() : nbytes;
+  memcpy(out, c->buf.data(), (size_t)len);
+  return len;
+}
+
+void dds_disconnect(void* conn) {
+  Conn* c = (Conn*)conn;
+  close(c->fd);
+  delete c;
 }
 
 }  // extern "C"
