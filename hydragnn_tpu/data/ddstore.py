@@ -211,6 +211,11 @@ class RemoteStoreClient:
         self._connect()
 
     def _connect(self) -> None:
+        if getattr(self, "_c", None):
+            # drop the previous connection (e.g. one inherited across fork:
+            # fds are per-process, so closing here never touches the parent)
+            self._lib.dds_disconnect(self._c)
+            self._c = None
         self._c = self._lib.dds_connect(self.host.encode(), self.port)
         self._pid = os.getpid()
         if not self._c:

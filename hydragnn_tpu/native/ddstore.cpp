@@ -265,7 +265,8 @@ void serve_conn(Server* sv, int fd) {
     if (!write_full(fd, &len, sizeof(len))) break;
     if (len > 0 && !write_full(fd, src, (size_t)len)) break;
   }
-  close(fd);
+  // deregister BEFORE close: once the fd number is released the kernel can
+  // recycle it, and the stop sweep must never shutdown() a stranger's fd
   {
     std::lock_guard<std::mutex> lock(sv->mu);
     for (auto it = sv->conns.begin(); it != sv->conns.end(); ++it) {
@@ -275,6 +276,7 @@ void serve_conn(Server* sv, int fd) {
       }
     }
   }
+  close(fd);
   sv->live.fetch_sub(1);
 }
 
@@ -333,9 +335,11 @@ void* dds_serve_start(void* h, int port, int64_t id_offset) {
 void dds_serve_stop(void* server) {
   Server* sv = (Server*)server;
   sv->stop.store(true);
+  // shutdown unblocks accept(); close only after the accept thread exits,
+  // so it can never accept() on a recycled fd number
   shutdown(sv->listen_fd, SHUT_RDWR);
-  close(sv->listen_fd);
   if (sv->accept_thread.joinable()) sv->accept_thread.join();
+  close(sv->listen_fd);
   while (sv->live.load() > 0) {
     {
       std::lock_guard<std::mutex> lock(sv->mu);
