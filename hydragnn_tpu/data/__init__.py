@@ -17,6 +17,7 @@ from .ddstore import (
     RemoteStoreClient,
 )
 from .descriptors import atomic_descriptors, smiles_to_graph
+from .xyz2mol import perceive_molecule, xyz_to_graph
 from .raw import (
     finalize_graphs,
     load_cfg_file,
@@ -84,6 +85,8 @@ __all__ = [
     "qm9_shaped_dataset",
     "atomic_descriptors",
     "smiles_to_graph",
+    "perceive_molecule",
+    "xyz_to_graph",
     "finalize_graphs",
     "load_cfg_file",
     "load_lsms_file",

@@ -217,13 +217,17 @@ class RemoteStoreClient:
             self._lib.dds_disconnect(self._c)
             self._c = None
         self._c = self._lib.dds_connect(self.host.encode(), self.port)
-        self._pid = os.getpid()
         if not self._c:
+            self._c = None
             raise ConnectionError(f"cannot connect to {self.host}:{self.port}")
+        # only a successful connect updates the pid: a failed reconnect must
+        # leave get() retrying _connect, never fetching on a NULL handle
+        self._pid = os.getpid()
 
     def get(self, global_id: int) -> bytes:
-        if os.getpid() != self._pid:
-            # inherited across fork: the parent still owns the old socket
+        if self._c is None or os.getpid() != self._pid:
+            # inherited across fork, or a previous reconnect failed: the
+            # parent still owns the old socket / there is nothing to fetch on
             self._connect()
         n = self._lib.dds_fetch(self._c, global_id)
         if n == -2:

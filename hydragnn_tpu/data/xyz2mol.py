@@ -1,0 +1,172 @@
+"""Geometry -> molecule perception: covalent-radius connectivity + integer
+bond-order assignment + formal charges.
+
+Compact, dependency-free behavioral analog of the reference's vendored
+xyz2mol (reference: hydragnn/utils/descriptors_and_embeddings/
+xyz2mol.py:1-1007, the Kim & Kim / Jensen-group algorithm wrapped around
+rdkit). rdkit is not available in this image, so the useful subset is
+implemented directly:
+
+1. connectivity from covalent radii (bond when the distance is below
+   ``tolerance * (r_i + r_j)`` — xyz2mol's own criterion),
+2. integer bond orders by iterative saturation of free valences
+   (double/triple bonds where both partners still have capacity),
+3. formal charges from leftover (under/over)-saturation against the
+   element's neutral valence.
+
+Covers the organic set (H C N O F Si P S Cl Br I) the reference's pipeline
+targets; it does not enumerate resonance structures. Output converts to a
+framework ``Graph`` with the bond order as the edge attribute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.radial import COVALENT_RADII
+from .graph import Graph
+
+# neutral valences; first entry is preferred, later entries are permitted
+# expansions (S 4/6, P 5) — mirrors xyz2mol's atomic_valence table
+_VALENCES = {
+    1: (1,),
+    5: (3,),
+    6: (4,),
+    7: (3,),
+    8: (2,),
+    9: (1,),
+    14: (4,),
+    15: (3, 5),
+    16: (2, 4, 6),
+    17: (1,),
+    35: (1,),
+    53: (1,),
+}
+
+
+@dataclasses.dataclass
+class Molecule:
+    """Perceived molecule: atoms, integer-order bonds, formal charges."""
+
+    z: np.ndarray  # [n] atomic numbers
+    pos: np.ndarray  # [n, 3]
+    bonds: List[Tuple[int, int, int]]  # (i, j, order), i < j
+    formal_charges: np.ndarray  # [n] int
+
+    @property
+    def num_atoms(self) -> int:
+        return int(self.z.shape[0])
+
+    def to_graph(self) -> Graph:
+        """Directed framework Graph; edge_attr = bond order (one column)."""
+        senders, receivers, orders = [], [], []
+        for i, j, o in self.bonds:
+            senders += [i, j]
+            receivers += [j, i]
+            orders += [o, o]
+        return Graph(
+            x=self.z[:, None].astype(np.float32),
+            pos=self.pos.astype(np.float32),
+            senders=np.asarray(senders, np.int32),
+            receivers=np.asarray(receivers, np.int32),
+            edge_attr=np.asarray(orders, np.float32)[:, None],
+            z=self.z.copy(),
+        )
+
+
+def connectivity(
+    z: np.ndarray, pos: np.ndarray, tolerance: float = 1.3
+) -> List[Tuple[int, int]]:
+    """Single-bond skeleton: pairs closer than tolerance * sum of covalent
+    radii (reference: xyz2mol get_AC, the adjacency-matrix construction)."""
+    z = np.asarray(z)
+    pos = np.asarray(pos, np.float64)
+    radii = np.asarray([COVALENT_RADII[int(zz)] for zz in z])
+    pairs = []
+    n = z.shape[0]
+    for i in range(n):
+        d = np.linalg.norm(pos[i + 1 :] - pos[i], axis=1)
+        cut = tolerance * (radii[i] + radii[i + 1 :])
+        for off in np.nonzero(d < cut)[0]:
+            pairs.append((i, int(i + 1 + off)))
+    return pairs
+
+
+def perceive_molecule(
+    z: Sequence[int],
+    pos: np.ndarray,
+    charge: Optional[int] = None,
+    tolerance: float = 1.3,
+) -> Molecule:
+    """Bond orders + formal charges from geometry.
+
+    Free valence = preferred valence - current bond-order sum; bonds where
+    both partners have free valence are promoted (double, then triple), most
+    -saturable pairs first — the saturation loop at the core of xyz2mol's
+    BO-matrix search, without the resonance enumeration. Whatever
+    unsaturation remains becomes formal charge (O with one single bond ->
+    O^-, N with four bonds -> N^+), and the total is checked against
+    ``charge`` when provided.
+    """
+    z = np.asarray(z, np.int64)
+    pos = np.asarray(pos, np.float64)
+    skeleton = connectivity(z, pos, tolerance)
+    order = {p: 1 for p in skeleton}
+
+    def allowed(i):
+        return _VALENCES.get(int(z[i]), (4,))
+
+    def bo_sum(i):
+        return sum(o for (a, b), o in order.items() if a == i or b == i)
+
+    def free(i):
+        # highest permitted valence still reachable counts as capacity,
+        # preferred valence drives the promotion priority
+        return max(allowed(i)) - bo_sum(i)
+
+    changed = True
+    while changed:
+        changed = False
+        # promote the pair whose partners are both most unsaturated
+        candidates = [
+            (min(free(a), free(b)), (a, b))
+            for (a, b) in order
+            if free(a) > 0 and free(b) > 0 and order[(a, b)] < 3
+        ]
+        if not candidates:
+            break
+        candidates.sort(key=lambda t: (-t[0], t[1]))
+        _, pair = candidates[0]
+        order[pair] += 1
+        changed = True
+
+    formal = np.zeros(z.shape[0], np.int64)
+    for i in range(z.shape[0]):
+        s = bo_sum(i)
+        if int(z[i]) in _VALENCES:
+            # deviation from the closest permitted valence is the formal
+            # charge: under-saturated O -> -1 (hydroxide), over-saturated
+            # N -> +1 (ammonium), saturated atoms -> 0
+            best = min(allowed(i), key=lambda v: abs(v - s))
+            formal[i] = s - best
+    if charge is not None and int(formal.sum()) != charge:
+        # a declared total charge (including an explicit 0) is checked; the
+        # default None skips the check for chargeless use
+        raise ValueError(
+            f"perceived total formal charge {int(formal.sum())} != declared "
+            f"charge {charge}; geometry may be mis-bonded at tolerance="
+            f"{tolerance}"
+        )
+    bonds = sorted((a, b, o) for (a, b), o in order.items())
+    return Molecule(z=z, pos=pos, bonds=bonds, formal_charges=formal)
+
+
+def xyz_to_graph(
+    z: Sequence[int], pos: np.ndarray, charge: Optional[int] = None
+) -> Graph:
+    """Geometry -> bonded Graph with bond-order edge attributes (the
+    endpoint the reference reaches through rdkit mol objects)."""
+    return perceive_molecule(z, pos, charge).to_graph()

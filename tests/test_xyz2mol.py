@@ -1,0 +1,73 @@
+"""Geometry -> molecule perception (xyz2mol analog; reference:
+hydragnn/utils/descriptors_and_embeddings/xyz2mol.py)."""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data.xyz2mol import perceive_molecule, xyz_to_graph
+
+
+def pytest_methane_single_bonds():
+    z = [6, 1, 1, 1, 1]
+    d = 1.09
+    pos = np.array([
+        [0, 0, 0],
+        [d, 0, 0], [-d / 3, d, 0], [-d / 3, -d / 2, d * 0.8],
+        [-d / 3, -d / 2, -d * 0.8],
+    ])
+    mol = perceive_molecule(z, pos)
+    assert len(mol.bonds) == 4
+    assert all(o == 1 for _, _, o in mol.bonds)
+    assert mol.formal_charges.sum() == 0
+
+
+def pytest_co2_double_bonds():
+    z = [8, 6, 8]
+    pos = np.array([[-1.16, 0, 0], [0, 0, 0], [1.16, 0, 0]])
+    mol = perceive_molecule(z, pos)
+    assert sorted(mol.bonds) == [(0, 1, 2), (1, 2, 2)]
+    assert mol.formal_charges.sum() == 0
+
+
+def pytest_n2_triple_bond():
+    z = [7, 7]
+    pos = np.array([[0, 0, 0], [1.10, 0, 0]])
+    mol = perceive_molecule(z, pos)
+    assert mol.bonds == [(0, 1, 3)]
+    assert mol.formal_charges.sum() == 0
+
+
+def pytest_ethene_double_bond():
+    z = [6, 6, 1, 1, 1, 1]
+    pos = np.array([
+        [0, 0, 0], [1.33, 0, 0],
+        [-0.55, 0.92, 0], [-0.55, -0.92, 0],
+        [1.88, 0.92, 0], [1.88, -0.92, 0],
+    ])
+    mol = perceive_molecule(z, pos)
+    orders = {(i, j): o for i, j, o in mol.bonds}
+    assert orders[(0, 1)] == 2  # C=C
+    assert sum(1 for o in orders.values() if o == 1) == 4  # four C-H
+    assert mol.formal_charges.sum() == 0
+
+
+def pytest_hydroxide_formal_charge():
+    z = [8, 1]
+    pos = np.array([[0, 0, 0], [0.97, 0, 0]])
+    mol = perceive_molecule(z, pos, charge=-1)
+    assert mol.bonds == [(0, 1, 1)]
+    assert mol.formal_charges.tolist() == [-1, 0]
+
+
+def pytest_charge_mismatch_raises():
+    z = [8, 1]
+    pos = np.array([[0, 0, 0], [0.97, 0, 0]])
+    with pytest.raises(ValueError, match="formal charge"):
+        perceive_molecule(z, pos, charge=2)
+
+
+def pytest_to_graph_roundtrip():
+    g = xyz_to_graph([7, 7], np.array([[0, 0, 0], [1.10, 0, 0]]))
+    assert g.num_edges == 2  # both directions
+    np.testing.assert_array_equal(g.edge_attr.ravel(), [3.0, 3.0])
+    np.testing.assert_array_equal(g.z, [7, 7])
