@@ -29,10 +29,15 @@ import numpy as np
 
 from hydragnn_tpu.config import update_config
 from hydragnn_tpu.data import GraphLoader, MinMax, VariablesOfInterest, \
-    deterministic_graph_dataset, extract_variables, split_dataset
+    branch_sample_weights, deterministic_graph_dataset, extract_variables, \
+    split_dataset
 from hydragnn_tpu.models import create_model, init_model
 from hydragnn_tpu.parallel import make_mesh, replicate_state
-from hydragnn_tpu.parallel.dp import make_parallel_eval_step, make_parallel_train_step
+from hydragnn_tpu.parallel.dp import (
+    ensure_stacked,
+    make_parallel_eval_step,
+    make_parallel_train_step,
+)
 from hydragnn_tpu.train import TrainState, make_optimizer
 
 
@@ -57,6 +62,12 @@ def main():
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--branch_size", type=int, default=1)
     ap.add_argument("--batch_size", type=int, default=32)
+    ap.add_argument(
+        "--branch_weights", default=None,
+        help="comma-separated per-dataset sampling shares, e.g. '2,1' — the "
+        "uneven-branch analog (reference sizes branch process groups by "
+        "dataset, examples/multibranch/train.py:166-213)",
+    )
     args = ap.parse_args()
 
     datasets = build_datasets()
@@ -104,15 +115,23 @@ def main():
 
     n_dev = len(jax.devices())
     mesh = make_mesh(branch_size=args.branch_size)
+    sampling = {}
+    if args.branch_weights:
+        shares = [float(s) for s in args.branch_weights.split(",")]
+        sampling = dict(
+            oversampling=True,
+            sample_weights=branch_sample_weights(tr, dict(enumerate(shares))),
+        )
     loader = GraphLoader(
-        tr, args.batch_size, seed=0, num_shards=n_dev, drop_last=True
+        tr, args.batch_size, seed=0, num_shards=n_dev, drop_last=True, **sampling
     )
     val_loader = GraphLoader(
         va, args.batch_size, spec=loader.spec, shuffle=False, num_shards=n_dev
     )
 
     model = create_model(config)
-    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], next(iter(loader)))
+    first = ensure_stacked(next(iter(loader)))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], first)
     variables = init_model(model, one)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
     state = replicate_state(TrainState.create(variables, tx), mesh)
@@ -124,8 +143,8 @@ def main():
         loader.set_epoch(epoch)
         for batch in loader:
             rng, sub = jax.random.split(rng)
-            state, tot, tasks = step(state, batch, sub)
-        va_loss, _ = evalf(state, next(iter(val_loader)))
+            state, tot, tasks = step(state, ensure_stacked(batch), sub)
+        va_loss, _ = evalf(state, ensure_stacked(next(iter(val_loader))))
         print(f"epoch {epoch}: train {float(tot):.5f} val {float(va_loss):.5f}")
 
 

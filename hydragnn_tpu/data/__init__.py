@@ -2,6 +2,7 @@ from .graph import Graph, GraphBatch, PadSpec, batch_graphs, batch_graphs_np, gr
 from .neighbors import radius_graph, radius_graph_pbc, edge_vectors_and_lengths
 from .pipeline import (
     GraphLoader,
+    branch_sample_weights,
     MinMax,
     VariablesOfInterest,
     extract_variables,
@@ -73,6 +74,7 @@ __all__ = [
     "radius_graph_pbc",
     "edge_vectors_and_lengths",
     "GraphLoader",
+    "branch_sample_weights",
     "MinMax",
     "VariablesOfInterest",
     "extract_variables",

@@ -129,6 +129,37 @@ class MinMax:
         return y * (self.y_max[idx] - self.y_min[idx]) + self.y_min[idx]
 
 
+def branch_sample_weights(
+    graphs: Sequence[Graph], branch_weights: Dict[int, float]
+) -> np.ndarray:
+    """Per-sample draw weights giving each dataset branch a total sampling
+    share proportional to ``branch_weights[dataset_id]``.
+
+    The SPMD analog of the reference's *uneven* branch process groups
+    (examples/multibranch/train.py:166-213 sizes each branch's rank count
+    by its dataset; MultiTaskModelMP then trains them in parallel): here
+    one merged loader draws with replacement, and these weights set how
+    much step budget each branch receives regardless of dataset size —
+    e.g. weights {0: 1, 1: 1} equalize a large and a small dataset.
+    """
+    ids = np.asarray([g.dataset_id for g in graphs], np.int64)
+    uncovered = sorted(set(ids.tolist()) - set(branch_weights))
+    if uncovered:
+        raise ValueError(f"dataset_id(s) {uncovered} not in branch_weights")
+    w = np.zeros(ids.shape[0], np.float64)
+    for ds_id, share in branch_weights.items():
+        if share <= 0:
+            raise ValueError(
+                f"branch_weights[{ds_id}] must be positive, got {share}"
+            )
+        mask = ids == ds_id
+        count = int(mask.sum())
+        if count == 0:
+            raise ValueError(f"no samples with dataset_id {ds_id}")
+        w[mask] = float(share) / count
+    return w
+
+
 def split_dataset(
     graphs: List[Graph],
     perc_train: float,
@@ -198,6 +229,7 @@ class GraphLoader:
         num_buckets: int = 1,
         oversampling: bool = False,
         num_samples: Optional[int] = None,
+        sample_weights: Optional[np.ndarray] = None,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -237,6 +269,18 @@ class GraphLoader:
         # hydragnn/preprocess/load_data.py:237-274)
         self.oversampling = oversampling
         self.num_samples = num_samples
+        # per-sample draw weights (uneven-branch analog, see
+        # branch_sample_weights); only meaningful with oversampling
+        if sample_weights is not None:
+            if not oversampling:
+                raise ValueError("sample_weights requires oversampling=True")
+            w = np.asarray(sample_weights, np.float64)
+            if w.shape != (len(graphs),):
+                raise ValueError(
+                    f"sample_weights shape {w.shape} != ({len(graphs)},)"
+                )
+            sample_weights = w / w.sum()
+        self.sample_weights = sample_weights
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -253,7 +297,9 @@ class GraphLoader:
         rng = np.random.default_rng(self.seed + self.epoch)
         if self.oversampling:
             n = self.num_samples or len(self.graphs)
-            idx = rng.choice(len(self.graphs), size=n, replace=True)
+            idx = rng.choice(
+                len(self.graphs), size=n, replace=True, p=self.sample_weights
+            )
         else:
             idx = np.arange(len(self.graphs))
             if self.shuffle:

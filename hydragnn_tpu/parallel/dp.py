@@ -32,6 +32,18 @@ from .mesh import BRANCH_AXIS, DATA_AXIS
 _BOTH = (BRANCH_AXIS, DATA_AXIS)
 
 
+def ensure_stacked(batch):
+    """Guarantee the leading device axis the shard_map steps expect.
+
+    ``GraphLoader(num_shards=1)`` emits unstacked batches (the plain-jit
+    contract); a 1-device mesh still wants ``[1, ...]``. Keeping the shim
+    here keeps the [D, ...] contract in one place for every consumer.
+    """
+    if batch.graph_mask.ndim == 1:
+        return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], batch)
+    return batch
+
+
 def make_parallel_train_step(
     model: HydraModel, tx, mesh: Mesh, compute_grad_energy: bool = False
 ):

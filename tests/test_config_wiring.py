@@ -175,3 +175,35 @@ def pytest_num_samples_subsets_epoch():
     seen = sum(int(np.asarray(b.graph_mask).sum()) for b in loader)
     assert seen == 10
     assert len(loader) == 2
+
+
+def pytest_branch_sample_weights_uneven():
+    """Uneven-branch sampling: branch shares follow the declared weights,
+    not the dataset sizes (the SPMD analog of the reference's uneven branch
+    process groups, examples/multibranch/train.py:166-213)."""
+    import dataclasses
+
+    from hydragnn_tpu.data import branch_sample_weights
+    from hydragnn_tpu.data import deterministic_graph_dataset as dgd
+
+    big = [dataclasses.replace(g, dataset_id=0) for g in dgd(90, seed=1)]
+    small = [dataclasses.replace(g, dataset_id=1) for g in dgd(10, seed=2)]
+    graphs = big + small
+    w = branch_sample_weights(graphs, {0: 1.0, 1: 1.0})
+    # each branch's total share is equal despite the 9:1 size imbalance
+    assert np.isclose(w[:90].sum() / w.sum(), 0.5)
+    loader = GraphLoader(graphs, 20, oversampling=True, num_samples=4000,
+                         sample_weights=w, seed=0)
+    ids = np.asarray([graphs[i].dataset_id for i in loader._local_indices()])
+    frac_small = float((ids == 1).mean())
+    assert 0.44 < frac_small < 0.56, frac_small
+
+    # validation errors name the actual problem
+    with pytest.raises(ValueError, match="requires oversampling"):
+        GraphLoader(graphs, 20, sample_weights=w)
+    with pytest.raises(ValueError, match="not in branch_weights"):
+        branch_sample_weights(graphs, {0: 1.0})
+    with pytest.raises(ValueError, match="must be positive"):
+        branch_sample_weights(graphs, {0: 1.0, 1: 0.0})
+    with pytest.raises(ValueError, match="no samples with dataset_id"):
+        branch_sample_weights(graphs, {0: 1.0, 1: 1.0, 7: 1.0})
