@@ -230,6 +230,7 @@ class GraphLoader:
         oversampling: bool = False,
         num_samples: Optional[int] = None,
         sample_weights: Optional[np.ndarray] = None,
+        sort_edges: bool = False,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -281,6 +282,9 @@ class GraphLoader:
                 )
             sample_weights = w / w.sum()
         self.sample_weights = sample_weights
+        # receiver-sorted edges (the Pallas sorted-segment-sum precondition,
+        # ops/pallas_segment.py; also scatter-friendlier for XLA)
+        self.sort_edges = sort_edges
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -325,7 +329,9 @@ class GraphLoader:
 
     def _make(self, graphs: List[Graph]) -> GraphBatch:
         if self.num_shards == 1:
-            return batch_graphs(graphs, self.ladder.select_for(graphs))
+            return batch_graphs(
+                graphs, self.ladder.select_for(graphs), sort_edges=self.sort_edges
+            )
         shards = [graphs[s :: self.num_shards] for s in range(self.num_shards)]
         # one spec for the whole stacked batch: the smallest level fitting
         # the largest shard (all shards must share static shapes)
@@ -337,7 +343,11 @@ class GraphLoader:
             if with_trip
             else 0,
         )
-        arrs = [batch_graphs_np(s, spec) for s in shards if s]
+        arrs = [
+            batch_graphs_np(s, spec, sort_edges=self.sort_edges)
+            for s in shards
+            if s
+        ]
         template = {k: np.zeros_like(v) for k, v in arrs[0].items()}
         # padding edges must still point at the dummy node slot
         template["senders"] = np.full_like(arrs[0]["senders"], spec.n_nodes - 1)

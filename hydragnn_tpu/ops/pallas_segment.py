@@ -1,0 +1,171 @@
+"""Pallas TPU kernel: sorted-segment sum of edge messages.
+
+The scatter-add ``out[i] = sum_{e: recv[e]==i} msg[e]`` sits on the hot path
+of every message-passing model here (ops/segment.py -> jax.ops.segment_sum,
+the torch_scatter analog, SURVEY §2.3 item 2). XLA lowers it to a serialized
+scatter; with receivers *sorted* (free at batching time — edge order is
+semantically irrelevant) the reduction becomes CSR-contiguous and maps onto
+the MXU as a block-diagonal one-hot matmul:
+
+- grid ``(C_blocks, row_blocks, K)``: for output row-block ``j``, the K
+  inner steps stream the edge windows that can touch its rows (degree-capped
+  graphs bound edges-per-row-block by ``Nb * max_degree``), and the output
+  block is revisited across K as a standard reduction accumulator;
+- the edge->local-row map is precomputed as an owner-encoded one-hot
+  ``oh[e, recv[e] % Nb] = owner(e) + 1`` so one streamed operand carries
+  both the scatter pattern and the this-block mask (exact float compares,
+  values < 2^24);
+- per step: ``acc[Nb, Cb] += onehot_masked.T @ msg_window`` — an
+  [Nb, Eb] x [Eb, Cb] MXU contraction instead of a scatter.
+
+The backward pass of a segment sum is a gather, which XLA already does
+well, so the custom VJP uses ``dout[recv]`` directly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(estart_ref, oh_ref, msg_ref, out_ref):
+    c, j, k = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    del c, k  # block selection happened in the index maps
+
+    @pl.when(pl.program_id(2) == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # owner-encoded one-hot: entries equal to j+1 belong to this row block
+    mine = (oh_ref[:] == (j + 1).astype(oh_ref.dtype)).astype(msg_ref.dtype)
+    out_ref[:] += jax.lax.dot_general(
+        mine,
+        msg_ref[:],
+        (((0,), (0,)), ((), ())),  # contract over the edge axis
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _pad_to(x, multiple, axis):
+    pad = (-x.shape[axis]) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7)
+)
+def sorted_segment_sum(
+    messages,
+    segment_ids,
+    num_segments: int,
+    max_degree: int = 32,
+    block_rows: int = 128,
+    block_edges: int = 512,
+    block_cols: int = 512,
+    interpret: bool = False,
+):
+    """``segment_sum`` for receiver-sorted edges via the Pallas kernel.
+
+    ``segment_ids`` MUST be ascending (sorted receivers), and any segment
+    holding more than ``max_degree`` edges gets an UNSPECIFIED value (its
+    trailing edges fall outside the K streamed windows). Real nodes of this
+    framework's batches satisfy the cap (data/neighbors.py caps in-degree;
+    ``GraphLoader(sort_edges=True)`` sorts receivers) — but the final
+    *padding* node receives every padding edge and will exceed it: its slot
+    must be masked downstream, which every consumer of the dummy-node
+    convention already does (data/graph.py padding docs).
+    Messages are [E, C] float; returns [num_segments, C].
+    """
+    return _forward(
+        messages, segment_ids, num_segments, max_degree, block_rows,
+        block_edges, block_cols, interpret,
+    )
+
+
+def _forward(
+    messages, segment_ids, num_segments, max_degree, block_rows, block_edges,
+    block_cols, interpret,
+):
+    e, c = messages.shape
+    nb, eb, cb = block_rows, block_edges, block_cols
+    cb = min(cb, max(c, 128))
+    dtype = messages.dtype
+
+    ids = segment_ids.astype(jnp.int32)
+    msg = _pad_to(messages.astype(jnp.float32), eb, 0)
+    msg = _pad_to(msg, cb, 1)
+    n_pad = num_segments + (-num_segments) % nb
+
+    # K inner windows cover the worst legal row block (degree-capped), +1
+    # for edge-block misalignment
+    k_windows = (nb * max_degree + eb - 1) // eb + 1
+    k_windows = min(k_windows, msg.shape[0] // eb)
+    k_windows = max(k_windows, 1)
+    # trailing zero blocks so estart[j] + k is always in range — never clamp
+    # (a clamp would re-read one block for several k and double-count edges).
+    # k_windows blocks of slack: estart can point one block past the data
+    # when a trailing row block owns no edges.
+    msg = jnp.pad(msg, ((0, k_windows * eb), (0, 0)))
+    e_pad = msg.shape[0]
+
+    # owner-encoded one-hot [E_pad, Nb]; padding edges stay all-zero so the
+    # (oh == j+1 >= 1) comparison never selects them
+    owner = ids // nb + 1
+    local = ids % nb
+    oh = jnp.zeros((e_pad, nb), jnp.float32)
+    oh = oh.at[jnp.arange(e), local].set(owner.astype(jnp.float32))
+
+    # first edge-block index each row block may need (receivers sorted)
+    j_blocks = n_pad // nb
+    row_starts = jnp.searchsorted(
+        ids, jnp.arange(j_blocks, dtype=jnp.int32) * nb, side="left"
+    ).astype(jnp.int32)
+    estart_block = row_starts // eb
+
+    def msg_index(c_i, j, k, estart):
+        return (estart[j] + k, c_i)
+
+    def oh_index(c_i, j, k, estart):
+        return (estart[j] + k, 0)
+
+    def out_index(c_i, j, k, estart):
+        return (j, c_i)
+
+    grid = (msg.shape[1] // cb, j_blocks, k_windows)
+    out = pl.pallas_call(
+        _kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((eb, nb), oh_index),
+                pl.BlockSpec((eb, cb), msg_index),
+            ],
+            out_specs=pl.BlockSpec((nb, cb), out_index),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n_pad, msg.shape[1]), jnp.float32),
+        interpret=interpret,
+    )(estart_block, oh, msg)
+    return out[:num_segments, :c].astype(dtype)
+
+
+def _fwd(messages, segment_ids, *static):
+    return _forward(messages, segment_ids, *static), segment_ids
+
+
+def _bwd(num_segments, max_degree, block_rows, block_edges, block_cols,
+         interpret, segment_ids, g):
+    # d/d msg of a segment sum is a gather of the cotangent (XLA-fast);
+    # integer ids get no gradient
+    return g[segment_ids], None
+
+
+sorted_segment_sum.defvjp(_fwd, _bwd)
