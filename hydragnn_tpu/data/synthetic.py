@@ -23,7 +23,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .graph import Graph
-from .neighbors import radius_graph
+from .neighbors import radius_graph, radius_graph_pbc
 
 
 def knn_average(pos: np.ndarray, values: np.ndarray, k: int) -> np.ndarray:
@@ -125,19 +125,27 @@ def _symmetrize_edges(senders: np.ndarray, receivers: np.ndarray):
     return np.asarray(s, np.int32), np.asarray(r, np.int32)
 
 
-def _lj_targets(pos, senders, receivers, epsilon: float, sigma: float):
+def _lj_targets(pos, senders, receivers, epsilon: float, sigma: float,
+                shifts=None):
     """Closed-form Lennard-Jones total energy and per-atom forces over the
-    (symmetric) edge list. Each pair appears twice, so half the pair energy
-    is charged per edge."""
+    edge list. Each pair of a symmetric list appears twice, so half the
+    pair energy is charged per edge; forces are the exact gradient of that
+    edge-restricted energy (half accumulated on each endpoint), so
+    F = -dE/dpos holds for ANY edge list — including ones where a neighbor
+    cap dropped one direction of a pair. ``shifts`` makes the displacements
+    PBC-aware (minimum-image convention of the graph)."""
     diff = pos[receivers] - pos[senders]  # r_i - r_j for edge j->i
+    if shifts is not None:
+        diff = diff - shifts
     r = np.linalg.norm(diff, axis=1)
     s6 = (sigma / r) ** 6
     s12 = s6**2
     energy = float(np.sum(0.5 * 4.0 * epsilon * (s12 - s6)))
-    # F_i = sum_j 24 eps (2 s12 - s6) / r^2 * (r_i - r_j)
-    coef = 24.0 * epsilon * (2.0 * s12 - s6) / r**2
+    # dE/dpos of the per-edge half energies: each edge pushes both endpoints
+    coef = 0.5 * 24.0 * epsilon * (2.0 * s12 - s6) / r**2
     forces = np.zeros_like(pos)
     np.add.at(forces, receivers, coef[:, None] * diff)
+    np.add.at(forces, senders, -coef[:, None] * diff)
     return energy, forces
 
 
@@ -311,6 +319,75 @@ def qm9_shaped_dataset(
                 senders=senders,
                 receivers=receivers,
                 graph_y=np.asarray([energy / n], np.float32),
+                z=z.copy(),
+            )
+        )
+    return graphs
+
+
+def mptrj_shaped_dataset(
+    number_configurations: int = 128,
+    radius: float = 5.0,
+    max_neighbours: int = 20,
+    seed: int = 23,
+) -> List[Graph]:
+    """MPTrj-*shaped* workload: perturbed periodic crystals with varied
+    lattices, compositions, and cell sizes — the structure of the
+    Materials-Project-trajectory benchmark the reference trains MACE/GFM
+    models on (reference: examples/mptrj; the real download is unavailable
+    in this image). Each sample is a BCC/FCC/SC supercell with a random
+    binary composition, thermal rattling, PBC radius-graph edges with shift
+    vectors, and physically-consistent LJ energy (graph, per atom) and
+    force (node) targets evaluated on the periodic displacements.
+    """
+    rng = np.random.default_rng(seed)
+    bases = {
+        "sc": np.zeros((1, 3)),
+        "bcc": np.array([[0, 0, 0], [0.5, 0.5, 0.5]], np.float64),
+        "fcc": np.array(
+            [[0, 0, 0], [0.5, 0.5, 0], [0.5, 0, 0.5], [0, 0.5, 0.5]], np.float64
+        ),
+    }
+    element_pool = np.array([3, 8, 13, 14, 22, 26, 28, 29])  # Li O Al Si Ti Fe Ni Cu
+    graphs: List[Graph] = []
+    for _ in range(number_configurations):
+        kind = ("sc", "bcc", "fcc")[int(rng.integers(3))]
+        basis = bases[kind]
+        a = float(rng.uniform(3.4, 4.4))
+        reps = int(rng.integers(2, 4))
+        cells = np.array(
+            [(x, y, z) for x in range(reps) for y in range(reps)
+             for z in range(reps)],
+            np.float64,
+        )
+        frac = (cells[:, None, :] + basis[None, :, :]).reshape(-1, 3) / reps
+        cell = np.diag([a * reps] * 3)
+        pos = frac @ cell + rng.normal(0.0, 0.08, (frac.shape[0], 3))
+        n = pos.shape[0]
+        zs = rng.choice(element_pool, size=2, replace=False)
+        z = np.where(rng.random(n) < rng.uniform(0.2, 0.8), zs[0], zs[1]).astype(
+            np.int32
+        )
+        senders, receivers, shifts = radius_graph_pbc(
+            pos, cell, radius, max_neighbours
+        )
+        # LJ on the shift-corrected periodic displacements, via the shared
+        # helper whose halving/receiver-only accumulation keeps F = -dE/dpos
+        # exact on symmetric edge lists
+        sigma = a / np.sqrt(2.0) / 2.0 ** (1.0 / 6.0)
+        energy, forces = _lj_targets(
+            pos, senders, receivers, 0.5, sigma, shifts=shifts
+        )
+        graphs.append(
+            Graph(
+                x=z[:, None].astype(np.float32),
+                pos=pos.astype(np.float32),
+                senders=senders,
+                receivers=receivers,
+                edge_shifts=shifts.astype(np.float32),
+                cell=cell.astype(np.float32),
+                graph_targets={"energy": np.asarray([energy / n], np.float32)},
+                node_targets={"forces": forces.astype(np.float32)},
                 z=z.copy(),
             )
         )

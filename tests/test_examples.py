@@ -95,6 +95,16 @@ def pytest_example_open_catalyst(tmp_path):
     assert "force MAE" in out
 
 
+def pytest_example_mptrj(tmp_path):
+    """MPTrj flow: periodic crystals (cell + shift vectors through columnar)
+    with MACE energy+force training (reference: examples/mptrj)."""
+    out = _run_example(
+        "examples/mptrj/mptrj.py", "--num_samples", "16", "--num_epoch", "2",
+        timeout=560, cwd=str(tmp_path),
+    )
+    assert "force MAE" in out
+
+
 def pytest_example_multibranch():
     out = _run_example("examples/multibranch/train.py", "--epochs", "2")
     assert "epoch 1:" in out
