@@ -1,0 +1,19 @@
+"""DFTB UV spectrum example (discrete): molecule -> binned excitation
+intensities (reference: examples/dftb_uv_spectrum/
+train_discrete_uv_spectrum.py). Same flow as the smooth variant with
+histogram binning instead of Gaussian broadening.
+
+    python examples/dftb_uv_spectrum/train_discrete_uv_spectrum.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+import train_smooth_uv_spectrum as smooth_mod
+
+smooth_mod.SMOOTH = False
+
+if __name__ == "__main__":
+    smooth_mod.main()

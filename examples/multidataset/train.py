@@ -1,0 +1,133 @@
+"""Multidataset GFM example: one model trained over the five-dataset
+chemistry fleet (ANI1x + QM7x + MPTrj + Alexandria + Transition1x shaped
+analogs) — the single-branch "graph foundation model" flow (reference:
+examples/multidataset/train.py + gfm_multitasking.json: merged ADIOS
+datasets, energy + force multitask, proportional sampling;
+the branch-parallel variant lives in examples/multibranch).
+
+Each family generator contributes graphs re-tagged with ``dataset_id``;
+targets are normalized per-dataset (energy per atom, centered) so one
+energy head can serve all five — the reference's
+energy_linear_regression.py pre-transform plays the same role.
+``--balance`` draws samples with per-family weights so small families get
+equal step budget (the uneven-branch analog, data.branch_sample_weights).
+
+    python examples/multidataset/train.py [--num_per_dataset 64] [--balance]
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import hydragnn_tpu
+from hydragnn_tpu.data import (
+    alexandria_shaped_dataset,
+    ani1x_shaped_dataset,
+    mptrj_shaped_dataset,
+    qm7x_shaped_dataset,
+    split_dataset,
+    transition1x_shaped_dataset,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+# maker + how each family stores its graph energy target: "total" (divide
+# by num_nodes here), "per_atom" (already E/n), or "scalar" (a non-energy
+# graph property, HLGAP for qm7x — used as-is, no per-atom scaling)
+FAMILIES = {
+    "ani1x": (ani1x_shaped_dataset, "total"),
+    "qm7x": (qm7x_shaped_dataset, "scalar"),
+    "mptrj": (mptrj_shaped_dataset, "per_atom"),
+    "alexandria": (alexandria_shaped_dataset, "per_atom"),
+    "transition1x": (transition1x_shaped_dataset, "total"),
+}
+
+
+def build_merged(num_per_dataset, radius, max_neighbours):
+    merged = []
+    for ds_id, (name, (maker, energy_kind)) in enumerate(FAMILIES.items()):
+        graphs = maker(
+            number_configurations=num_per_dataset, radius=radius,
+            max_neighbours=max_neighbours,
+        )
+        # uniform contract across families: input x = [Z], graph target =
+        # centered per-atom energy (or the family's scalar property),
+        # node target = forces (zero where the family has none)
+        out = []
+        energies = []
+        for g in graphs:
+            e = g.graph_targets["energy"][0] if g.graph_targets else g.graph_y[0]
+            if energy_kind == "total":
+                e = e / g.num_nodes
+            energies.append(e)
+        e_mean = float(np.mean(energies))
+        for g, e in zip(graphs, energies):
+            forces = (
+                g.node_targets["forces"]
+                if g.node_targets and "forces" in g.node_targets
+                else np.zeros((g.num_nodes, 3), np.float32)
+            )
+            out.append(dataclasses.replace(
+                g,
+                x=np.asarray(g.z, np.float32)[:, None],
+                graph_y=None,
+                graph_targets={"energy": np.asarray([e - e_mean], np.float32)},
+                node_targets={"force": forces.astype(np.float32)},
+                dataset_id=ds_id,
+                # molecular families carry no PBC shifts; zero-fill so the
+                # batch stacker sees a uniform schema across the fleet
+                edge_shifts=(
+                    g.edge_shifts
+                    if g.edge_shifts is not None
+                    else np.zeros((g.num_edges, 3), np.float32)
+                ),
+            ))
+        print(f"{name}: {len(out)} graphs (dataset_id={ds_id})")
+        merged += out
+    return merged
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_per_dataset", type=int, default=64)
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--mpnn_type", default=None)
+    ap.add_argument("--balance", action="store_true",
+                    help="equal per-family step budget via weighted draws")
+    args = ap.parse_args()
+
+    with open(os.path.join(_HERE, "gfm_multitasking.json")) as f:
+        config = json.load(f)
+    arch = config["NeuralNetwork"]["Architecture"]
+    if args.mpnn_type:
+        arch["mpnn_type"] = args.mpnn_type
+    if args.num_epoch:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+    if args.balance:
+        config["NeuralNetwork"]["Training"]["balance_branch_sampling"] = True
+
+    merged = build_merged(
+        args.num_per_dataset, arch["radius"], arch["max_neighbours"]
+    )
+    tr, va, te = split_dataset(merged, 0.8, seed=0)
+
+    model, state, hist, config, loaders, mm = hydragnn_tpu.run_training(
+        config, datasets=(tr, va, te)
+    )
+    tot, tasks, preds, trues = hydragnn_tpu.run_prediction(
+        config, model_state=state, datasets=(tr, va, te)
+    )
+    for name in ("energy", "force"):
+        mae = float(np.mean(np.abs(preds[name] - trues[name])))
+        print(f"{name} MAE {mae:.5f}")
+    print(f"test loss {tot:.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Multidataset GFM HPO example: hyperparameter search over the merged
+five-dataset GFM flow (reference: examples/multidataset_hpo/gfm.py +
+gfm_deephyper_multi.py — DeepHyper searches over the multidataset config,
+one SLURM allocation carved per trial; the TPU analog of the per-trial
+node carving is the per-trial ``trial_offset`` seed plus the launch
+recipes in run-scripts/).
+
+    python examples/multidataset_hpo/gfm.py [--num_trials 3]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from hydragnn_tpu.hpo import run_hpo
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_MULTIDATASET = os.path.join(_HERE, "..", "multidataset")
+sys.path.insert(0, _MULTIDATASET)
+
+SEARCH_SPACE = {
+    "NeuralNetwork/Training/Optimizer/learning_rate": ("loguniform", 3e-4, 3e-2),
+    "NeuralNetwork/Architecture/hidden_dim": [32, 50, 64],
+    "NeuralNetwork/Architecture/mpnn_type": ["EGNN", "SchNet", "PNA"],
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_trials", type=int, default=3)
+    ap.add_argument("--num_per_dataset", type=int, default=32)
+    ap.add_argument("--num_epoch", type=int, default=3)
+    ap.add_argument("--trial_offset", type=int, default=0,
+                    help="offset into the search (parallel HPO shards)")
+    args = ap.parse_args()
+
+    import train as multidataset_train  # examples/multidataset/train.py
+
+    with open(os.path.join(_MULTIDATASET, "gfm_multitasking.json")) as f:
+        base_config = json.load(f)
+    base_config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    arch = base_config["NeuralNetwork"]["Architecture"]
+    merged = multidataset_train.build_merged(
+        args.num_per_dataset, arch["radius"], arch["max_neighbours"]
+    )
+    from hydragnn_tpu.data import split_dataset
+
+    datasets = split_dataset(merged, 0.8, seed=0)
+
+    def objective(config):
+        import hydragnn_tpu
+
+        _, _, hist, *_ = hydragnn_tpu.run_training(config, datasets=datasets)
+        return float(np.min(hist["val"]))
+
+    best, trials = run_hpo(
+        base_config,
+        SEARCH_SPACE,
+        num_trials=args.num_trials,
+        trial_offset=args.trial_offset,
+        objective=objective,
+    )
+    for i, t in enumerate(trials):
+        a = t["config"]["NeuralNetwork"]["Architecture"]
+        print(f"trial {i}: loss {t['loss']:.5f} {a['mpnn_type']} hidden {a['hidden_dim']}")
+    a = best["NeuralNetwork"]["Architecture"]
+    print(f"best: {a['mpnn_type']} hidden {a['hidden_dim']}")
+
+
+if __name__ == "__main__":
+    main()

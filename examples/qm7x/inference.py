@@ -1,0 +1,45 @@
+"""QM7-X inference: load a trained checkpoint and predict on the test split
+(reference: examples/qm7x/inference.py — standalone prediction driver).
+
+Run train.py first so logs/<name>/ holds a checkpoint, then:
+
+    python examples/qm7x/inference.py [--single_tasking]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import hydragnn_tpu
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--single_tasking", action="store_true")
+    args = ap.parse_args()
+
+    cfg = "qm7x_single_tasking.json" if args.single_tasking else "qm7x.json"
+    with open(os.path.join(_HERE, cfg)) as f:
+        config = json.load(f)
+    data_path = os.path.join(os.getcwd(), config["Dataset"]["path"]["total"])
+    config["Dataset"]["path"]["total"] = data_path
+    if not os.path.isdir(data_path):
+        raise SystemExit("dataset missing - run examples/qm7x/train.py first")
+
+    # loads the checkpoint saved by run_training from logs/<log_name>/
+    tot, tasks, preds, trues = hydragnn_tpu.run_prediction(config)
+    for name in config["NeuralNetwork"]["Variables_of_interest"]["output_names"]:
+        mae = float(np.mean(np.abs(preds[name] - trues[name])))
+        print(f"{name} MAE {mae:.5f}")
+    print(f"test loss {tot:.5f}")
+
+
+if __name__ == "__main__":
+    main()

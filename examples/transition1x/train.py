@@ -1,0 +1,68 @@
+"""Transition1x example: reaction-path energy training through the columnar
+format (reference: examples/transition1x/train.py + dataloader.py — NEB
+reaction-path configurations near transition states, energy regression).
+
+The real Transition1x HDF5 is not downloadable here (zero egress); the
+dataset is the Transition1x-*shaped* generator
+(``transition1x_shaped_dataset``: interpolated reactant->product paths with
+an activation-barrier energy bump — the defining structure of the real
+dataset, which samples geometries *around* transition states).
+
+    python examples/transition1x/train.py [--num_samples 256]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+import hydragnn_tpu
+from hydragnn_tpu.data import ColumnarWriter, transition1x_shaped_dataset
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_dataset(path, num_samples, radius, max_neighbours):
+    if os.path.isdir(path):
+        return
+    graphs = transition1x_shaped_dataset(
+        number_configurations=num_samples, radius=radius,
+        max_neighbours=max_neighbours,
+    )
+    ColumnarWriter(path).add(graphs).save()
+    print(f"wrote {len(graphs)} Transition1x-shaped path samples -> {path}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mpnn_type", default=None)
+    ap.add_argument("--num_epoch", type=int, default=None)
+    ap.add_argument("--num_samples", type=int, default=256)
+    args = ap.parse_args()
+
+    with open(os.path.join(_HERE, "transition1x_energy.json")) as f:
+        config = json.load(f)
+    arch = config["NeuralNetwork"]["Architecture"]
+    if args.mpnn_type:
+        arch["mpnn_type"] = args.mpnn_type
+    if args.num_epoch:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
+
+    data_path = os.path.join(os.getcwd(), config["Dataset"]["path"]["total"])
+    config["Dataset"]["path"]["total"] = data_path
+    build_dataset(
+        data_path, args.num_samples, arch["radius"], arch["max_neighbours"]
+    )
+
+    model, state, hist, config, loaders, mm = hydragnn_tpu.run_training(config)
+    tot, tasks, preds, trues = hydragnn_tpu.run_prediction(config, model_state=state)
+    mae = float(np.mean(np.abs(preds["energy"] - trues["energy"])))
+    print(f"test loss {tot:.5f}; energy MAE {mae:.5f}")
+
+
+if __name__ == "__main__":
+    main()

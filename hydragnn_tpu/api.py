@@ -203,6 +203,18 @@ def prepare_data(
         host_index=host_index,
         num_shards=num_shards,
     )
+    # equal per-dataset step budget for GFM fleets: weighted draws with
+    # replacement, the SPMD analog of the reference's uneven branch process
+    # groups (examples/multibranch/train.py:166-213; data.branch_sample_weights)
+    balance = bool(training.get("balance_branch_sampling", False))
+    sample_weights = None
+    if balance:
+        from .data import branch_sample_weights
+
+        ids = sorted({g.dataset_id for g in trainset})
+        sample_weights = branch_sample_weights(
+            trainset, {i: 1.0 for i in ids}
+        )
     train_loader = GraphLoader(
         trainset,
         batch_size,
@@ -210,8 +222,9 @@ def prepare_data(
         seed=0,
         # RandomSampler-with-replacement / fixed-draw modes
         # (reference: load_data.py:237-274)
-        oversampling=bool(training.get("oversampling", False)),
+        oversampling=bool(training.get("oversampling", False)) or balance,
         num_samples=training.get("num_samples"),
+        sample_weights=sample_weights,
         # multi-host batches must stay full so every process steps in
         # lockstep with identical shard shapes
         drop_last=jax.process_count() > 1,

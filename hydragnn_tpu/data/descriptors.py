@@ -115,17 +115,26 @@ def atomic_descriptors(z, one_hot_period_group: bool = True) -> np.ndarray:
 
 
 def smiles_to_graph(smiles: str, radius: float = 10.0) -> Graph:
-    """SMILES -> Graph with RDKit 3D embedding; raises ImportError with a
-    clear message when rdkit is unavailable
-    (reference: smiles_utils.generate_graphdata)."""
+    """SMILES -> Graph with RDKit 3D embedding; falls back to the in-tree
+    dependency-free SMILES reader (data/smiles.py) when rdkit is
+    unavailable (reference: smiles_utils.generate_graphdata)."""
     try:
         from rdkit import Chem
         from rdkit.Chem import AllChem
-    except ImportError as e:
-        raise ImportError(
-            "smiles_to_graph needs rdkit, which is not installed in this "
-            "environment; install rdkit or provide 3D geometries directly"
-        ) from e
+    except ImportError:
+        import warnings
+
+        from .smiles import smiles_to_graph as _native
+
+        warnings.warn(
+            "rdkit unavailable: smiles_to_graph is using the in-tree SMILES "
+            "reader, whose node-feature table ([Z, degree, charge, aromatic, "
+            "n_H] + bond-order edge_attr) differs from the rdkit path's "
+            "atomic_descriptors table — datasets/checkpoints built with one "
+            "path are not feature-compatible with the other",
+            stacklevel=2,
+        )
+        return _native(smiles)
     mol = Chem.MolFromSmiles(smiles)
     mol = Chem.AddHs(mol)
     AllChem.EmbedMolecule(mol, randomSeed=0)

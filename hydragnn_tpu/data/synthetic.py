@@ -116,6 +116,18 @@ def _configuration(rng, uc, types, number_neighbors, linear_only, radius, max_ne
     )
 
 
+def supercell_frac(basis: np.ndarray, reps: int) -> np.ndarray:
+    """Fractional coordinates of a ``reps^3`` supercell of ``basis`` (one
+    row per atom, x-major cell order) — shared by the periodic generators
+    (mptrj/alexandria/omat24/eam)."""
+    cells = np.array(
+        [(x, y, z) for x in range(reps) for y in range(reps)
+         for z in range(reps)],
+        np.float64,
+    )
+    return (cells[:, None, :] + basis[None, :, :]).reshape(-1, 3) / reps
+
+
 def _symmetrize_edges(senders: np.ndarray, receivers: np.ndarray):
     """Every pair must appear in both directions or the 0.5-per-edge energy
     sum and the receiver-side force accumulation break Newton's third law."""
@@ -355,12 +367,7 @@ def mptrj_shaped_dataset(
         basis = bases[kind]
         a = float(rng.uniform(3.4, 4.4))
         reps = int(rng.integers(2, 4))
-        cells = np.array(
-            [(x, y, z) for x in range(reps) for y in range(reps)
-             for z in range(reps)],
-            np.float64,
-        )
-        frac = (cells[:, None, :] + basis[None, :, :]).reshape(-1, 3) / reps
+        frac = supercell_frac(basis, reps)
         cell = np.diag([a * reps] * 3)
         pos = frac @ cell + rng.normal(0.0, 0.08, (frac.shape[0], 3))
         n = pos.shape[0]

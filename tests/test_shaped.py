@@ -1,0 +1,169 @@
+"""Shaped-generator invariants: shapes, determinism, force consistency.
+
+The closed-form targets are only useful if they are *right* — in particular
+F = -dE/dpos for the physics families (the reference's LJ example asserts the
+same property for its dataset, examples/LennardJones/LJ_data.py). The EAM
+Finnis-Sinclair analytic gradient is checked against numerical
+differentiation here.
+"""
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data import (
+    alexandria_shaped_dataset,
+    ani1x_shaped_dataset,
+    eam_bulk_dataset,
+    odac23_shaped_dataset,
+    omat24_shaped_dataset,
+    omol25_shaped_dataset,
+    parse_smiles,
+    qm7x_shaped_dataset,
+    smiles_table_dataset,
+    transition1x_shaped_dataset,
+    uv_spectrum_shaped_dataset,
+    zinc_shaped_dataset,
+)
+from hydragnn_tpu.data.shaped import _fs_eam_targets_pbc
+from hydragnn_tpu.data.smiles import SmilesError, smiles_to_graph
+
+
+@pytest.mark.parametrize(
+    "maker",
+    [
+        ani1x_shaped_dataset,
+        qm7x_shaped_dataset,
+        transition1x_shaped_dataset,
+        omol25_shaped_dataset,
+        alexandria_shaped_dataset,
+        omat24_shaped_dataset,
+        odac23_shaped_dataset,
+        eam_bulk_dataset,
+        zinc_shaped_dataset,
+    ],
+)
+def pytest_shaped_basic_invariants(maker):
+    graphs = maker(8)
+    assert len(graphs) >= 8 or maker is transition1x_shaped_dataset
+    for g in graphs:
+        n, e = g.num_nodes, g.num_edges
+        assert n > 1 and e > 0
+        assert g.pos.shape == (n, 3)
+        assert g.senders.max() < n and g.receivers.max() < n
+        assert g.x.shape[0] == n
+        assert np.isfinite(g.x).all()
+        assert g.graph_y is not None and np.isfinite(g.graph_y).all()
+        if g.edge_shifts is None:
+            # symmetric edge lists (every pair in both directions); PBC
+            # graphs may drop one direction at the neighbour cap — the LJ
+            # closed form stays exact either way (synthetic._lj_targets)
+            pairs = set(zip(g.senders.tolist(), g.receivers.tolist()))
+            assert all((j, i) in pairs for (i, j) in pairs)
+    # determinism
+    again = maker(8)
+    np.testing.assert_array_equal(graphs[0].x, again[0].x)
+
+
+def pytest_eam_forces_match_numerical_gradient():
+    graphs = eam_bulk_dataset(2, seed=5)
+    g = graphs[0]
+    pos = g.pos.astype(np.float64)
+    z = g.z
+    cutoff = 3.6
+
+    def total_energy(p):
+        e, _ = _fs_eam_targets_pbc(
+            p, g.senders, g.receivers, z, cutoff,
+            g.edge_shifts.astype(np.float64),
+        )
+        return e.sum()
+
+    _, forces = _fs_eam_targets_pbc(
+        pos, g.senders, g.receivers, z, cutoff, g.edge_shifts.astype(np.float64)
+    )
+    eps = 1e-6
+    rng = np.random.default_rng(0)
+    for idx in rng.integers(0, pos.shape[0], size=4):
+        for dim in range(3):
+            p1, p2 = pos.copy(), pos.copy()
+            p1[idx, dim] += eps
+            p2[idx, dim] -= eps
+            num = -(total_energy(p1) - total_energy(p2)) / (2 * eps)
+            assert abs(num - forces[idx, dim]) < 1e-5 * max(1.0, abs(num)), (
+                f"atom {idx} dim {dim}: analytic {forces[idx, dim]} vs "
+                f"numerical {num}"
+            )
+
+
+def pytest_eam_graph_energy_is_sum_of_atomic():
+    g = eam_bulk_dataset(2, seed=9)[0]
+    atomic = g.x[:, 1]
+    np.testing.assert_allclose(g.graph_y[0], atomic.sum(), rtol=1e-5)
+
+
+def pytest_qm7x_five_target_table():
+    g = qm7x_shaped_dataset(4)[0]
+    assert g.x.shape[1] == 7  # Z, fx, fy, fz, hCHG, hVDIP, hRAT
+    assert g.graph_y.shape == (1,)  # HLGAP
+    assert 0.0 < g.graph_y[0] < 2.0
+    assert (g.x[:, 6] >= 0).all() and (g.x[:, 6] <= 1).all()  # hRAT ratio
+
+
+def pytest_uv_spectrum_shapes():
+    smooth = uv_spectrum_shaped_dataset(4, num_bins=37, smooth=True)
+    disc = uv_spectrum_shaped_dataset(4, num_bins=37, smooth=False)
+    for g in smooth + disc:
+        assert g.graph_y.shape == (37,)
+        assert (g.graph_y >= 0).all()
+    assert not np.allclose(smooth[0].graph_y, disc[0].graph_y)
+
+
+def pytest_periodic_families_carry_pbc_channels():
+    for g in alexandria_shaped_dataset(2) + omat24_shaped_dataset(2):
+        assert g.cell is not None and g.cell.shape == (3, 3)
+        assert g.edge_shifts is not None and g.edge_shifts.shape == (g.num_edges, 3)
+        assert g.node_targets["forces"].shape == (g.num_nodes, 3)
+
+
+def pytest_smiles_parser_basics():
+    # ethanol: 3 heavy + 6 H after explicit-H expansion
+    g = smiles_to_graph("CCO")
+    assert g.num_nodes == 9
+    assert sorted(np.unique(g.z).tolist()) == [1, 6, 8]
+    # benzene: aromatic ring, 6 C + 6 H, 12 ring-bond edges + 12 C-H edges
+    g = smiles_to_graph("c1ccccc1")
+    assert g.num_nodes == 12
+    assert g.num_edges == 24
+    assert (g.x[:6, 3] == 1).all()  # aromatic flag column
+    # charge + bracket atom
+    g = smiles_to_graph("[NH4+]", add_hydrogens=True)
+    assert g.num_nodes == 5
+    assert g.x[0, 2] == 1.0  # charge column
+    # branches and ring-closure with bond order
+    g = smiles_to_graph("CC(=O)Oc1ccccc1C(=O)O")  # aspirin
+    assert int((g.z == 6).sum()) == 9 and int((g.z == 8).sum()) == 4
+    assert g.num_nodes == 21  # aspirin C9H8O4
+
+
+def pytest_smiles_parser_errors():
+    with pytest.raises(SmilesError):
+        parse_smiles("C(C")
+    with pytest.raises(SmilesError):
+        parse_smiles("C1CC")
+    with pytest.raises(SmilesError):
+        parse_smiles("C$")
+
+
+def pytest_smiles_3d_embedding_respects_bonds():
+    g = smiles_to_graph("CCO", seed=3)
+    d = np.linalg.norm(g.pos[g.senders] - g.pos[g.receivers], axis=1)
+    assert (d > 0.6).all() and (d < 2.2).all()
+
+
+def pytest_smiles_table_dataset_trains_shape():
+    graphs = smiles_table_dataset(16)
+    assert len(graphs) == 16
+    for g in graphs:
+        assert g.x.shape[1] == 5
+        assert g.graph_y.shape == (1,)
+        assert np.isfinite(g.graph_y).all()
