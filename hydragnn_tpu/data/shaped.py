@@ -138,8 +138,10 @@ def transition1x_shaped_dataset(number_configurations: int = 256,
     rng = np.random.default_rng(seed)
     graphs: List[Graph] = []
     n_paths = max(1, number_configurations // 8)
-    per_path = max(1, number_configurations // n_paths)
-    for _ in range(n_paths):
+    # distribute the remainder so exactly number_configurations come back
+    per_path_counts = np.full(n_paths, number_configurations // n_paths)
+    per_path_counts[: number_configurations - int(per_path_counts.sum())] += 1
+    for per_path in per_path_counts:
         n_heavy = int(rng.integers(2, 8))
         n_h = int(np.clip(rng.poisson(1.3 * n_heavy), 0, 16))
         z = np.concatenate([
@@ -150,7 +152,7 @@ def transition1x_shaped_dataset(number_configurations: int = 256,
         z = z[: reactant.shape[0]]
         product = reactant + rng.normal(0.0, 0.35, reactant.shape)
         barrier = float(rng.uniform(0.5, 2.0))
-        for _ in range(per_path):
+        for _ in range(int(per_path)):
             lam = float(rng.uniform(0.0, 1.0))
             pos = (1 - lam) * reactant + lam * product
             pos = pos + rng.normal(0.0, 0.03, pos.shape)

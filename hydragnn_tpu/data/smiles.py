@@ -263,6 +263,12 @@ def smiles_to_graph(
             symbols, aromatic, charges, explicit_h, order_sum
         )
     ]
+    unknown = sorted({sym for sym in symbols if sym not in _Z})
+    if unknown:
+        raise SmilesError(
+            f"unsupported element(s) {unknown} in {s!r} (supported: "
+            f"{sorted(_Z)})"
+        )
     z = [_Z[sym] for sym in symbols]
     deg = np.zeros(len(symbols))
     for a, b, _ in bonds:
@@ -338,10 +344,13 @@ def smiles_table_dataset(
     SMILES CSVs and train a gap regression."""
     rng = np.random.default_rng(seed)
     if target_fn is None:
+        from .shaped import _en_of
+
         def target_fn(g: Graph) -> float:
-            en = np.asarray([_endict.get(int(v), 1.8) for v in g.z])
             arom_frac = float(g.x[:, 3].mean())
-            return float(en.mean() + 0.8 * arom_frac - 0.01 * g.num_nodes)
+            return float(
+                _en_of(g.z).mean() + 0.8 * arom_frac - 0.01 * g.num_nodes
+            )
     graphs: List[Graph] = []
     while len(graphs) < number_configurations:
         s = random_drug_smiles(rng, int(rng.integers(2, 5)))
@@ -352,7 +361,3 @@ def smiles_table_dataset(
         g.graph_y = np.asarray([target_fn(g)], np.float32)
         graphs.append(g)
     return graphs
-
-
-_endict = {1: 2.20, 6: 2.55, 7: 3.04, 8: 3.44, 9: 3.98, 16: 2.58,
-           17: 3.16, 35: 2.96, 53: 2.66, 15: 2.19, 5: 2.04}

@@ -166,6 +166,140 @@ class Visualizer:
         )
         plt.close(fig)
 
+    @staticmethod
+    def _hist2d_contour(ax, t: np.ndarray, p: np.ndarray, bins: int = 40):
+        """Density-contour parity (reference: __hist2d_contour,
+        visualizer.py:83-91) — readable where a raw scatter saturates."""
+        h, xe, ye = np.histogram2d(t.ravel(), p.ravel(), bins=bins)
+        xc, yc = 0.5 * (xe[:-1] + xe[1:]), 0.5 * (ye[:-1] + ye[1:])
+        ax.contourf(xc, yc, np.log1p(h.T), levels=12, cmap="viridis")
+
+    def create_parity_plot_and_error_histogram_scalar(
+        self,
+        varname: str,
+        true_values: np.ndarray,
+        predicted_values: np.ndarray,
+        density: bool = True,
+    ) -> None:
+        """Two-panel scalar summary: density-contour parity + error
+        histogram (reference: create_parity_plot_and_error_histogram_scalar,
+        visualizer.py:281-385)."""
+        plt = _plt()
+        t = np.asarray(true_values, np.float64).ravel()
+        p = np.asarray(predicted_values, np.float64).ravel()
+        fig, axs = plt.subplots(1, 2, figsize=(8, 3.6))
+        if density and t.size > 200:
+            self._hist2d_contour(axs[0], t, p)
+        else:
+            axs[0].scatter(t, p, s=4, alpha=0.5)
+        lo, hi = float(min(t.min(), p.min())), float(max(t.max(), p.max()))
+        axs[0].plot([lo, hi], [lo, hi], "w--" if density else "k--", linewidth=1)
+        axs[0].set_xlabel(f"true {varname}")
+        axs[0].set_ylabel(f"predicted {varname}")
+        err = p - t
+        axs[1].hist(err, bins=40)
+        axs[1].set_xlabel(f"{varname} error")
+        axs[1].set_ylabel("count")
+        axs[1].set_title(
+            f"MAE {np.abs(err).mean():.4f}  RMSE {np.sqrt((err**2).mean()):.4f}"
+        )
+        fig.tight_layout()
+        fig.savefig(
+            os.path.join(self.outdir, f"parity_errhist_{varname}.png"), dpi=120
+        )
+        plt.close(fig)
+
+    def create_error_histogram_per_node(
+        self,
+        varname: str,
+        true_values: np.ndarray,
+        predicted_values: np.ndarray,
+        node_index: np.ndarray,
+        max_nodes: int = 16,
+    ) -> None:
+        """Per-node-position error histograms for nodal outputs (reference:
+        create_error_histogram_per_node, visualizer.py:387-465): one panel
+        per node slot, errors pooled across samples."""
+        plt = _plt()
+        t = np.asarray(true_values, np.float64).ravel()
+        p = np.asarray(predicted_values, np.float64).ravel()
+        idx = np.asarray(node_index).ravel()
+        slots = np.unique(idx)[:max_nodes]
+        cols = min(4, len(slots))
+        rows = int(np.ceil(len(slots) / cols))
+        fig, axs = plt.subplots(
+            rows, cols, figsize=(3 * cols, 2.4 * rows), squeeze=False
+        )
+        for k, slot in enumerate(slots):
+            ax = axs[k // cols][k % cols]
+            m = idx == slot
+            ax.hist(p[m] - t[m], bins=25)
+            ax.set_title(f"node {int(slot)}", fontsize=8)
+        for k in range(len(slots), rows * cols):
+            axs[k // cols][k % cols].axis("off")
+        fig.suptitle(f"{varname}: per-node error")
+        fig.tight_layout()
+        fig.savefig(
+            os.path.join(self.outdir, f"errhist_pernode_{varname}.png"), dpi=120
+        )
+        plt.close(fig)
+
+    def create_parity_plot_vector(
+        self,
+        varname: str,
+        true_values: np.ndarray,
+        predicted_values: np.ndarray,
+    ) -> None:
+        """Graph-level vector parity: one panel per component plus the
+        magnitude (reference: create_parity_plot_vector,
+        visualizer.py:467-517)."""
+        plt = _plt()
+        t = np.asarray(true_values, np.float64)
+        p = np.asarray(predicted_values, np.float64)
+        t = t.reshape(t.shape[0], -1)
+        p = p.reshape(p.shape[0], -1)
+        k = t.shape[1]
+        fig, axs = plt.subplots(1, k + 1, figsize=(3.3 * (k + 1), 3.3))
+        for c in range(k):
+            axs[c].scatter(t[:, c], p[:, c], s=4, alpha=0.5)
+            lo = float(min(t[:, c].min(), p[:, c].min()))
+            hi = float(max(t[:, c].max(), p[:, c].max()))
+            axs[c].plot([lo, hi], [lo, hi], "k--", linewidth=1)
+            axs[c].set_title(f"{varname}[{c}]", fontsize=9)
+        tm, pm = np.linalg.norm(t, axis=1), np.linalg.norm(p, axis=1)
+        axs[k].scatter(tm, pm, s=4, alpha=0.5)
+        axs[k].set_title("magnitude", fontsize=9)
+        fig.tight_layout()
+        fig.savefig(
+            os.path.join(self.outdir, f"parity_vector_{varname}.png"), dpi=120
+        )
+        plt.close(fig)
+
+    def create_plot_global(
+        self,
+        trues: Dict[str, np.ndarray],
+        preds: Dict[str, np.ndarray],
+        output_names: Optional[Sequence[str]] = None,
+    ) -> None:
+        """One overview figure with a parity panel per output head
+        (reference: create_plot_global, visualizer.py:722-732)."""
+        plt = _plt()
+        names = list(output_names or trues)
+        fig, axs = plt.subplots(
+            1, len(names), figsize=(3.6 * len(names), 3.6), squeeze=False
+        )
+        for k, name in enumerate(names):
+            ax = axs[0][k]
+            t = np.asarray(trues[name]).ravel()
+            p = np.asarray(preds[name]).ravel()
+            ax.scatter(t, p, s=3, alpha=0.4)
+            lo, hi = float(min(t.min(), p.min())), float(max(t.max(), p.max()))
+            ax.plot([lo, hi], [lo, hi], "k--", linewidth=1)
+            ax.set_title(name, fontsize=9)
+        fig.tight_layout()
+        fig.savefig(os.path.join(self.outdir, "global_overview.png"), dpi=120)
+        plt.close(fig)
+
     def num_nodes_plot(self, nodes_num_list: Sequence[int]) -> None:
         """Histogram of graph sizes in the dataset (reference:
         num_nodes_plot, visualizer.py:734-742)."""

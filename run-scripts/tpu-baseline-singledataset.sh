@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Baseline single-dataset runs: train one model per GFM family dataset
+# (the multibranch comparison baseline; reference:
+# run-scripts/SC25-baseline-singledataset{0..4}.sh + job-baseline-*.sh).
+# Index selects the family: 0=ani1x 1=qm7x 2=mptrj 3=alexandria
+# 4=transition1x; "all" loops over every family sequentially.
+#
+#   ./run-scripts/tpu-baseline-singledataset.sh TPU_NAME ZONE INDEX [ARGS...]
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?gce zone}
+INDEX=${3:?dataset index 0-4 or "all"}
+shift 3
+
+REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
+DRIVERS=(
+  "examples/ani1_x/train.py"
+  "examples/qm7x/train.py"
+  "examples/mptrj/mptrj.py"
+  "examples/alexandria/train.py"
+  "examples/transition1x/train.py"
+)
+
+ARGS=""
+if [ "$#" -gt 0 ]; then
+  ARGS=$(printf '%q ' "$@")
+fi
+
+run_one() {
+  local driver=$1
+  echo "== baseline: ${driver}"
+  gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+    --zone "${ZONE}" \
+    --worker=all \
+    --command "cd ${REPO_DIR} && \
+      ${HYDRAGNN_COORDINATOR:+HYDRAGNN_COORDINATOR=${HYDRAGNN_COORDINATOR}} \
+      python ${driver} ${ARGS}"
+}
+
+if [ "${INDEX}" = "all" ]; then
+  for d in "${DRIVERS[@]}"; do run_one "$d"; done
+else
+  run_one "${DRIVERS[$INDEX]}"
+fi

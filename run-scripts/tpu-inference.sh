@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Inference over a trained checkpoint on a pod slice (reference:
+# run-scripts/SC25-inference.sh — run_prediction over the saved multibranch
+# model). The driver must call hydragnn_tpu.run_prediction (e.g.
+# examples/qm7x/inference.py).
+#
+#   ./run-scripts/tpu-inference.sh TPU_NAME ZONE DRIVER [ARGS...]
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?gce zone}
+DRIVER=${3:?inference driver .py}
+shift 3
+
+REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
+
+ARGS=""
+if [ "$#" -gt 0 ]; then
+  ARGS=$(printf '%q ' "$@")
+fi
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --zone "${ZONE}" \
+  --worker=all \
+  --command "cd ${REPO_DIR} && python ${DRIVER} ${ARGS}"

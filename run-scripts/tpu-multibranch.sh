@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# The SC25 multibranch GFM production run on a TPU pod slice: the five-
+# dataset multidataset/multibranch training with branch-parallel decoders
+# over the (branch, data) mesh (reference: run-scripts/SC25-multibranch.sh —
+# 128 Frontier nodes x 8 ranks over ANI1x/qm7x/MPTrj/Alexandria/
+# transition1x; job-multibranch-taskparallel.sh is the task-parallel form).
+#
+#   ./run-scripts/tpu-multibranch.sh TPU_NAME ZONE [BRANCH_SIZE] [ARGS...]
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?gce zone}
+BRANCH_SIZE=${3:-1}
+shift 3 || shift 2
+
+REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
+PER_HOST_BS=${PER_HOST_BS:-160}
+
+ARGS=""
+if [ "$#" -gt 0 ]; then
+  ARGS=$(printf '%q ' "$@")
+fi
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --zone "${ZONE}" \
+  --worker=all \
+  --command "cd ${REPO_DIR} && \
+    ${HYDRAGNN_COORDINATOR:+HYDRAGNN_COORDINATOR=${HYDRAGNN_COORDINATOR}} \
+    HYDRAGNN_TRACE_LEVEL=${HYDRAGNN_TRACE_LEVEL:-0} \
+    python examples/multibranch/train.py \
+      --branch_size ${BRANCH_SIZE} \
+      --batch_size ${PER_HOST_BS} \
+      --branch_weights \${HYDRAGNN_BRANCH_WEIGHTS:-1,1} \
+      ${ARGS}"

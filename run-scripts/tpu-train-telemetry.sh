@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Training with telemetry capture: xprof device traces (one target epoch)
+# plus host-side CPU/memory sampling per worker — the TPU analog of the
+# omnistat-instrumented runs (reference:
+# run-scripts/SC25-multibranch-omnistat.sh + job-multibranch-omnistat.sh,
+# which sample GPU telemetry alongside training).
+#
+# The framework's Profile config captures the device trace
+# ("Profile": {"enable": 1, "target_epoch": N} -> logs/<name>/xprof);
+# this script adds a vmstat sampler per worker and collects both.
+#
+#   ./run-scripts/tpu-train-telemetry.sh TPU_NAME ZONE DRIVER [ARGS...]
+set -euo pipefail
+
+TPU_NAME=${1:?tpu name}
+ZONE=${2:?gce zone}
+DRIVER=${3:?training driver .py}
+shift 3
+
+REPO_DIR=${REPO_DIR:-\$HOME/hydragnn_tpu}
+SAMPLE_SECS=${SAMPLE_SECS:-5}
+
+ARGS=""
+if [ "$#" -gt 0 ]; then
+  ARGS=$(printf '%q ' "$@")
+fi
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" \
+  --zone "${ZONE}" \
+  --worker=all \
+  --command "cd ${REPO_DIR} && \
+    (vmstat -t ${SAMPLE_SECS} > telemetry_host_\$(hostname).log 2>&1 &) && \
+    HYDRAGNN_TRACE_LEVEL=${HYDRAGNN_TRACE_LEVEL:-1} \
+    python ${DRIVER} ${ARGS}; \
+    pkill vmstat || true"
+
+# pull the host telemetry + xprof traces back
+gcloud compute tpus tpu-vm scp --zone "${ZONE}" --worker=all \
+  "${TPU_NAME}:${REPO_DIR}/telemetry_host_*.log" . || true

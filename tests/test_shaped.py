@@ -44,7 +44,7 @@ from hydragnn_tpu.data.smiles import SmilesError, smiles_to_graph
 )
 def pytest_shaped_basic_invariants(maker):
     graphs = maker(8)
-    assert len(graphs) >= 8 or maker is transition1x_shaped_dataset
+    assert len(graphs) == 8
     for g in graphs:
         n, e = g.num_nodes, g.num_edges
         assert n > 1 and e > 0
