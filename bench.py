@@ -83,6 +83,8 @@ def _production_workload():
                 "max_neighbours": 20,
                 "hidden_dim": hidden,
                 "num_conv_layers": 4,
+                # Pallas sorted-segment aggregation A/B (BENCH_SORTED=1)
+                "use_sorted_aggregation": os.getenv("BENCH_SORTED", "0") == "1",
                 "task_weights": [1.0, 100.0],
                 "output_heads": {
                     "graph": {
@@ -109,6 +111,8 @@ def _production_workload():
                 "num_epoch": 1,
                 "loss_function_type": "mae",
                 "num_pad_buckets": 3,
+                # bf16 compute vs f32 master weights (BENCH_MP=0 for f32)
+                "mixed_precision": os.getenv("BENCH_MP", "1") == "1",
                 "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
             },
         },
@@ -130,7 +134,11 @@ def _bench_production():
     variables = init_model(model, batches[0], seed=0)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
     state = TrainState.create(variables, tx)
-    step = make_train_step(model, tx)
+    step = make_train_step(
+        model,
+        tx,
+        mixed_precision=config["NeuralNetwork"]["Training"]["mixed_precision"],
+    )
     rng = jax.random.PRNGKey(0)
 
     # FLOPs per distinct batch shape, from the compiled executables

@@ -202,6 +202,8 @@ def prepare_data(
         host_count=host_count,
         host_index=host_index,
         num_shards=num_shards,
+        # receiver-sorted edges feed the Pallas segment kernel (TPU)
+        sort_edges=bool(arch.get("use_sorted_aggregation", False)),
     )
     # equal per-dataset step budget for GFM fleets: weighted draws with
     # replacement, the SPMD analog of the reference's uneven branch process
@@ -334,8 +336,9 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         mesh = make_mesh()
         state = replicate_state(state, mesh)
         cge = training.get("compute_grad_energy", False)
-        _pstep = make_parallel_train_step(model, tx, mesh, cge)
-        _peval = make_parallel_eval_step(model, mesh, cge)
+        mp = training.get("mixed_precision", False)
+        _pstep = make_parallel_train_step(model, tx, mesh, cge, mp)
+        _peval = make_parallel_eval_step(model, mesh, cge, mp)
         step_fn = lambda s, b, r: _pstep(s, promote_batch(b, mesh), r)
         # evaluate() expects (tot, tasks, aux) like make_eval_step
         eval_fn = lambda s, b: _peval(s, promote_batch(b, mesh)) + (None,)

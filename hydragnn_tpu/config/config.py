@@ -188,6 +188,26 @@ def update_config(
     else:
         arch["avg_num_neighbors"] = None
 
+    # ---- Pallas sorted-segment aggregation: static in-degree bound over
+    # EVERY split (eval batches must satisfy the cap too; the kernel gives
+    # unspecified sums for real segments past it — ops/pallas_segment.py)
+    if arch.get("use_sorted_aggregation"):
+        top = 1
+        for g in (*trainset, *valset, *testset):
+            if g.num_edges:
+                top = max(top, int(np.bincount(np.asarray(g.receivers)).max()))
+        supplied = arch.get("max_in_degree")
+        if supplied and int(supplied) < top:
+            # a stale bound copied from another run would make the kernel
+            # silently drop messages — fail loudly instead
+            raise ValueError(
+                f"max_in_degree={supplied} is below the dataset's actual "
+                f"max in-degree {top}; remove the key to auto-measure"
+            )
+        arch["max_in_degree"] = int(supplied or top)
+    arch.setdefault("use_sorted_aggregation", False)
+    arch.setdefault("max_in_degree", 0)
+
     # CGCNN keeps hidden dim = input dim without global attention
     # (reference: config_utils.py:80-87)
     if arch["mpnn_type"] == "CGCNN" and not arch["global_attn_engine"]:

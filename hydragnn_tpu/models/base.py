@@ -110,6 +110,11 @@ class ModelConfig:
     initial_bias: Optional[float] = None
     periodic_boundary_conditions: bool = False
     max_neighbours: Optional[int] = None
+    # receiver-sorted edge arrays + static in-degree bound: lets the TPU
+    # backend aggregate messages with the Pallas MXU kernel instead of a
+    # scatter (ops/segment.py segment_sum; loader sort_edges=True)
+    sorted_aggregation: bool = False
+    max_in_degree: int = 0
 
     @property
     def num_heads(self) -> int:

@@ -20,6 +20,8 @@ from .base import register_conv
 class CGConv(nn.Module):
     output_dim: int  # must equal input dim (dimension-preserving residual)
     edge_dim: int = 0
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -29,10 +31,14 @@ class CGConv(nn.Module):
         z = jnp.concatenate(parts, axis=-1)
         gate = nn.sigmoid(nn.Dense(self.output_dim)(z))
         core = nn.softplus(nn.Dense(self.output_dim)(z))
-        agg = segment_sum(gate * core, batch.receivers, batch.num_nodes, batch.edge_mask)
+        agg = segment_sum(gate * core, batch.receivers, batch.num_nodes,
+                          batch.edge_mask, sorted_ids=self.sorted_agg,
+                          max_degree=self.max_in_degree)
         return inv + agg, equiv
 
 
 @register_conv("CGCNN", is_edge_model=True)
 def make_cgcnn(cfg, in_dim, out_dim, last_layer):
-    return CGConv(output_dim=out_dim, edge_dim=cfg.edge_dim)
+    return CGConv(output_dim=out_dim, edge_dim=cfg.edge_dim,
+                  sorted_agg=cfg.sorted_aggregation,
+                  max_in_degree=cfg.max_in_degree)

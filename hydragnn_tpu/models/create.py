@@ -123,6 +123,8 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         var_output=loss_type == "GaussianNLLLoss",
         conv_checkpointing=bool(training.get("conv_checkpointing", False)),
         freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
+        sorted_aggregation=bool(arch.get("use_sorted_aggregation", False)),
+        max_in_degree=int(arch.get("max_in_degree") or 0),
         initial_bias=arch.get("initial_bias"),
         periodic_boundary_conditions=bool(arch.get("periodic_boundary_conditions", False)),
         max_neighbours=arch.get("max_neighbours"),

@@ -22,7 +22,8 @@ from .base import register_conv
 from .layers import MLP
 
 
-def coordinate_displacement(unit, gate_feat, batch, hidden_dim, tanh=False):
+def coordinate_displacement(unit, gate_feat, batch, hidden_dim, tanh=False,
+                            sorted_agg=False, max_in_degree=0):
     """Mean-aggregated coordinate displacement along (normalized) edge vectors,
     gated by a small MLP whose final layer starts near zero (gain 0.001).
     Shared by EGNN and equivariant SchNet (reference: E_GCL.coord_model,
@@ -37,7 +38,9 @@ def coordinate_displacement(unit, gate_feat, batch, hidden_dim, tanh=False):
         # bounded displacement with a learnable range (E_GCL tanh mode)
         coef = jnp.tanh(coef)
     trans = jnp.clip(unit * coef, -100.0, 100.0)
-    return segment_mean(trans, batch.receivers, batch.num_nodes, batch.edge_mask)
+    return segment_mean(trans, batch.receivers, batch.num_nodes,
+                        batch.edge_mask, sorted_ids=sorted_agg,
+                        max_degree=max_in_degree)
 
 
 class EGCL(nn.Module):
@@ -46,6 +49,9 @@ class EGCL(nn.Module):
     edge_dim: int = 0
     equivariant: bool = False
     tanh: bool = True
+    # Pallas sorted-segment aggregation (cfg.sorted_aggregation)
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -64,15 +70,18 @@ class EGCL(nn.Module):
                         final_activation=True)(jnp.concatenate(parts, axis=-1))
 
         if self.equivariant:
-            delta = coordinate_displacement(unit, edge_feat, batch,
-                                            self.hidden_dim, tanh=self.tanh)
+            delta = coordinate_displacement(
+                unit, edge_feat, batch, self.hidden_dim, tanh=self.tanh,
+                sorted_agg=self.sorted_agg, max_in_degree=self.max_in_degree,
+            )
             if self.tanh:
                 rng_scale = self.param("coords_range", nn.initializers.ones, (1,))
                 delta = delta * rng_scale * 3.0
             pos = pos + delta
 
         agg = segment_sum(edge_feat, batch.receivers, batch.num_nodes,
-                          batch.edge_mask)
+                          batch.edge_mask, sorted_ids=self.sorted_agg,
+                          max_degree=self.max_in_degree)
         out = MLP((self.hidden_dim, self.output_dim), "relu")(
             jnp.concatenate([inv, agg], axis=-1)
         )
@@ -86,4 +95,6 @@ def make_egnn(cfg, in_dim, out_dim, last_layer):
         hidden_dim=cfg.hidden_dim,
         edge_dim=cfg.edge_dim,
         equivariant=cfg.equivariance and not last_layer,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

@@ -24,6 +24,8 @@ class GATv2Conv(nn.Module):
     concat: bool = True
     negative_slope: float = 0.05
     edge_dim: int = 0
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -40,7 +42,12 @@ class GATv2Conv(nn.Module):
             logits, batch.receivers, batch.num_nodes, batch.edge_mask
         )
         msg = x_r[batch.senders] * alpha[..., None]  # [E, H, C]
-        out = segment_sum(msg, batch.receivers, batch.num_nodes, batch.edge_mask)
+        # flatten heads so the 2-D sorted-segment kernel can take the sum
+        out = segment_sum(
+            msg.reshape(-1, H * C), batch.receivers, batch.num_nodes,
+            batch.edge_mask, sorted_ids=self.sorted_agg,
+            max_degree=self.max_in_degree,
+        ).reshape(-1, H, C)
         if self.concat:
             return out.reshape(-1, H * C), equiv
         return out.mean(axis=1), equiv
@@ -56,4 +63,6 @@ def make_gat(cfg, in_dim, out_dim, last_layer):
         concat=not last_layer,
         negative_slope=0.05,
         edge_dim=cfg.edge_dim,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

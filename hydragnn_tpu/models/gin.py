@@ -17,12 +17,16 @@ from .base import register_conv
 class GINConv(nn.Module):
     output_dim: int
     eps_init: float = 100.0
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
         eps = self.param("eps", lambda _: jnp.asarray(self.eps_init, jnp.float32))
         agg = segment_sum(
-            inv[batch.senders], batch.receivers, batch.num_nodes, batch.edge_mask
+            inv[batch.senders], batch.receivers, batch.num_nodes,
+            batch.edge_mask, sorted_ids=self.sorted_agg,
+            max_degree=self.max_in_degree,
         )
         h = (1.0 + eps) * inv + agg
         h = nn.Dense(self.output_dim)(h)
@@ -33,4 +37,5 @@ class GINConv(nn.Module):
 
 @register_conv("GIN", is_edge_model=False)
 def make_gin(cfg, in_dim, out_dim, last_layer):
-    return GINConv(output_dim=out_dim)
+    return GINConv(output_dim=out_dim, sorted_agg=cfg.sorted_aggregation,
+                   max_in_degree=cfg.max_in_degree)

@@ -22,6 +22,8 @@ from .base import register_conv
 class MFConv(nn.Module):
     output_dim: int
     max_degree: int = 10
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -35,7 +37,9 @@ class MFConv(nn.Module):
         )
         bias = self.param("bias", nn.initializers.zeros, (D, self.output_dim))
         agg = segment_sum(
-            inv[batch.senders], batch.receivers, batch.num_nodes, batch.edge_mask
+            inv[batch.senders], batch.receivers, batch.num_nodes,
+            batch.edge_mask, sorted_ids=self.sorted_agg,
+            max_degree=self.max_in_degree,
         )
         deg = segment_count(batch.receivers, batch.num_nodes, batch.edge_mask)
         deg = jnp.clip(deg.astype(jnp.int32), 0, self.max_degree)
@@ -50,4 +54,6 @@ class MFConv(nn.Module):
 @register_conv("MFC", is_edge_model=False)
 def make_mfc(cfg, in_dim, out_dim, last_layer):
     max_deg = cfg.max_neighbours if cfg.max_neighbours is not None else 10
-    return MFConv(output_dim=out_dim, max_degree=int(max_deg))
+    return MFConv(output_dim=out_dim, max_degree=int(max_deg),
+                  sorted_agg=cfg.sorted_aggregation,
+                  max_in_degree=cfg.max_in_degree)

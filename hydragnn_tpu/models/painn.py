@@ -59,6 +59,8 @@ class PainnConv(nn.Module):
     radius: float
     edge_dim: int = 0
     last_layer: bool = False
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -89,7 +91,9 @@ class PainnConv(nn.Module):
         msg_v = v[batch.senders] * gate_v[:, None, :]
         msg_v = msg_v + gate_edge[:, None, :] * unit[:, :, None]
 
-        x = x + segment_sum(msg_s, batch.receivers, n, batch.edge_mask)
+        x = x + segment_sum(msg_s, batch.receivers, n, batch.edge_mask,
+                            sorted_ids=self.sorted_agg,
+                            max_degree=self.max_in_degree)
         v = v + segment_sum(msg_v, batch.receivers, n, batch.edge_mask)
 
         x, v = painn_update(x, v, self.node_size, self.last_layer)
@@ -104,4 +108,6 @@ def make_painn(cfg, in_dim, out_dim, last_layer):
         radius=cfg.radius or 5.0,
         edge_dim=cfg.edge_dim,
         last_layer=last_layer,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

@@ -38,13 +38,14 @@ def _avg_deg_stats(deg_hist: Tuple[int, ...]) -> Tuple[float, float]:
     return max(avg_log, 1e-6), max(avg_lin, 1e-6)
 
 
-def pna_aggregate(msg, batch, deg_hist):
+def pna_aggregate(msg, batch, deg_hist, sorted_agg=False, max_in_degree=0):
     """PNA aggregate-and-scale: [mean,min,max,std] aggregation x
     [identity, amplification, attenuation, linear] degree scalers.
     Shared by PNA / PNAPlus / PNAEq (reference: DegreeScalerAggregation)."""
     n = batch.num_nodes
     aggs = [
-        segment_mean(msg, batch.receivers, n, batch.edge_mask),
+        segment_mean(msg, batch.receivers, n, batch.edge_mask,
+                     sorted_ids=sorted_agg, max_degree=max_in_degree),
         segment_min(msg, batch.receivers, n, batch.edge_mask),
         segment_max(msg, batch.receivers, n, batch.edge_mask),
         segment_std(msg, batch.receivers, n, batch.edge_mask),
@@ -65,6 +66,8 @@ class PNAConv(nn.Module):
     output_dim: int
     deg_hist: Tuple[int, ...]
     edge_dim: int = 0
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -77,7 +80,8 @@ class PNAConv(nn.Module):
         f_in = inv.shape[-1]
         msg = nn.Dense(f_in)(jnp.concatenate(parts, axis=-1))
 
-        scaled = pna_aggregate(msg, batch, self.deg_hist)
+        scaled = pna_aggregate(msg, batch, self.deg_hist,
+                               self.sorted_agg, self.max_in_degree)
         # post-MLP, post_layers=1, then final linear projection
         out = nn.Dense(self.output_dim)(jnp.concatenate([inv, scaled], axis=-1))
         out = nn.Dense(self.output_dim)(out)
@@ -86,4 +90,6 @@ class PNAConv(nn.Module):
 
 @register_conv("PNA", is_edge_model=True)
 def make_pna(cfg, in_dim, out_dim, last_layer):
-    return PNAConv(output_dim=out_dim, deg_hist=cfg.pna_deg, edge_dim=cfg.edge_dim)
+    return PNAConv(output_dim=out_dim, deg_hist=cfg.pna_deg,
+                   edge_dim=cfg.edge_dim, sorted_agg=cfg.sorted_aggregation,
+                   max_in_degree=cfg.max_in_degree)

@@ -14,11 +14,15 @@ from .base import register_conv
 
 class SAGEConv(nn.Module):
     output_dim: int
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
         agg = segment_mean(
-            inv[batch.senders], batch.receivers, batch.num_nodes, batch.edge_mask
+            inv[batch.senders], batch.receivers, batch.num_nodes,
+            batch.edge_mask, sorted_ids=self.sorted_agg,
+            max_degree=self.max_in_degree,
         )
         h = nn.Dense(self.output_dim, use_bias=True)(agg) + nn.Dense(
             self.output_dim, use_bias=False
@@ -28,4 +32,5 @@ class SAGEConv(nn.Module):
 
 @register_conv("SAGE", is_edge_model=False)
 def make_sage(cfg, in_dim, out_dim, last_layer):
-    return SAGEConv(output_dim=out_dim)
+    return SAGEConv(output_dim=out_dim, sorted_agg=cfg.sorted_aggregation,
+                    max_in_degree=cfg.max_in_degree)

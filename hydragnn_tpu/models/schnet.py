@@ -27,6 +27,8 @@ class CFConv(nn.Module):
     radius: float
     edge_dim: int = 0
     equivariant: bool = False
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -48,7 +50,9 @@ class CFConv(nn.Module):
 
         h = nn.Dense(self.num_filters, use_bias=False)(inv)
         msg = h[batch.senders] * w
-        agg = segment_sum(msg, batch.receivers, batch.num_nodes, batch.edge_mask)
+        agg = segment_sum(msg, batch.receivers, batch.num_nodes,
+                          batch.edge_mask, sorted_ids=self.sorted_agg,
+                          max_degree=self.max_in_degree)
         out = nn.Dense(self.output_dim)(agg)
 
         if self.equivariant:
@@ -76,4 +80,6 @@ def make_schnet(cfg, in_dim, out_dim, last_layer):
         # last layer stays invariant so node outputs are E(3)-invariant
         # (reference: SCFStack equivariant=self.equivariance and not last_layer)
         equivariant=cfg.equivariance and not last_layer,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

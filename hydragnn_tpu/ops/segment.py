@@ -25,10 +25,34 @@ def _mask_messages(messages: jnp.ndarray, mask: Optional[jnp.ndarray], fill: flo
     return jnp.where(m, messages, fill)
 
 
-def segment_sum(messages, segment_ids, num_segments, mask=None):
-    return jax.ops.segment_sum(
-        _mask_messages(messages, mask), segment_ids, num_segments=num_segments
-    )
+def segment_sum(
+    messages,
+    segment_ids,
+    num_segments,
+    mask=None,
+    sorted_ids: bool = False,
+    max_degree: Optional[int] = None,
+):
+    """Scatter-add of edge messages.
+
+    With ``sorted_ids=True`` (receiver-sorted edge arrays, built by
+    ``GraphLoader(sort_edges=True)``) and a static in-degree bound
+    ``max_degree`` (config ``max_in_degree``, measured over the dataset),
+    the TPU backend routes through the Pallas MXU kernel
+    (ops/pallas_segment.py) instead of XLA's serialized scatter. Any other
+    backend, or 1-D messages, falls back to ``jax.ops.segment_sum``.
+    """
+    msg = _mask_messages(messages, mask)
+    if (
+        sorted_ids
+        and max_degree
+        and msg.ndim == 2
+        and jax.default_backend() == "tpu"
+    ):
+        from .pallas_segment import sorted_segment_sum
+
+        return sorted_segment_sum(msg, segment_ids, num_segments, max_degree)
+    return jax.ops.segment_sum(msg, segment_ids, num_segments=num_segments)
 
 
 def segment_count(segment_ids, num_segments, mask=None):
@@ -38,8 +62,19 @@ def segment_count(segment_ids, num_segments, mask=None):
     return jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments)
 
 
-def segment_mean(messages, segment_ids, num_segments, mask=None, eps: float = 0.0):
-    s = segment_sum(messages, segment_ids, num_segments, mask)
+def segment_mean(
+    messages,
+    segment_ids,
+    num_segments,
+    mask=None,
+    eps: float = 0.0,
+    sorted_ids: bool = False,
+    max_degree: Optional[int] = None,
+):
+    s = segment_sum(
+        messages, segment_ids, num_segments, mask,
+        sorted_ids=sorted_ids, max_degree=max_degree,
+    )
     n = segment_count(segment_ids, num_segments, mask)
     n = jnp.maximum(n, 1.0) if eps == 0.0 else n + eps
     return s / n.reshape(n.shape + (1,) * (s.ndim - 1))

@@ -45,17 +45,31 @@ def ensure_stacked(batch):
 
 
 def make_parallel_train_step(
-    model: HydraModel, tx, mesh: Mesh, compute_grad_energy: bool = False
+    model: HydraModel,
+    tx,
+    mesh: Mesh,
+    compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
 ):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh."""
     cfg = model.cfg
 
     def per_device_loss(params, batch_stats, batch, rng):
+        if mixed_precision:
+            from ..train.loop import cast_batch_bf16, cast_floats
+
+            params = cast_floats(params, jnp.bfloat16)
+            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
         tot, tasks, mutated, _ = compute_loss(
             model, variables, batch, cfg, True, rng, compute_grad_energy
         )
-        return tot, (tasks, mutated)
+        if mixed_precision and "batch_stats" in mutated:
+            mutated = dict(
+                mutated,
+                batch_stats=cast_floats(mutated["batch_stats"], jnp.float32),
+            )
+        return tot.astype(jnp.float32), (tasks, mutated)
 
     if cfg.conv_checkpointing:
         per_device_loss = jax.checkpoint(per_device_loss)
@@ -107,14 +121,30 @@ def make_parallel_train_step(
 
 
 def make_parallel_eval_step(
-    model: HydraModel, mesh: Mesh, compute_grad_energy: bool = False
+    model: HydraModel,
+    mesh: Mesh,
+    compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
 ):
     cfg = model.cfg
 
     def sharded_eval(state: TrainState, batch):
         batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        variables = state.variables()
+        if mixed_precision:
+            # keep eval numerics identical to the single-host eval step
+            # (train/loop.py make_eval_step): bf16 params/stats/inputs
+            from ..train.loop import cast_batch_bf16, cast_floats
+
+            variables = {
+                "params": cast_floats(variables["params"], jnp.bfloat16),
+                "batch_stats": cast_floats(
+                    variables.get("batch_stats", {}), jnp.bfloat16
+                ),
+            }
+            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
         tot, tasks, _, _ = compute_loss(
-            model, state.variables(), batch, cfg, False, None, compute_grad_energy
+            model, variables, batch, cfg, False, None, compute_grad_energy
         )
         # weight by real graphs so padded shards don't skew the mean
         n = jnp.sum(batch.graph_mask.astype(jnp.float32))

@@ -31,23 +31,68 @@ from .optimizer import ReduceLROnPlateau
 from .state import TrainState
 
 
+# float batch fields cast to bfloat16 under mixed precision (targets and
+# masks stay f32/bool so the loss accumulates in f32 via promotion)
+_MP_INPUT_FIELDS = ("x", "pos", "edge_attr", "edge_shifts", "pe", "rel_pe")
+
+
+def cast_batch_bf16(batch: GraphBatch, keep_pos: bool = False) -> GraphBatch:
+    """Cast the model-input channels of a batch to bfloat16. ``keep_pos``
+    preserves f32 positions for the autograd-force objective, where forces
+    come from d(energy)/d(pos) and bf16 positions would quantize them."""
+    upd = {}
+    for f in _MP_INPUT_FIELDS:
+        if keep_pos and f == "pos":
+            continue
+        v = getattr(batch, f)
+        if v is not None and jnp.issubdtype(v.dtype, jnp.floating):
+            upd[f] = v.astype(jnp.bfloat16)
+    return batch.replace(**upd)
+
+
+def cast_floats(tree, dtype):
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype)
+        if isinstance(p, jnp.ndarray) and jnp.issubdtype(p.dtype, jnp.floating)
+        else p,
+        tree,
+    )
+
+
 def make_train_step(
     model: HydraModel,
     tx: optax.GradientTransformation,
     compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
 ):
     """Build the jitted SGD step: (state, batch, rng) -> (state, loss, tasks).
 
     ``compute_grad_energy=True`` switches to the energy+force objective
-    (reference: train_validate_test.py:517-520 -> Base.energy_force_loss)."""
+    (reference: train_validate_test.py:517-520 -> Base.energy_force_loss).
+
+    ``mixed_precision=True`` runs the forward/backward in bfloat16 (MXU
+    native) against f32 master weights: params and input channels are cast
+    to bf16 inside the differentiated function, so gradients flow back
+    through the cast and land in f32 for the optimizer; running batch-norm
+    statistics are re-cast to f32 before being stored. Targets stay f32, so
+    residuals and the loss accumulate in f32 by dtype promotion."""
     cfg = model.cfg
 
     def loss_fn(params, batch_stats, batch, rng):
+        if mixed_precision:
+            params = cast_floats(params, jnp.bfloat16)
+            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
         tot, tasks, mutated, _ = compute_loss(
             model, variables, batch, cfg, True, rng, compute_grad_energy
         )
-        return tot, (tasks, mutated)
+        if mixed_precision and "batch_stats" in mutated:
+            mutated = dict(
+                mutated, batch_stats=cast_floats(
+                    mutated["batch_stats"], jnp.float32
+                )
+            )
+        return tot.astype(jnp.float32), (tasks, mutated)
 
     if cfg.conv_checkpointing:
         # rematerialize the forward during backward (reference: per-conv torch
@@ -72,13 +117,26 @@ def make_train_step(
     return train_step
 
 
-def make_eval_step(model: HydraModel, compute_grad_energy: bool = False):
+def make_eval_step(
+    model: HydraModel,
+    compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
+):
     cfg = model.cfg
 
     @jax.jit
     def eval_step(state: TrainState, batch: GraphBatch):
+        variables = state.variables()
+        if mixed_precision:
+            variables = {
+                "params": cast_floats(variables["params"], jnp.bfloat16),
+                "batch_stats": cast_floats(
+                    variables.get("batch_stats", {}), jnp.bfloat16
+                ),
+            }
+            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
         tot, tasks, _, outputs = compute_loss(
-            model, state.variables(), batch, cfg, False, None, compute_grad_energy
+            model, variables, batch, cfg, False, None, compute_grad_energy
         )
         return tot, tasks, outputs
 
@@ -197,10 +255,14 @@ def train_validate_test(
     do_valtest = os.getenv("HYDRAGNN_VALTEST", "1") != "0"
 
     compute_grad_energy = training.get("compute_grad_energy", False)
+    # bf16 compute against f32 master weights (MXU-native; make_train_step)
+    mixed_precision = training.get("mixed_precision", False)
     if step_fn is None:
-        step_fn = make_train_step(model, tx, compute_grad_energy)
+        step_fn = make_train_step(
+            model, tx, compute_grad_energy, mixed_precision
+        )
     if eval_fn is None:
-        eval_fn = make_eval_step(model, compute_grad_energy)
+        eval_fn = make_eval_step(model, compute_grad_energy, mixed_precision)
     scheduler = ReduceLROnPlateau()
     stopper = (
         EarlyStopping(patience=training.get("patience", 10))

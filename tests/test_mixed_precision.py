@@ -1,0 +1,139 @@
+"""Mixed-precision (bf16 compute, f32 master weights) training path.
+
+The TPU MXU is bfloat16-native; ``Training.mixed_precision`` casts params
+and input channels to bf16 inside the differentiated step while the
+optimizer state, gradients, and batch-norm running statistics stay f32
+(train/loop.py make_train_step). These tests pin the contract: training
+still converges, and every persistent array remains f32.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.train import TrainState, make_optimizer
+from hydragnn_tpu.train.loop import (
+    cast_batch_bf16,
+    cast_floats,
+    make_eval_step,
+    make_train_step,
+)
+
+
+def _setup(mpnn_type="PNA", hidden=16):
+    raw = deterministic_graph_dataset(64, seed=97)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["t"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.8, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": mpnn_type,
+                "hidden_dim": hidden,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": hidden,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [hidden, hidden],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["t"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": 16,
+                "num_epoch": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+            },
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]}, "graph_features": {"dim": [1]}},
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(tr, 16, seed=0, drop_last=True)
+    model = create_model(config)
+    batch = next(iter(loader))
+    variables = init_model(model, batch, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+    return model, tx, state, loader
+
+
+def pytest_mixed_precision_converges_and_keeps_f32_master():
+    model, tx, state, loader = _setup()
+    step = make_train_step(model, tx, mixed_precision=True)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for epoch in range(8):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            rng, sub = jax.random.split(rng)
+            state, tot, _ = step(state, batch, sub)
+        losses.append(float(tot))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses
+    # persistent state stays f32: master params, optimizer state, BN stats
+    for leaf in jax.tree_util.tree_leaves(state.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+    for leaf in jax.tree_util.tree_leaves(state.batch_stats):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32, leaf.dtype
+
+
+def pytest_mixed_precision_matches_f32_closely():
+    """One step of bf16-compute training tracks the f32 step: same sign and
+    magnitude of the loss, parameters within bf16 tolerance."""
+    model, tx, state, loader = _setup()
+    batch = next(iter(loader))
+    rng = jax.random.PRNGKey(1)
+    step32 = make_train_step(model, tx, mixed_precision=False)
+    step16 = make_train_step(model, tx, mixed_precision=True)
+    # donated buffers: run each step from a fresh copy of the state
+    s32 = jax.tree_util.tree_map(jnp.copy, state)
+    s16 = jax.tree_util.tree_map(jnp.copy, state)
+    s32, tot32, _ = step32(s32, batch, rng)
+    s16, tot16, _ = step16(s16, batch, rng)
+    assert abs(float(tot32) - float(tot16)) < 0.05 * max(abs(float(tot32)), 1e-3)
+    p32 = jax.tree_util.tree_leaves(s32.params)
+    p16 = jax.tree_util.tree_leaves(s16.params)
+    for a, b in zip(p32, p16):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.05, rtol=0.1
+        )
+
+
+def pytest_mixed_precision_eval_step():
+    model, tx, state, loader = _setup()
+    evalf = make_eval_step(model, mixed_precision=True)
+    tot, tasks, outputs = evalf(state, next(iter(loader)))
+    assert np.isfinite(float(tot))
+
+
+def pytest_cast_helpers():
+    batch = None
+    tree = {"a": jnp.ones((2, 2), jnp.float32), "b": jnp.ones((2,), jnp.int32)}
+    lo = cast_floats(tree, jnp.bfloat16)
+    assert lo["a"].dtype == jnp.bfloat16 and lo["b"].dtype == jnp.int32
+    hi = cast_floats(lo, jnp.float32)
+    assert hi["a"].dtype == jnp.float32
