@@ -227,6 +227,9 @@ def prepare_data(
         oversampling=bool(training.get("oversampling", False)) or balance,
         num_samples=training.get("num_samples"),
         sample_weights=sample_weights,
+        # background batch building (HYDRAGNN_NUM_WORKERS=0 disables; the
+        # reference's env of the same name sizes its thread-pool loader)
+        prefetch=max(int(os.getenv("HYDRAGNN_NUM_WORKERS", "2")), 0),
         # multi-host batches must stay full so every process steps in
         # lockstep with identical shard shapes
         drop_last=jax.process_count() > 1,
@@ -389,6 +392,9 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             compute_grad_energy=config["NeuralNetwork"]["Training"].get(
                 "compute_grad_energy", False
             ),
+            mixed_precision=config["NeuralNetwork"]["Training"].get(
+                "mixed_precision", False
+            ),
         )
         viz = Visualizer(log_name)
         viz.create_scatter_plots(trues, preds)
@@ -438,6 +444,9 @@ def _(config: dict, model_state=None, datasets=None):
         test_loader,
         compute_grad_energy=config["NeuralNetwork"]["Training"].get(
             "compute_grad_energy", False
+        ),
+        mixed_precision=config["NeuralNetwork"]["Training"].get(
+            "mixed_precision", False
         ),
     )
     var = config["NeuralNetwork"]["Variables_of_interest"]

@@ -231,6 +231,7 @@ class GraphLoader:
         num_samples: Optional[int] = None,
         sample_weights: Optional[np.ndarray] = None,
         sort_edges: bool = False,
+        prefetch: int = 0,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -285,6 +286,11 @@ class GraphLoader:
         # receiver-sorted edges (the Pallas sorted-segment-sum precondition,
         # ops/pallas_segment.py; also scatter-friendlier for XLA)
         self.sort_edges = sort_edges
+        # background-thread batch building: host batching overlaps device
+        # compute (the reference's HydraDataLoader thread-pool loader,
+        # hydragnn/preprocess/load_data.py:93-203; its core-affinity pinning
+        # has no analog here — XLA owns the host threads)
+        self.prefetch = int(prefetch)
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -317,7 +323,7 @@ class GraphLoader:
             idx = idx[: len(idx) // self.host_count * self.host_count]
         return idx[self.host_index :: self.host_count]
 
-    def __iter__(self) -> Iterator[GraphBatch]:
+    def _batches(self) -> Iterator[GraphBatch]:
         idx = self._local_indices()
         bs = self.batch_size
         n_full = len(idx) // bs
@@ -326,6 +332,50 @@ class GraphLoader:
         rem = len(idx) - n_full * bs
         if rem and not self.drop_last:
             yield self._make([self.graphs[i] for i in idx[n_full * bs :]])
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        if self.prefetch <= 0:
+            yield from self._batches()
+            return
+        # bounded producer thread: up to ``prefetch`` batches built ahead
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        _END, _ERR = object(), object()
+
+        def put_or_stop(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for batch in self._batches():
+                    if not put_or_stop(batch):
+                        return
+                put_or_stop(_END)
+            except BaseException as e:  # surfaced in the consumer
+                put_or_stop((_ERR, e))
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                    raise item[1]
+                yield item
+        finally:
+            # abandoned mid-epoch (break / exception): release the producer
+            stop.set()
 
     def _make(self, graphs: List[Graph]) -> GraphBatch:
         if self.num_shards == 1:

@@ -27,6 +27,8 @@ class PNAEqConv(nn.Module):
     radius: float
     edge_dim: int = 0
     last_layer: bool = False
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -59,7 +61,8 @@ class PNAEqConv(nn.Module):
         v = v + segment_sum(msg_v, batch.receivers, n, batch.edge_mask)
 
         # PNA aggregation of scalar messages (aggregators x scalers)
-        scaled = pna_aggregate(msg_s, batch, self.deg_hist)
+        scaled = pna_aggregate(msg_s, batch, self.deg_hist,
+                               self.sorted_agg, self.max_in_degree)
         delta = nn.Dense(self.node_size)(jnp.concatenate([x, scaled], axis=-1))
         x = x + delta
 
@@ -77,4 +80,6 @@ def make_pna_eq(cfg, in_dim, out_dim, last_layer):
         radius=cfg.radius or 5.0,
         edge_dim=cfg.edge_dim,
         last_layer=last_layer,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

@@ -24,6 +24,8 @@ class PNAPlusConv(nn.Module):
     num_radial: int = 5
     envelope_exponent: int = 5
     edge_dim: int = 0
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -43,7 +45,8 @@ class PNAPlusConv(nn.Module):
         # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276)
         msg = msg * nn.Dense(f_in, use_bias=False)(rbf)
 
-        scaled = pna_aggregate(msg, batch, self.deg_hist)
+        scaled = pna_aggregate(msg, batch, self.deg_hist,
+                               self.sorted_agg, self.max_in_degree)
         out = nn.Dense(self.output_dim)(jnp.concatenate([inv, scaled], axis=-1))
         out = nn.Dense(self.output_dim)(out)
         return out, equiv
@@ -58,4 +61,6 @@ def make_pna_plus(cfg, in_dim, out_dim, last_layer):
         num_radial=cfg.num_radial or 5,
         envelope_exponent=cfg.envelope_exponent or 5,
         edge_dim=cfg.edge_dim,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

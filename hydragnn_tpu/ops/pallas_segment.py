@@ -100,7 +100,12 @@ def _forward(
     dtype = messages.dtype
 
     ids = segment_ids.astype(jnp.int32)
-    msg = _pad_to(messages.astype(jnp.float32), eb, 0)
+    # messages stream in their own dtype (bf16 stays bf16 — half the HBM
+    # traffic under mixed precision); the kernel's dot_general accumulates
+    # in f32 via preferred_element_type either way. The one-hot operand must
+    # stay f32: owner encodings are exact-compared and bf16's 8 mantissa
+    # bits would corrupt owners > 256.
+    msg = _pad_to(messages, eb, 0)
     msg = _pad_to(msg, cb, 1)
     n_pad = num_segments + (-num_segments) % nb
 

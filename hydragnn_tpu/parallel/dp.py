@@ -56,19 +56,17 @@ def make_parallel_train_step(
 
     def per_device_loss(params, batch_stats, batch, rng):
         if mixed_precision:
-            from ..train.loop import cast_batch_bf16, cast_floats
+            from ..train.loop import mp_cast
 
-            params = cast_floats(params, jnp.bfloat16)
-            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
+            params, batch = mp_cast(params, batch, compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
         tot, tasks, mutated, _ = compute_loss(
             model, variables, batch, cfg, True, rng, compute_grad_energy
         )
-        if mixed_precision and "batch_stats" in mutated:
-            mutated = dict(
-                mutated,
-                batch_stats=cast_floats(mutated["batch_stats"], jnp.float32),
-            )
+        if mixed_precision:
+            from ..train.loop import mp_restore_stats
+
+            mutated = mp_restore_stats(mutated)
         return tot.astype(jnp.float32), (tasks, mutated)
 
     if cfg.conv_checkpointing:
@@ -133,16 +131,11 @@ def make_parallel_eval_step(
         variables = state.variables()
         if mixed_precision:
             # keep eval numerics identical to the single-host eval step
-            # (train/loop.py make_eval_step): bf16 params/stats/inputs
-            from ..train.loop import cast_batch_bf16, cast_floats
+            from ..train.loop import mp_cast_eval
 
-            variables = {
-                "params": cast_floats(variables["params"], jnp.bfloat16),
-                "batch_stats": cast_floats(
-                    variables.get("batch_stats", {}), jnp.bfloat16
-                ),
-            }
-            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
+            variables, batch = mp_cast_eval(
+                variables, batch, compute_grad_energy
+            )
         tot, tasks, _, _ = compute_loss(
             model, variables, batch, cfg, False, None, compute_grad_energy
         )

@@ -59,6 +59,37 @@ def cast_floats(tree, dtype):
     )
 
 
+def mp_cast(params, batch, compute_grad_energy: bool):
+    """The mixed-precision input cast, shared by the single-device and mesh
+    step builders so their numerics stay byte-identical: bf16 params + bf16
+    input channels (f32 positions under the autograd-force objective)."""
+    return (
+        cast_floats(params, jnp.bfloat16),
+        cast_batch_bf16(batch, keep_pos=compute_grad_energy),
+    )
+
+
+def mp_restore_stats(mutated: dict) -> dict:
+    """Persist batch-norm running statistics in f32 after a bf16 forward."""
+    if "batch_stats" in mutated:
+        mutated = dict(
+            mutated, batch_stats=cast_floats(mutated["batch_stats"], jnp.float32)
+        )
+    return mutated
+
+
+def mp_cast_eval(variables, batch, compute_grad_energy: bool):
+    """Eval-side cast: bf16 params AND running stats (eval normalizes with
+    the running statistics, unlike training)."""
+    variables = {
+        "params": cast_floats(variables["params"], jnp.bfloat16),
+        "batch_stats": cast_floats(
+            variables.get("batch_stats", {}), jnp.bfloat16
+        ),
+    }
+    return variables, cast_batch_bf16(batch, keep_pos=compute_grad_energy)
+
+
 def make_train_step(
     model: HydraModel,
     tx: optax.GradientTransformation,
@@ -80,18 +111,13 @@ def make_train_step(
 
     def loss_fn(params, batch_stats, batch, rng):
         if mixed_precision:
-            params = cast_floats(params, jnp.bfloat16)
-            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
+            params, batch = mp_cast(params, batch, compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
         tot, tasks, mutated, _ = compute_loss(
             model, variables, batch, cfg, True, rng, compute_grad_energy
         )
-        if mixed_precision and "batch_stats" in mutated:
-            mutated = dict(
-                mutated, batch_stats=cast_floats(
-                    mutated["batch_stats"], jnp.float32
-                )
-            )
+        if mixed_precision:
+            mutated = mp_restore_stats(mutated)
         return tot.astype(jnp.float32), (tasks, mutated)
 
     if cfg.conv_checkpointing:
@@ -128,13 +154,9 @@ def make_eval_step(
     def eval_step(state: TrainState, batch: GraphBatch):
         variables = state.variables()
         if mixed_precision:
-            variables = {
-                "params": cast_floats(variables["params"], jnp.bfloat16),
-                "batch_stats": cast_floats(
-                    variables.get("batch_stats", {}), jnp.bfloat16
-                ),
-            }
-            batch = cast_batch_bf16(batch, keep_pos=compute_grad_energy)
+            variables, batch = mp_cast_eval(
+                variables, batch, compute_grad_energy
+            )
         tot, tasks, _, outputs = compute_loss(
             model, variables, batch, cfg, False, None, compute_grad_energy
         )
@@ -336,11 +358,17 @@ def train_validate_test(
 
 
 def test_model(
-    model: HydraModel, state: TrainState, loader, compute_grad_energy: bool = False
+    model: HydraModel,
+    state: TrainState,
+    loader,
+    compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
 ) -> Tuple[float, Dict[str, float], Dict[str, np.ndarray], Dict[str, np.ndarray]]:
     """Full-dataset evaluation returning flattened real predictions/targets
-    per head (reference: test(), train_validate_test.py:620-748)."""
-    eval_fn = make_eval_step(model, compute_grad_energy)
+    per head (reference: test(), train_validate_test.py:620-748).
+    ``mixed_precision`` must match training so the reported test loss uses
+    the same numerics that drove checkpoint selection."""
+    eval_fn = make_eval_step(model, compute_grad_energy, mixed_precision)
     cfg = model.cfg
     if compute_grad_energy:
         # energy is reported graph-level, forces node-level, regardless of the

@@ -112,3 +112,22 @@ def pytest_graphloader_sort_edges_plumbed():
             else:
                 for shard in recv:
                     assert np.all(np.diff(shard) >= 0)
+
+
+def pytest_bf16_messages_stream_without_upcast():
+    """bf16 messages keep their dtype through the kernel (mixed-precision
+    path); accumulation is still f32 so results match the f32 reference to
+    bf16 quantization tolerance."""
+    rng = np.random.default_rng(11)
+    recv = _sorted_capped_receivers(rng, 400, 64, 16)
+    msg32 = rng.normal(size=(400, 32)).astype(np.float32)
+    msg16 = jnp.asarray(msg32).astype(jnp.bfloat16)
+    out = sorted_segment_sum(msg16, jnp.asarray(recv), 64, 16, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    ref = jax.ops.segment_sum(
+        jnp.asarray(msg16).astype(jnp.float32), jnp.asarray(recv),
+        num_segments=64,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=2e-2, atol=2e-2
+    )
