@@ -38,6 +38,8 @@ class DimeNetConv(nn.Module):
     envelope_exponent: int = 5
     radius: float = 5.0
     edge_dim: int = 0
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -100,7 +102,9 @@ class DimeNetConv(nn.Module):
 
         # ---- output block (PyG OutputPPBlock): edges -> nodes
         g = nn.Dense(hidden, use_bias=False)(rbf) * h
-        node = segment_sum(g, batch.receivers, batch.num_nodes, batch.edge_mask)
+        node = segment_sum(g, batch.receivers, batch.num_nodes,
+                           batch.edge_mask, sorted_ids=self.sorted_agg,
+                           max_degree=self.max_in_degree)
         node = nn.Dense(self.out_emb_size, use_bias=False)(node)
         node = act(nn.Dense(self.out_emb_size)(node))
         out = nn.Dense(self.output_dim, use_bias=False)(node)
@@ -128,4 +132,6 @@ def make_dimenet(cfg, in_dim, out_dim, last_layer):
         envelope_exponent=cfg.envelope_exponent or 5,
         radius=cfg.radius or 5.0,
         edge_dim=cfg.edge_dim,
+        sorted_agg=cfg.sorted_aggregation,
+        max_in_degree=cfg.max_in_degree,
     )

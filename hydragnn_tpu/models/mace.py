@@ -39,6 +39,7 @@ import numpy as np
 from ..data.graph import GraphBatch
 from ..ops.o3 import couple, irrep_slice, real_sph_harm, sh_dim, tp_paths
 from ..ops.radial import RadialEmbedding, edge_vectors
+from ..ops.segment import segment_sum
 from ..ops.segment import masked_global_mean_pool
 from .base import ModelConfig, NodeHeadConfig
 from .layers import MLP, get_activation
@@ -90,6 +91,8 @@ class MACEInteraction(nn.Module):
     max_ell: int  # lmax of edge spherical harmonics and messages
     node_max_ell: int  # lmax of node features / skip connection
     avg_num_neighbors: float
+    sorted_agg: bool = False
+    max_in_degree: int = 0
     last_layer: bool = False
 
     @nn.compact
@@ -127,8 +130,12 @@ class MACEInteraction(nn.Module):
             msg = msg.at[:, :, irrep_slice(l3)].add(contrib)
 
         msg = msg * batch.edge_mask.astype(h.dtype)[:, None, None]
-        agg = jnp.zeros((h.shape[0], c, sh_dim(self.max_ell)), h.dtype)
-        agg = agg.at[batch.receivers].add(msg) / self.avg_num_neighbors
+        # channel x irrep axes flattened so the 2-D sorted-segment kernel
+        # can take the receiver sum on TPU (ops/segment.py)
+        agg = segment_sum(
+            msg.reshape(msg.shape[0], -1), batch.receivers, h.shape[0],
+            sorted_ids=self.sorted_agg, max_degree=self.max_in_degree,
+        ).reshape(h.shape[0], c, sh_dim(self.max_ell)) / self.avg_num_neighbors
         agg = EquivariantLinear(c, self.max_ell, name="linear")(agg)
         return agg, sc
 
@@ -194,6 +201,8 @@ class MACEConv(nn.Module):
     avg_num_neighbors: float
     correlation: int
     last_layer: bool = False
+    sorted_agg: bool = False
+    max_in_degree: int = 0
 
     @nn.compact
     def __call__(self, h, sh, radial, node_attrs, batch):
@@ -204,6 +213,8 @@ class MACEConv(nn.Module):
             self.node_max_ell,
             self.avg_num_neighbors,
             last_layer=self.last_layer,
+            sorted_agg=self.sorted_agg,
+            max_in_degree=self.max_in_degree,
             name="interaction",
         )(h, sh, radial, batch)
         prod = SymmetricProduct(
@@ -269,6 +280,8 @@ class MACEModel(nn.Module):
                 avg_num_neighbors,
                 correlation,
                 last_layer=last,
+                sorted_agg=cfg.sorted_aggregation,
+                max_in_degree=cfg.max_in_degree,
                 name=f"conv{i}",
             )(h, sh, radial, node_attrs, batch)
             layer_out = self._readout(

@@ -126,7 +126,8 @@ def pytest_sorted_training_converges_like_unsorted():
 
 
 @pytest.mark.parametrize("mpnn_type", ["GIN", "SAGE", "SchNet", "PNA", "GAT",
-                                        "CGCNN", "MFC", "PAINN"])
+                                        "CGCNN", "MFC", "PAINN", "PNAPlus",
+                                        "PNAEq", "MACE"])
 def pytest_sorted_agg_wired_across_models(mpnn_type):
     """Every wired conv type runs a training step with the flag on (the CPU
     backend falls back to XLA, so this pins the wiring, not the kernel)."""
@@ -137,6 +138,11 @@ def pytest_sorted_agg_wired_across_models(mpnn_type):
     if mpnn_type == "SchNet":
         cfg["NeuralNetwork"]["Architecture"]["num_gaussians"] = 8
         cfg["NeuralNetwork"]["Architecture"]["num_filters"] = 8
+    if mpnn_type == "MACE":
+        cfg["NeuralNetwork"]["Architecture"].update(
+            num_radial=6, max_ell=2, node_max_ell=1, correlation=2,
+            hidden_dim=8,
+        )
     config = update_config(cfg, tr, va, te)
     assert config["NeuralNetwork"]["Architecture"]["max_in_degree"] > 0
     loader = GraphLoader(tr, 8, seed=0, drop_last=True, sort_edges=True)
