@@ -449,6 +449,28 @@ def _(config: dict, model_state=None, datasets=None):
             "mixed_precision", False
         ),
     )
+    # multi-host: every process returns the FULL prediction set and a
+    # globally reduced loss (reference: padded all-gather of test samples
+    # train_validate_test.py:410-448 + reduce_values_ranks :382-407)
+    import jax as _jax
+
+    from .parallel import gather_across_hosts
+
+    if _jax.process_count() > 1:
+        import numpy as _np
+
+        w = float(len(preds[next(iter(preds))]))
+        packed = {
+            "w": _np.asarray([w]),
+            "tot": _np.asarray([tot * w]),
+            **{f"task_{k}": _np.asarray([v * w]) for k, v in tasks.items()},
+        }
+        g = gather_across_hosts(packed)
+        W = float(g["w"].sum()) or 1.0
+        tot = float(g["tot"].sum() / W)
+        tasks = {k: float(g[f"task_{k}"].sum() / W) for k in tasks}
+    preds = gather_across_hosts(preds)
+    trues = gather_across_hosts(trues)
     var = config["NeuralNetwork"]["Variables_of_interest"]
     if var.get("denormalize_output") and mm is not None:
         voi = voi_from_config(config)

@@ -2,6 +2,7 @@ from .mesh import (
     BRANCH_AXIS,
     DATA_AXIS,
     batch_sharding,
+    gather_across_hosts,
     local_host_info,
     make_mesh,
     promote_batch,
@@ -16,6 +17,7 @@ __all__ = [
     "BRANCH_AXIS",
     "DATA_AXIS",
     "batch_sharding",
+    "gather_across_hosts",
     "local_host_info",
     "make_mesh",
     "promote_batch",

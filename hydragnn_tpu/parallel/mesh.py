@@ -201,3 +201,35 @@ def setup_distributed() -> None:
         global _rendezvous_skipped
         _rendezvous_skipped = True
         warnings.warn(f"multi-host rendezvous skipped: {e}")
+
+
+def gather_across_hosts(values):
+    """Concatenate per-host arrays across every process: dict of
+    [n_local, ...] -> dict of [n_global, ...], ragged-safe (each host may
+    hold a different sample count — pad to the max, then slice per the
+    gathered counts). The analog of the reference's padded all-gather of
+    test predictions (gather_tensor_ranks,
+    hydragnn/train/train_validate_test.py:410-448). Identity on one host.
+    """
+
+    if jax.process_count() == 1:
+        return values
+    from jax.experimental import multihost_utils
+
+    out = {}
+    for k, v in values.items():
+        v = np.asarray(v)
+        counts = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([v.shape[0]], np.int64)
+            )
+        ).reshape(-1)
+        max_n = int(counts.max())
+        pad = np.zeros((max_n - v.shape[0],) + v.shape[1:], v.dtype)
+        stacked = np.asarray(
+            multihost_utils.process_allgather(np.concatenate([v, pad]))
+        )
+        out[k] = np.concatenate(
+            [stacked[p, : int(counts[p])] for p in range(stacked.shape[0])]
+        )
+    return out

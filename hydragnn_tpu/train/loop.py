@@ -398,9 +398,23 @@ def test_model(
             preds[name].append(np.asarray(outputs[name]).reshape(target.shape)[mask])
             trues[name].append(target[mask])
     tot, tasks = _weighted_avg(entries)
-    return (
-        tot,
-        tasks,
-        {k: np.concatenate(v) for k, v in preds.items()},
-        {k: np.concatenate(v) for k, v in trues.items()},
-    )
+    preds_flat = {k: np.concatenate(v) for k, v in preds.items()}
+    trues_flat = {k: np.concatenate(v) for k, v in trues.items()}
+    # per-rank pickle dump of the collected test samples (reference:
+    # HYDRAGNN_DUMP_TESTDATA, train_validate_test.py:642-652). "0"/"false"
+    # disable (matching HYDRAGNN_VALTEST semantics); "1"/"true" use the
+    # default directory; anything else is the output directory.
+    dump = os.getenv("HYDRAGNN_DUMP_TESTDATA", "")
+    if dump and dump.lower() not in ("0", "false"):
+        import pickle
+
+        path = (
+            dump
+            if dump.lower() not in ("1", "true")
+            else os.path.join("logs", "testdata")
+        )
+        os.makedirs(path, exist_ok=True)
+        fname = os.path.join(path, f"testdata_rank{jax.process_index()}.pkl")
+        with open(fname, "wb") as f:
+            pickle.dump({"preds": preds_flat, "trues": trues_flat}, f)
+    return (tot, tasks, preds_flat, trues_flat)

@@ -136,6 +136,28 @@ _CHILD = textwrap.dedent(
     tot, tasks, preds, trues = run_prediction(cfg_out, model_state=state)
     assert np.isfinite(tot), tot
     assert preds["sum_x_x2_x3"].shape == trues["sum_x_x2_x3"].shape
+    # the prediction gather hands every host the FULL test set (reference:
+    # gather_tensor_ranks all-gather of test samples). 60 configs split
+    # 42/9/9; the 9-sample test split trims to 8 for two equal host shards
+    # of 4 — so the gathered set must be 8, not the local 4.
+    sizes = multihost_utils.process_allgather(
+        np.asarray([preds["sum_x_x2_x3"].shape[0]])
+    )
+    sizes = np.asarray(sizes).ravel()
+    assert int(sizes[0]) == int(sizes[1]) == 8, sizes
+    # and the globally reduced loss agrees across hosts
+    tots = np.asarray(
+        multihost_utils.process_allgather(np.asarray([tot]))
+    ).ravel()
+    np.testing.assert_allclose(tots[0], tots[1], rtol=1e-6)
+
+    # ragged-count gather correctness
+    from hydragnn_tpu.parallel import gather_across_hosts
+
+    ragged = {"v": np.full((3 + host_index, 2), host_index, np.float32)}
+    g = gather_across_hosts(ragged)
+    assert g["v"].shape == (7, 2), g["v"].shape
+    assert (g["v"][:3] == 0).all() and (g["v"][3:] == 1).all()
 
     print("MULTIHOST_OK", host_index)
     """

@@ -113,3 +113,52 @@ def pytest_setup_log_writes_file(tmp_path):
     logger.info("hello-world")
     text = open(tmp_path / "logrun" / "run.log").read()
     assert "hello-world" in text
+
+
+def pytest_dump_testdata_env(tmp_path, monkeypatch):
+    """HYDRAGNN_DUMP_TESTDATA pickles collected test predictions per rank
+    (reference: train_validate_test.py:642-652)."""
+    import pickle
+
+    import numpy as np
+
+    import hydragnn_tpu
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_DUMP_TESTDATA", "1")
+    cfg = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "dump_ci",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 40},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1]},
+            "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "radius": 2.0, "max_neighbours": 100,
+                "hidden_dim": 8, "num_conv_layers": 2, "task_weights": [1.0],
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                            "dim_sharedlayers": 8,
+                                            "num_headlayers": 2,
+                                            "dim_headlayers": [8, 8]}},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"], "output_index": [0],
+                "type": ["graph"], "denormalize_output": False,
+            },
+            "Training": {"num_epoch": 1, "batch_size": 8,
+                          "Optimizer": {"type": "AdamW",
+                                         "learning_rate": 0.01}},
+        },
+    }
+    model, state, *_ = hydragnn_tpu.run_training(cfg)
+    hydragnn_tpu.run_prediction(cfg, model_state=state)
+    path = tmp_path / "logs" / "testdata" / "testdata_rank0.pkl"
+    assert path.is_file()
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+    assert "sum_x_x2_x3" in blob["preds"]
+    assert blob["preds"]["sum_x_x2_x3"].shape == blob["trues"]["sum_x_x2_x3"].shape
