@@ -137,3 +137,52 @@ def pytest_cast_helpers():
     assert lo["a"].dtype == jnp.bfloat16 and lo["b"].dtype == jnp.int32
     hi = cast_floats(lo, jnp.float32)
     assert hi["a"].dtype == jnp.float32
+
+
+def pytest_mixed_precision_checkpoint_resume(tmp_path, monkeypatch):
+    """bf16-trained state checkpoints and resumes (Training.continue) with
+    f32 master weights intact."""
+    import os
+
+    import hydragnn_tpu
+
+    monkeypatch.chdir(tmp_path)
+    cfg = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "mp_resume",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 40},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1]},
+            "graph_features": {"name": ["sum_x_x2_x3"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "radius": 2.0, "max_neighbours": 100,
+                "hidden_dim": 8, "num_conv_layers": 2, "task_weights": [1.0],
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                            "dim_sharedlayers": 8,
+                                            "num_headlayers": 2,
+                                            "dim_headlayers": [8, 8]}},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"], "output_index": [0],
+                "type": ["graph"], "denormalize_output": False,
+            },
+            "Training": {"num_epoch": 2, "batch_size": 8,
+                          "mixed_precision": True,
+                          "Optimizer": {"type": "AdamW",
+                                         "learning_rate": 0.01}},
+        },
+    }
+    model, state, hist, cfg_out, *_ = hydragnn_tpu.run_training(cfg)
+    assert os.path.isdir("logs")
+    # resume: same config + continue -> restores and keeps training
+    cfg2 = {**cfg}
+    cfg2["NeuralNetwork"]["Training"]["continue"] = 1
+    model2, state2, hist2, *_ = hydragnn_tpu.run_training(cfg2)
+    assert len(hist2["train"]) == 2
+    for leaf in jax.tree_util.tree_leaves(state2.params):
+        if jnp.issubdtype(leaf.dtype, jnp.floating):
+            assert leaf.dtype == jnp.float32
