@@ -1,3 +1,4 @@
+from .ring_attention import ring_self_attention, sharded_global_attention
 from .mesh import (
     BRANCH_AXIS,
     DATA_AXIS,
@@ -18,6 +19,8 @@ __all__ = [
     "DATA_AXIS",
     "batch_sharding",
     "gather_across_hosts",
+    "ring_self_attention",
+    "sharded_global_attention",
     "local_host_info",
     "make_mesh",
     "promote_batch",

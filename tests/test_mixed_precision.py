@@ -179,7 +179,9 @@ def pytest_mixed_precision_checkpoint_resume(tmp_path, monkeypatch):
     model, state, hist, cfg_out, *_ = hydragnn_tpu.run_training(cfg)
     assert os.path.isdir("logs")
     # resume: same config + continue -> restores and keeps training
-    cfg2 = {**cfg}
+    import copy
+
+    cfg2 = copy.deepcopy(cfg)
     cfg2["NeuralNetwork"]["Training"]["continue"] = 1
     model2, state2, hist2, *_ = hydragnn_tpu.run_training(cfg2)
     assert len(hist2["train"]) == 2
