@@ -400,12 +400,19 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         viz.create_scatter_plots(trues, preds)
         viz.create_error_histograms(trues, preds)
         viz.plot_history(hist)
+        viz.create_plot_global(trues, preds)
+        viz.num_nodes_plot(
+            [g.num_nodes for g in test_loader.graphs]
+        )
         for name in trues:
             arr = np.asarray(trues[name])
             if name == "forces" or (arr.ndim == 2 and arr.shape[-1] == 3):
                 viz.create_parity_plot_per_node_vector(name, trues[name], preds[name])
             else:
                 viz.create_plot_global_analysis(name, trues[name], preds[name])
+                viz.create_parity_plot_and_error_histogram_scalar(
+                    name, trues[name], preds[name]
+                )
     print_timers(verbosity)
     return model, state, hist, config, loaders, mm
 
