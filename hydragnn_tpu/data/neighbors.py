@@ -15,10 +15,73 @@ with default symmetric output.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Tuple
 
 import numpy as np
 from scipy.spatial import cKDTree
+
+# node count above which the C++ cell-list builder takes over from scipy.
+# Measured on this image: the KD-tree (itself C) matches the cell list up
+# to a few thousand atoms; at 100k atoms the cell list is ~1.4x faster and
+# scales linearly in N while staying allocation-lean. Typical molecular /
+# slab samples therefore stay on scipy; mesoscale systems switch over.
+# HYDRAGNN_NATIVE_NEIGHBORS forces it on (=1) or off (=0).
+_NATIVE_MIN_N = 4096
+_native = None
+
+
+def _native_lib():
+    """Lazy-built cell-list library (native/neighbors.cpp); None when the
+    toolchain is unavailable — callers fall back to scipy."""
+    global _native
+    if _native is not None:
+        return _native or None
+    try:
+        import ctypes
+
+        from ..native.build import build_library
+
+        lib = ctypes.CDLL(build_library("neighbors"))
+        lib.rg_open.restype = ctypes.c_long
+        lib.rg_open.argtypes = [
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long,
+            ctypes.c_double,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_long,
+        ]
+        _native = lib
+    except Exception:
+        _native = False
+    return _native or None
+
+
+def _radius_graph_native(pos: np.ndarray, radius: float):
+    import ctypes
+
+    lib = _native_lib()
+    if lib is None:
+        return None
+    pos = np.ascontiguousarray(pos, np.float64)
+    n = pos.shape[0]
+    cap = max(64 * n, 1024)
+    for _ in range(2):
+        senders = np.empty(cap, np.int32)
+        receivers = np.empty(cap, np.int32)
+        m = lib.rg_open(
+            pos.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            n,
+            float(radius),
+            senders.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            receivers.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cap,
+        )
+        if m >= 0:
+            return senders[:m].copy(), receivers[:m].copy()
+        cap = -m  # exact size needed
+    return None
 
 
 def radius_graph(
@@ -33,16 +96,31 @@ def radius_graph(
     (reference: RadiusGraph(loop=False, max_num_neighbors=...) in
     hydragnn/preprocess/serialized_dataset_loader.py:134-141).
     Returns (senders, receivers) int32 arrays.
+
+    Large systems route through the C++ cell-list builder
+    (native/neighbors.cpp, the ASE-neighborlist analog); small ones stay on
+    scipy's KD-tree. Both produce the same edge SET; ordering differs.
     """
     pos = np.asarray(pos, np.float64)
-    tree = cKDTree(pos)
-    pairs = tree.query_pairs(r=radius, output_type="ndarray")  # unique i<j pairs
-    if pairs.size == 0:
-        senders = np.zeros((0,), np.int32)
-        receivers = np.zeros((0,), np.int32)
-    else:
-        senders = np.concatenate([pairs[:, 0], pairs[:, 1]]).astype(np.int32)
-        receivers = np.concatenate([pairs[:, 1], pairs[:, 0]]).astype(np.int32)
+    native_pref = os.getenv("HYDRAGNN_NATIVE_NEIGHBORS")
+    use_native = (
+        native_pref == "1"
+        or (native_pref != "0" and pos.shape[0] >= _NATIVE_MIN_N)
+    )
+    senders = receivers = None
+    if use_native:
+        built = _radius_graph_native(pos, radius)
+        if built is not None:
+            senders, receivers = built
+    if senders is None:
+        tree = cKDTree(pos)
+        pairs = tree.query_pairs(r=radius, output_type="ndarray")  # unique i<j
+        if pairs.size == 0:
+            senders = np.zeros((0,), np.int32)
+            receivers = np.zeros((0,), np.int32)
+        else:
+            senders = np.concatenate([pairs[:, 0], pairs[:, 1]]).astype(np.int32)
+            receivers = np.concatenate([pairs[:, 1], pairs[:, 0]]).astype(np.int32)
     if loop:
         idx = np.arange(pos.shape[0], dtype=np.int32)
         senders = np.concatenate([senders, idx])
