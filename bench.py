@@ -291,6 +291,10 @@ def main():
                 "peak_flops_assumed": prod["peak_flops_assumed"],
                 "synthetic_pna_graphs_per_sec": round(syn, 2),
                 "synthetic_pna_round1": RECORDED_BASELINE,
+                # finite loss = the bf16 step is numerically sane on-chip
+                "train_loss": round(prod["loss"], 5),
+                "mixed_precision": os.getenv("BENCH_MP", "1") == "1",
+                "sorted_aggregation": os.getenv("BENCH_SORTED", "0") == "1",
             }
         )
     )
