@@ -297,12 +297,14 @@ def train_validate_test(
         else None
     )
 
+    from ..utils import preemption
     from ..utils import tracer as tr
     from ..utils.profile import Profiler
     from ..utils.walltime import should_stop
 
     profiler = Profiler(config.get("Profile"), log_dir=f"./logs/{log_name}/profile")
     check_remaining = training.get("CheckRemainingTime", False)
+    preemption.install()
     tr.enable()
 
     rng = jax.random.PRNGKey(seed)
@@ -352,8 +354,18 @@ def train_validate_test(
             # SLURM walltime-aware stop (reference: train_validate_test.py:257-264)
             if check_remaining and should_stop(time.time() - t0):
                 break
+            # TPU-pod preemption (SIGTERM): checkpoint and stop cleanly so
+            # Training.continue resumes with <= 1 epoch lost; the decision
+            # is agreed across hosts so nobody blocks in a collective
+            if preemption.preempted_global():
+                if save_fn is not None:
+                    save_fn(state)
+                if verbosity > 0:
+                    print(f"[{log_name}] SIGTERM: checkpointed at epoch {epoch}, stopping")
+                break
     finally:
         profiler.close()
+        preemption.uninstall()
     return state, hist
 
 
