@@ -355,7 +355,12 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         )
         writer.add_scalar("lr", scalars.get("lr", 0.0), epoch)
 
-    save_fn = lambda s: save_model(s, log_name)
+    if training.get("checkpoint_backend", "msgpack") == "orbax":
+        from .train.checkpoint import save_model_orbax
+
+        save_fn = lambda s, e=None: save_model_orbax(s, log_name, epoch=e)
+    else:
+        save_fn = lambda s, e=None: save_model(s, log_name, epoch=e)
     try:
         with Timer("train_validate_test"):
             state, hist = train_validate_test(
@@ -375,11 +380,17 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             )
     finally:
         writer.close()
+    # final save with the GLOBAL (possibly sharded) state — orbax writes
+    # shard-parallel; skipped when the preemption path already checkpointed
+    # (re-serializing identical state would burn the SIGTERM grace window)
+    from .utils import preemption
+
+    if not preemption.preempted():
+        save_fn(state)
     if multihost:
         # localize the replicated global-mesh state so downstream consumers
-        # (checkpoint serialization, single-host prediction) see host arrays
+        # (single-host prediction, plotting) see host arrays
         state = jax.tree_util.tree_map(lambda x: np.asarray(x), state)
-    save_model(state, log_name)
     if config.get("Visualization", {}).get("create_plots") and jax.process_index() == 0:
         # parity/error/history plots (reference: train_validate_test.py:100-126,
         # 268-313 drives postprocess/visualizer.py)

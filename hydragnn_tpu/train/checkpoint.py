@@ -51,17 +51,60 @@ def save_model(
     return fname
 
 
+def save_model_orbax(
+    state: TrainState, log_name: str, path: str = "./logs",
+    epoch: Optional[int] = None,
+) -> str:
+    """Orbax save: the idiomatic JAX checkpoint path for pod scale —
+    sharding-aware (every process writes its own shards; do NOT rank-gate)
+    and layout-portable. Opt in with ``Training.checkpoint_backend:
+    "orbax"``; the msgpack path stays the default for single-host runs."""
+    import orbax.checkpoint as ocp
+
+    if epoch is None:
+        env = os.getenv("HYDRAGNN_EPOCH")
+        epoch = int(env) if env is not None else 0
+    d = _run_dir(log_name, path)
+    ckpt_dir = os.path.abspath(os.path.join(d, "orbax"))
+    with ocp.CheckpointManager(ckpt_dir) as mgr:
+        # CheckpointManager.save refuses existing steps; re-saves of a step
+        # (best-val updates, resumed runs) replace the old checkpoint
+        if int(epoch) in mgr.all_steps():
+            mgr.delete(int(epoch))
+        mgr.save(int(epoch), args=ocp.args.StandardSave(state))
+        mgr.wait_until_finished()
+    import jax
+
+    if jax.process_index() == 0:
+        with open(os.path.join(d, "latest"), "w") as f:
+            f.write(f"orbax/{int(epoch)}")
+    return os.path.join(ckpt_dir, str(int(epoch)))
+
+
 def load_existing_model(
     template_state: TrainState, log_name: str, path: str = "./logs"
 ) -> TrainState:
     """Restore into a template with identical pytree structure
-    (reference: load_existing_model, model.py:128-149)."""
+    (reference: load_existing_model, model.py:128-149). The ``latest``
+    pointer selects the backend: an ``orbax/<step>`` entry restores through
+    orbax, a ``*.msgpack`` entry through flax serialization."""
     d = os.path.join(path, log_name)
     latest = os.path.join(d, "latest")
     if os.path.exists(latest):
         with open(latest) as f:
-            fname = os.path.join(d, f.read().strip())
+            entry = f.read().strip()
     else:
-        fname = os.path.join(d, f"{log_name}.msgpack")
+        entry = f"{log_name}.msgpack"
+    if entry.startswith("orbax/"):
+        import orbax.checkpoint as ocp
+
+        step = int(entry.split("/", 1)[1])
+        with ocp.CheckpointManager(
+            os.path.abspath(os.path.join(d, "orbax"))
+        ) as mgr:
+            return mgr.restore(
+                step, args=ocp.args.StandardRestore(template_state)
+            )
+    fname = os.path.join(d, entry)
     with open(fname, "rb") as f:
         return serialization.from_bytes(template_state, f.read())

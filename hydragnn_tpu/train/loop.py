@@ -235,7 +235,7 @@ class BestCheckpoint:
     """Best-validation checkpointing with warmup
     (reference: Checkpoint, hydragnn/utils/model/model.py:323-363)."""
 
-    def __init__(self, save_fn: Callable[[TrainState], None], warmup: int = 0):
+    def __init__(self, save_fn: Callable[..., None], warmup: int = 0):
         self.save_fn = save_fn
         self.warmup = warmup
         self.best = float("inf")
@@ -244,7 +244,7 @@ class BestCheckpoint:
         if epoch < self.warmup or val_loss >= self.best:
             return False
         self.best = val_loss
-        self.save_fn(state)
+        self.save_fn(state, epoch)
         return True
 
 
@@ -259,7 +259,7 @@ def train_validate_test(
     log_name: str = "run",
     verbosity: int = 0,
     seed: int = 0,
-    save_fn: Optional[Callable[[TrainState], None]] = None,
+    save_fn: Optional[Callable[..., None]] = None,
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
     step_fn: Optional[Callable] = None,
     eval_fn: Optional[Callable] = None,
@@ -359,7 +359,7 @@ def train_validate_test(
             # is agreed across hosts so nobody blocks in a collective
             if preemption.preempted_global():
                 if save_fn is not None:
-                    save_fn(state)
+                    save_fn(state, epoch)
                 if verbosity > 0:
                     print(f"[{log_name}] SIGTERM: checkpointed at epoch {epoch}, stopping")
                 break
