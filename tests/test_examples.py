@@ -244,3 +244,31 @@ def pytest_example_uv_spectrum(tmp_path):
         "--num_samples", "48", "--num_epoch", "2", cwd=str(tmp_path),
     )
     assert "spectrum MAE" in out
+
+
+def pytest_example_ogb_smiles(tmp_path):
+    out = _run_example(
+        "examples/ogb/train_gap.py", "--num_samples", "48",
+        "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    assert "gap MAE" in out
+
+
+def pytest_example_oc22(tmp_path):
+    """OC22 total-energy slabs (table-form targets from the slab generator)."""
+    out = _run_example(
+        "examples/open_catalyst_2022/train.py", "--num_samples", "24",
+        "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    assert "energy MAE" in out
+
+
+def pytest_example_multibranch_driver(tmp_path):
+    """Branch-parallel GFM driver over the (branch, data) mesh with uneven
+    branch sampling weights."""
+    out = _run_example(
+        "examples/multibranch/train.py", "--epochs", "3",
+        "--branch_size", "2", "--branch_weights", "2,1",
+        cwd=str(tmp_path), timeout=600,
+    )
+    assert "epoch 2:" in out
