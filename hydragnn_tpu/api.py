@@ -290,6 +290,10 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             # loader emits stacked [local_shards, ...] batches: init on one
             sample = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
         variables = init_model(model, sample, seed=0)
+    from .utils import print_model
+
+    # parameter summary (reference: print_model, model.py:289-297)
+    print_model(variables, verbosity=verbosity)
     tx = make_optimizer(
         training["Optimizer"],
         freeze_conv=bool(arch.get("freeze_conv_layers", False)),

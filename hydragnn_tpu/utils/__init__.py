@@ -7,6 +7,7 @@ from .printing import (
     iterate_tqdm,
     print_distributed,
     print_master,
+    print_model,
     setup_log,
 )
 from .profile import Profiler, peak_memory_stats, print_peak_memory
@@ -23,6 +24,7 @@ __all__ = [
     "peak_memory_stats",
     "print_distributed",
     "print_master",
+    "print_model",
     "print_peak_memory",
     "print_timers",
     "query_remaining_seconds",

@@ -66,3 +66,27 @@ def setup_log(name: str, path: str = "./logs") -> logging.Logger:
     ch.setFormatter(fmt)
     logger.addHandler(ch)
     return logger
+
+
+def print_model(variables: dict, verbosity: int = 2) -> int:
+    """Parameter summary: per-module leaf shapes and the total count
+    (reference: print_model, hydragnn/utils/model/model.py:289-297).
+    Returns the total parameter count; prints at verbosity >= 2."""
+    import numpy as np
+
+    try:
+        from flax.traverse_util import flatten_dict
+
+        flat = flatten_dict(variables.get("params", variables))
+    except Exception:
+        flat = {("params",): variables}
+    total = 0
+    lines = []
+    for path, leaf in sorted(flat.items()):
+        n = int(np.prod(np.shape(leaf))) if np.ndim(leaf) else 1
+        total += n
+        lines.append(f"  {'/'.join(map(str, path))}: {tuple(np.shape(leaf))} = {n}")
+    if verbosity >= 2 and _process_index() == 0:
+        print("\n".join(lines))
+        print(f"Total trainable parameters: {total}")
+    return total

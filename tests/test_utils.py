@@ -215,3 +215,26 @@ def pytest_orbax_checkpoint_roundtrip(tmp_path, monkeypatch):
     # prediction path (model_state=None) also restores from orbax
     tot, tasks, preds, trues = hydragnn_tpu.run_prediction(cfg_out)
     assert np.isfinite(tot)
+
+
+def pytest_print_model_summary(capsys):
+    """print_model dumps per-leaf shapes and the total parameter count
+    (reference: print_model, model.py:289-297)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.utils import print_model
+
+    variables = {
+        "params": {
+            "Dense_0": {"kernel": jnp.zeros((3, 4)), "bias": jnp.zeros((4,))},
+            "Dense_1": {"kernel": jnp.zeros((4, 2))},
+        }
+    }
+    total = print_model(variables, verbosity=2)
+    assert total == 3 * 4 + 4 + 4 * 2
+    out = capsys.readouterr().out
+    assert "Total trainable parameters: 24" in out
+    assert "Dense_0/kernel" in out
+    # silent at low verbosity, still returns the count
+    assert print_model(variables, verbosity=0) == 24
+    assert "Total" not in capsys.readouterr().out
