@@ -100,6 +100,10 @@ def main():
     ap.add_argument("--mpnn_type", default=None)
     ap.add_argument("--balance", action="store_true",
                     help="equal per-family step budget via weighted draws")
+    ap.add_argument("--ref_energy", action="store_true",
+                    help="subtract least-squares per-element reference "
+                    "energies before training (the reference's "
+                    "energy_linear_regression.py preprocessing)")
     args = ap.parse_args()
 
     with open(os.path.join(_HERE, "gfm_multitasking.json")) as f:
@@ -116,6 +120,26 @@ def main():
         args.num_per_dataset, arch["radius"], arch["max_neighbours"]
     )
     tr, va, te = split_dataset(merged, 0.8, seed=0)
+    if args.ref_energy:
+        from hydragnn_tpu.data import (
+            fit_reference_energies,
+            subtract_reference_energies,
+        )
+
+        # one table per dataset (offsets are DFT-setting specific), fit on
+        # the TRAIN split only, and only for true-energy families (qm7x's
+        # graph scalar is HLGAP — not an energy, FAMILIES kind "scalar")
+        energy_ids = {
+            i for i, (_, (_, kind)) in enumerate(FAMILIES.items())
+            if kind != "scalar"
+        }
+        fit_set = [g for g in tr if g.dataset_id in energy_ids]
+        tables = fit_reference_energies(fit_set, per_atom=True, by_dataset=True)
+        tr, va, te = (
+            subtract_reference_energies(s, tables, per_atom=True)
+            for s in (tr, va, te)
+        )
+        print(f"reference energies fit per dataset: {sorted(tables)}")
 
     model, state, hist, config, loaders, mm = hydragnn_tpu.run_training(
         config, datasets=(tr, va, te)

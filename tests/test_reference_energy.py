@@ -1,0 +1,117 @@
+"""Per-element reference-energy regression (data/reference_energy.py;
+reference: examples/multidataset/energy_linear_regression.py)."""
+
+import numpy as np
+
+from hydragnn_tpu.data import (
+    ani1x_shaped_dataset,
+    fit_reference_energies,
+    subtract_reference_energies,
+)
+from hydragnn_tpu.data.graph import Graph
+
+
+def _graph(z, energy):
+    z = np.asarray(z, np.int32)
+    n = z.shape[0]
+    return Graph(
+        x=z[:, None].astype(np.float32),
+        pos=np.zeros((n, 3), np.float32),
+        senders=np.array([0], np.int32),
+        receivers=np.array([min(1, n - 1)], np.int32),
+        graph_y=np.asarray([energy], np.float32),
+        z=z,
+    )
+
+
+def pytest_exact_linear_composition_recovered():
+    """Energies that ARE a per-element sum fit exactly; residuals vanish."""
+    e = {1: -0.5, 6: -38.0, 8: -75.0}
+    rng = np.random.default_rng(0)
+    graphs = []
+    for _ in range(30):
+        z = rng.choice([1, 6, 8], size=rng.integers(2, 12))
+        graphs.append(_graph(z, sum(e[int(v)] for v in z)))
+    table = fit_reference_energies(graphs)
+    for zz, ee in e.items():
+        assert abs(table[zz] - ee) < 1e-6, (zz, table[zz])
+    resid = subtract_reference_energies(graphs, table)
+    assert max(abs(float(g.graph_y[0])) for g in resid) < 1e-4
+
+
+def pytest_residuals_better_conditioned_on_shaped_data():
+    """On the ANI1x-shaped family the residual variance drops vs raw
+    totals offset by fake per-element constants (the real use case)."""
+    graphs = ani1x_shaped_dataset(64)
+    offsets = {1: -0.6, 6: -38.1, 7: -54.6, 8: -75.1}
+    shifted = []
+    for g in graphs:
+        e = g.graph_targets["energy"][0] + sum(
+            offsets[int(z)] for z in g.z
+        )
+        import dataclasses
+
+        shifted.append(dataclasses.replace(
+            g, graph_targets={"energy": np.asarray([e], np.float32)}
+        ))
+    raw = np.asarray([g.graph_targets["energy"][0] for g in shifted])
+    table = fit_reference_energies(shifted)
+    resid_graphs = subtract_reference_energies(shifted, table)
+    resid = np.asarray(
+        [g.graph_targets["energy"][0] for g in resid_graphs]
+    )
+    assert resid.std() < 0.25 * raw.std()
+
+
+def pytest_per_atom_mode_roundtrip():
+    e = {6: -38.0, 8: -75.0}
+    rng = np.random.default_rng(1)
+    graphs = []
+    for _ in range(20):
+        z = rng.choice([6, 8], size=rng.integers(2, 9))
+        total = sum(e[int(v)] for v in z)
+        graphs.append(_graph(z, total / z.shape[0]))  # per-atom target
+    table = fit_reference_energies(graphs, per_atom=True)
+    for zz, ee in e.items():
+        assert abs(table[zz] - ee) < 1e-6
+    resid = subtract_reference_energies(graphs, table, per_atom=True)
+    assert max(abs(float(g.graph_y[0])) for g in resid) < 1e-5
+
+
+def pytest_by_dataset_tables_and_passthrough():
+    """Per-dataset fitting: distinct offsets per family are each recovered,
+    and graphs whose dataset_id has no table pass through unchanged."""
+    import dataclasses
+
+    rng = np.random.default_rng(2)
+    e0 = {6: -38.0, 8: -75.0}
+    e1 = {6: -40.0, 8: -70.0}  # different DFT settings, same elements
+    graphs = []
+    for ds_id, table in ((0, e0), (1, e1)):
+        for _ in range(20):
+            z = rng.choice([6, 8], size=rng.integers(2, 9))
+            g = _graph(z, sum(table[int(v)] for v in z))
+            graphs.append(dataclasses.replace(g, dataset_id=ds_id))
+    scalar = dataclasses.replace(_graph([6, 8], 1.23), dataset_id=2)
+    tables = fit_reference_energies(graphs, by_dataset=True)
+    assert abs(tables[0][6] - (-38.0)) < 1e-6
+    assert abs(tables[1][6] - (-40.0)) < 1e-6
+    resid = subtract_reference_energies(graphs + [scalar], tables)
+    assert max(abs(float(g.graph_y[0])) for g in resid[:-1]) < 1e-4
+    # dataset 2 has no table: HLGAP-style scalar untouched
+    assert float(resid[-1].graph_y[0]) == np.float32(1.23)
+
+
+def pytest_fit_subtract_share_extraction_rule():
+    """A graph with node-only graph_targets and energy in graph_y works in
+    BOTH entry points (the shared _energy_of rule)."""
+    import dataclasses
+
+    g = _graph([6, 6, 8], -151.0)
+    g = dataclasses.replace(
+        g, graph_targets={"forces": np.zeros((3, 3), np.float32)}
+    )
+    table = fit_reference_energies([g] * 4)
+    out = subtract_reference_energies([g], table)
+    assert np.isfinite(out[0].graph_y[0])
+    assert "forces" in out[0].graph_targets  # untouched
