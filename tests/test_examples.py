@@ -272,3 +272,13 @@ def pytest_example_multibranch_driver(tmp_path):
         cwd=str(tmp_path), timeout=600,
     )
     assert "epoch 2:" in out
+
+
+def pytest_example_qm9_hpo_driver(tmp_path):
+    """HPO example driver: random search over the qm9-shaped flow."""
+    out = _run_example(
+        "examples/qm9_hpo/qm9_hpo.py", "--num_trials", "2",
+        "--num_samples", "48", "--num_epoch", "2", "--no_optuna",
+        cwd=str(tmp_path), timeout=600,
+    )
+    assert "best:" in out
