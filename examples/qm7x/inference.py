@@ -23,11 +23,16 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--single_tasking", action="store_true")
+    ap.add_argument("--num_epoch", type=int, default=None,
+                    help="must match the training run: the checkpoint's "
+                    "log-dir name embeds num_epoch (get_log_name_config)")
     args = ap.parse_args()
 
     cfg = "qm7x_single_tasking.json" if args.single_tasking else "qm7x.json"
     with open(os.path.join(_HERE, cfg)) as f:
         config = json.load(f)
+    if args.num_epoch:
+        config["NeuralNetwork"]["Training"]["num_epoch"] = args.num_epoch
     data_path = os.path.join(os.getcwd(), config["Dataset"]["path"]["total"])
     config["Dataset"]["path"]["total"] = data_path
     if not os.path.isdir(data_path):

@@ -282,3 +282,40 @@ def pytest_example_qm9_hpo_driver(tmp_path):
         cwd=str(tmp_path), timeout=600,
     )
     assert "best:" in out
+
+
+def pytest_example_omat24(tmp_path):
+    out = _run_example(
+        "examples/open_materials_2024/omat24.py", "--num_samples", "24",
+        "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    assert "energy_per_atom MAE" in out
+
+
+def pytest_example_omol25_forces(tmp_path):
+    out = _run_example(
+        "examples/open_molecules_2025/train.py", "--train_mode", "forces",
+        "--num_samples", "24", "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    assert "forces MAE" in out
+
+
+def pytest_example_odac23(tmp_path):
+    out = _run_example(
+        "examples/open_direct_air_capture_2023/train.py",
+        "--num_samples", "16", "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    assert "energy_per_atom MAE" in out
+
+
+def pytest_example_qm7x_inference_roundtrip(tmp_path):
+    """train.py then inference.py restores the checkpoint from logs/."""
+    _run_example(
+        "examples/qm7x/train.py", "--single_tasking",
+        "--num_samples", "48", "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    out = _run_example(
+        "examples/qm7x/inference.py", "--single_tasking",
+        "--num_epoch", "2", cwd=str(tmp_path),
+    )
+    assert "HLGAP MAE" in out
