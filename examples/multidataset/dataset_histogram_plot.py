@@ -1,0 +1,48 @@
+"""Per-family dataset statistics plots for the GFM fleet (reference:
+examples/multidataset/dataset_histogram_plot.py — node-count histograms of
+the five datasets side by side).
+
+    python examples/multidataset/dataset_histogram_plot.py [--num_per_dataset 64]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num_per_dataset", type=int, default=64)
+    ap.add_argument("--out", default="dataset_histograms.png")
+    args = ap.parse_args()
+
+    import train as multidataset_train  # examples/multidataset/train.py
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fams = list(multidataset_train.FAMILIES.items())
+    fig, axs = plt.subplots(2, len(fams), figsize=(3.2 * len(fams), 5.6))
+    for col, (name, (maker, _)) in enumerate(fams):
+        graphs = maker(number_configurations=args.num_per_dataset)
+        sizes = [g.num_nodes for g in graphs]
+        degrees = np.concatenate([
+            np.bincount(g.receivers, minlength=g.num_nodes) for g in graphs
+        ])
+        axs[0][col].hist(sizes, bins=20)
+        axs[0][col].set_title(f"{name}: atoms/graph", fontsize=9)
+        axs[1][col].hist(degrees, bins=20)
+        axs[1][col].set_title(f"{name}: in-degree", fontsize=9)
+    fig.tight_layout()
+    fig.savefig(args.out, dpi=120)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
