@@ -112,7 +112,10 @@ def subtract_reference_energies(
             out.append(g)
             continue
         e, field = _energy_of(g)
-        offset = float(sum(t.get(int(z), 0.0) for z in np.asarray(g.z)))
+        zs, counts = np.unique(np.asarray(g.z), return_counts=True)
+        offset = float(
+            sum(t.get(int(z), 0.0) * int(c) for z, c in zip(zs, counts))
+        )
         resid = e - (offset / g.num_nodes if per_atom else offset)
         if field == "graph_targets":
             tgt = dict(g.graph_targets)

@@ -25,7 +25,12 @@ import numpy as np
 
 from .graph import Graph
 from .neighbors import radius_graph, radius_graph_pbc
-from .synthetic import _lj_targets, _symmetrize_edges, supercell_frac
+from .synthetic import (
+    _lj_targets,
+    _symmetrize_edges,
+    grow_molecule as _grow_molecule,
+    supercell_frac,
+)
 
 # electronegativity table (Pauling) for the charge-like closed-form targets
 _EN = {1: 2.20, 6: 2.55, 7: 3.04, 8: 3.44, 9: 3.98, 16: 2.58, 17: 3.16,
@@ -36,24 +41,6 @@ _EN = {1: 2.20, 6: 2.55, 7: 3.04, 8: 3.44, 9: 3.98, 16: 2.58, 17: 3.16,
 
 def _en_of(z: np.ndarray) -> np.ndarray:
     return np.asarray([_EN.get(int(v), 1.8) for v in z], np.float64)
-
-
-def _grow_molecule(rng, n: int, lo: float = 1.0, hi: float = 1.9,
-                   step: float = 1.5, max_tries: int = 8000) -> np.ndarray:
-    """Bonded-molecule geometry by rejection sampling at covalent distances:
-    each new atom is placed within [lo, hi] of every previously placed atom
-    it lands near, anchored off a random existing atom."""
-    pos = np.zeros((n, 3))
-    placed, tries = 1, 0
-    while placed < n and tries < max_tries:
-        tries += 1
-        anchor = pos[int(rng.integers(placed))]
-        cand = anchor + rng.normal(0.0, 1.0, 3) * step
-        d = np.linalg.norm(pos[:placed] - cand, axis=1)
-        if d.min() > lo and d.min() < hi:
-            pos[placed] = cand
-            placed += 1
-    return pos[:placed]
 
 
 def _molecule_forces_family(

@@ -116,6 +116,25 @@ def _configuration(rng, uc, types, number_neighbors, linear_only, radius, max_ne
     )
 
 
+def grow_molecule(rng, n: int, lo: float = 1.0, hi: float = 1.9,
+                  step: float = 1.5, max_tries: int = 8000) -> np.ndarray:
+    """Bonded-molecule geometry by rejection sampling at covalent distances:
+    each new atom anchors off a random placed atom and must land within
+    [lo, hi] of its nearest neighbor. Shared by the molecular generators
+    (qm9 here; ani1x/qm7x/transition1x/omol25/uv in data/shaped.py)."""
+    pos = np.zeros((n, 3))
+    placed, tries = 1, 0
+    while placed < n and tries < max_tries:
+        tries += 1
+        anchor = pos[int(rng.integers(placed))]
+        cand = anchor + rng.normal(0.0, 1.0, 3) * step
+        d = np.linalg.norm(pos[:placed] - cand, axis=1)
+        if d.min() > lo and d.min() < hi:
+            pos[placed] = cand
+            placed += 1
+    return pos[:placed]
+
+
 def supercell_frac(basis: np.ndarray, reps: int) -> np.ndarray:
     """Fractional coordinates of a ``reps^3`` supercell of ``basis`` (one
     row per atom, x-major cell order) — shared by the periodic generators
@@ -306,21 +325,9 @@ def qm9_shaped_dataset(
             ]
         ).astype(np.int32)
         n = z.shape[0]
-        # bonded-molecule geometry: rejection sampling at covalent distances
-        pos = np.zeros((n, 3))
-        placed = 1
-        tries = 0
-        while placed < n and tries < 8000:
-            tries += 1
-            anchor = pos[int(rng.integers(placed))]
-            cand = anchor + rng.normal(0.0, 1.0, 3) * 1.5
-            d = np.linalg.norm(pos[:placed] - cand, axis=1)
-            if np.min(d) > 1.0 and np.min(d) < 1.9:
-                pos[placed] = cand
-                placed += 1
-        pos = pos[:placed]
-        z = z[:placed]
-        n = placed
+        pos = grow_molecule(rng, n)
+        z = z[: pos.shape[0]]
+        n = pos.shape[0]
         senders, receivers = radius_graph(pos, radius, max_neighbours)
         senders, receivers = _symmetrize_edges(senders, receivers)
         energy, _ = _lj_targets(pos, senders, receivers, 0.15, 1.2)

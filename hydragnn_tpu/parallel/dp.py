@@ -56,7 +56,7 @@ def make_parallel_train_step(
 
     def per_device_loss(params, batch_stats, batch, rng):
         if mixed_precision:
-            from ..train.loop import mp_cast
+            from ..train.loop import mp_cast, mp_restore_stats
 
             params, batch = mp_cast(params, batch, compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
@@ -64,8 +64,6 @@ def make_parallel_train_step(
             model, variables, batch, cfg, True, rng, compute_grad_energy
         )
         if mixed_precision:
-            from ..train.loop import mp_restore_stats
-
             mutated = mp_restore_stats(mutated)
         return tot.astype(jnp.float32), (tasks, mutated)
 
