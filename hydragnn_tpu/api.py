@@ -49,6 +49,10 @@ def _localize_loader(loader: GraphLoader) -> GraphLoader:
         shuffle=False,
         host_count=loader.host_count,
         host_index=loader.host_index,
+        # the Pallas sorted-segment route is baked into the model when
+        # use_sorted_aggregation is on — the localized loader must keep
+        # feeding receiver-sorted batches or its sums are unspecified
+        sort_edges=loader.sort_edges,
     )
 
 
@@ -202,7 +206,10 @@ def prepare_data(
         host_count=host_count,
         host_index=host_index,
         num_shards=num_shards,
-        # receiver-sorted edges feed the Pallas segment kernel (TPU)
+        # receiver-sorted edges feed the Pallas segment kernel (TPU). No
+        # max_in_degree here: update_config already validated the dataset's
+        # top in-degree against the bound (config.py:194-207); the loader
+        # check exists for directly constructed loaders
         sort_edges=bool(arch.get("use_sorted_aggregation", False)),
     )
     # equal per-dataset step budget for GFM fleets: weighted draws with
@@ -386,11 +393,15 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         writer.close()
     # final save with the GLOBAL (possibly sharded) state — orbax writes
     # shard-parallel; skipped when the preemption path already checkpointed
-    # (re-serializing identical state would burn the SIGTERM grace window)
+    # (re-serializing identical state would burn the SIGTERM grace window).
+    # Gate on the loop's cross-host AGREED decision, not the local SIGTERM
+    # flag: under orbax the save is a collective, and skewed signal delivery
+    # would otherwise hang the non-preempted hosts in it.
     from .utils import preemption
 
-    if not preemption.preempted():
-        save_fn(state)
+    if not preemption.global_stop_noted():
+        final_epoch = len(hist["train"]) - 1
+        save_fn(state, final_epoch if final_epoch >= 0 else None)
     if multihost:
         # localize the replicated global-mesh state so downstream consumers
         # (single-host prediction, plotting) see host arrays
@@ -481,7 +492,12 @@ def _(config: dict, model_state=None, datasets=None):
     if _jax.process_count() > 1:
         import numpy as _np
 
-        w = float(len(preds[next(iter(preds))]))
+        # per-host weight = number of real graphs this host evaluated (the
+        # same weighting _weighted_avg used inside test_model) — NOT the
+        # element count of the first head, which for a node-level head
+        # scales with node count and would skew the merged loss when hosts
+        # hold different-sized graphs
+        w = float(len(test_loader.graphs))
         packed = {
             "w": _np.asarray([w]),
             "tot": _np.asarray([tot * w]),
@@ -495,10 +511,18 @@ def _(config: dict, model_state=None, datasets=None):
     trues = gather_across_hosts(trues)
     var = config["NeuralNetwork"]["Variables_of_interest"]
     if var.get("denormalize_output") and mm is not None:
+        # every head is denormalized, node-level included (reference:
+        # output_denormalize, hydragnn/postprocess/postprocess.py:13-26)
         voi = voi_from_config(config)
         for name, t, idx in zip(var["output_names"], var["type"], var["output_index"]):
+            if name not in preds:
+                continue  # e.g. autograd-forces head replaces the node head
             if t == "graph":
                 sl = voi.graph_feature_slice(idx)
                 preds[name] = mm.denormalize_graph(preds[name], sl)
                 trues[name] = mm.denormalize_graph(trues[name], sl)
+            else:
+                sl = voi.node_feature_slice(idx)
+                preds[name] = mm.denormalize_node(preds[name], sl)
+                trues[name] = mm.denormalize_node(trues[name], sl)
     return tot, tasks, preds, trues

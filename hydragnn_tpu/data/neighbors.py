@@ -54,6 +54,12 @@ def _native_lib():
         ]
         _native = lib
     except Exception:
+        import warnings
+
+        warnings.warn(
+            "native cell-list neighbor builder unavailable "
+            "(C++ toolchain missing?); falling back to scipy KD-tree"
+        )
         _native = False
     return _native or None
 
@@ -244,7 +250,11 @@ def _cap_neighbours(pos, senders, receivers, shifts, k):
         disp = disp + shifts
     d = np.linalg.norm(disp, axis=1)
     keep = np.zeros(senders.shape[0], bool)
-    order = np.lexsort((d, receivers))
+    # sender index as the final key breaks distance ties deterministically:
+    # the native cell-list and scipy builders emit the same edge SET in
+    # different orders, and without this the capped edge set would differ
+    # between machines with and without a working C++ toolchain
+    order = np.lexsort((senders, d, receivers))
     recv_sorted = receivers[order]
     start = 0
     while start < order.size:

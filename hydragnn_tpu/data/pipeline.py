@@ -128,6 +128,15 @@ class MinMax:
     def denormalize_graph(self, y: np.ndarray, idx: slice) -> np.ndarray:
         return y * (self.y_max[idx] - self.y_min[idx]) + self.y_min[idx]
 
+    def denormalize_node(self, y: np.ndarray, idx: slice) -> np.ndarray:
+        """Node heads are extracted from (normalized) ``graph.x`` columns, so
+        their scale is the x min/max (reference: output_denormalize covers
+        every head, hydragnn/postprocess/postprocess.py:13-26)."""
+        lo = (self.node_y_min if self.node_y_min is not None else self.x_min)[idx]
+        hi = (self.node_y_max if self.node_y_max is not None else self.x_max)[idx]
+        rng = np.where(hi > lo, hi - lo, 1.0)
+        return y * rng + lo
+
 
 def branch_sample_weights(
     graphs: Sequence[Graph], branch_weights: Dict[int, float]
@@ -231,6 +240,7 @@ class GraphLoader:
         num_samples: Optional[int] = None,
         sample_weights: Optional[np.ndarray] = None,
         sort_edges: bool = False,
+        max_in_degree: Optional[int] = None,
         prefetch: int = 0,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
@@ -286,6 +296,24 @@ class GraphLoader:
         # receiver-sorted edges (the Pallas sorted-segment-sum precondition,
         # ops/pallas_segment.py; also scatter-friendlier for XLA)
         self.sort_edges = sort_edges
+        # the Pallas kernel leaves over-degree segments UNSPECIFIED
+        # (ops/pallas_segment.py); fail loudly at loader build instead of
+        # risking silently wrong aggregation sums on device
+        if sort_edges and max_in_degree:
+            for gi, g in enumerate(graphs):
+                if g.num_edges:
+                    top = int(
+                        np.bincount(
+                            np.asarray(g.receivers), minlength=g.num_nodes
+                        ).max()
+                    )
+                    if top > int(max_in_degree):
+                        raise ValueError(
+                            f"graph {gi} has in-degree {top} > max_in_degree "
+                            f"{max_in_degree}; raise Architecture.max_in_degree "
+                            "(the Pallas sorted-segment kernel would produce "
+                            "unspecified sums for over-degree nodes)"
+                        )
         # background-thread batch building: host batching overlaps device
         # compute (the reference's HydraDataLoader thread-pool loader,
         # hydragnn/preprocess/load_data.py:93-203; its core-affinity pinning

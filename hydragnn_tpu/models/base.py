@@ -394,7 +394,6 @@ class MLPNode(nn.Module):
             split_rngs={"params": True},
         )(feats, self.activation)
         # evaluate all per-node MLPs on gathered inputs ordered by node pos
-        xs = jnp.zeros((self.num_nodes, x.shape[0], x.shape[1]), x.dtype)
         onehot = jax.nn.one_hot(node_pos % self.num_nodes, self.num_nodes, axis=0)
         xs = jnp.einsum("pn,nf->pnf", onehot, x)
         ys = mlps(xs)  # [num_nodes, N, out]

@@ -12,10 +12,45 @@ All functions take ``num_segments`` statically so shapes stay fixed under jit.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+
+def _pallas_route_enabled() -> bool:
+    """Whether ``sorted_ids`` segment sums route to the Pallas MXU kernel.
+
+    ``jax.default_backend()`` is evaluated at trace time, which is correct
+    for the supported configurations (the framework jits for the default
+    backend); ``HYDRAGNN_PALLAS_SEGMENT=0/1`` overrides for a jit that
+    targets a non-default device.
+    """
+    pref = os.getenv("HYDRAGNN_PALLAS_SEGMENT")
+    if pref is not None:
+        return pref == "1"
+    return jax.default_backend() == "tpu"
+
+
+def _debug_check_sorted(segment_ids) -> None:
+    """Opt-in (HYDRAGNN_DEBUG_SORTED=1) runtime check that segment_ids is
+    non-decreasing — ``sorted_ids=True`` is otherwise an unchecked caller
+    promise, and an unsorted batch (e.g. hand-built at inference, bypassing
+    GraphLoader's sort_edges) would silently produce wrong sums."""
+
+    def _host_assert(ids):
+        import numpy as np
+
+        ids = np.asarray(ids)
+        if ids.size and (np.diff(ids) < 0).any():
+            raise AssertionError(
+                "segment_sum(sorted_ids=True) received unsorted segment_ids; "
+                "build batches with GraphLoader(sort_edges=True) or disable "
+                "use_sorted_aggregation"
+            )
+
+    jax.debug.callback(_host_assert, segment_ids)
 
 
 def _mask_messages(messages: jnp.ndarray, mask: Optional[jnp.ndarray], fill: float = 0.0):
@@ -43,12 +78,9 @@ def segment_sum(
     backend, or 1-D messages, falls back to ``jax.ops.segment_sum``.
     """
     msg = _mask_messages(messages, mask)
-    if (
-        sorted_ids
-        and max_degree
-        and msg.ndim == 2
-        and jax.default_backend() == "tpu"
-    ):
+    if sorted_ids and os.getenv("HYDRAGNN_DEBUG_SORTED") == "1":
+        _debug_check_sorted(segment_ids)
+    if sorted_ids and max_degree and msg.ndim == 2 and _pallas_route_enabled():
         from .pallas_segment import sorted_segment_sum
 
         return sorted_segment_sum(msg, segment_ids, num_segments, max_degree)

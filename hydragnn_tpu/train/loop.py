@@ -178,6 +178,12 @@ def _weighted_avg(entries: List[Tuple[float, Dict[str, float], int]]):
 def train_epoch(loader, step_fn, state, rng):
     from ..utils import tracer as tr
 
+    # Device-side loss bookkeeping: the per-step (loss, tasks) scalars stay
+    # on device and are read back ONCE at epoch end, so step i+1 dispatches
+    # while step i is still executing (JAX async dispatch keeps the chip
+    # saturated; a per-step float() would block the host on every step and
+    # serialize the pipeline — the reference tolerates this because torch
+    # .item() overlaps with DDP bucket comms, XLA does not).
     entries = []
     it = iter(loader)
     for i in range(len(loader)):
@@ -194,12 +200,19 @@ def train_epoch(loader, step_fn, state, rng):
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
         state, tot, tasks = step_fn(state, batch, sub)
+        # graph_mask is a host numpy array from the loader — no device sync
         n = int(np.asarray(batch.graph_mask).sum())
         tr.stop("train_step")
-        entries.append((float(tot), {k: float(v) for k, v in tasks.items()}, n))
+        entries.append((tot, tasks, n))
         max_batches = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
         if max_batches is not None and i + 1 >= int(max_batches):
             break
+    # single host sync for the whole epoch
+    entries = jax.device_get(entries)
+    entries = [
+        (float(t), {k: float(v) for k, v in d.items()}, n)
+        for t, d, n in entries
+    ]
     tot, tasks = _weighted_avg(entries)
     return state, tot, tasks, rng
 
@@ -209,7 +222,12 @@ def evaluate(loader, eval_fn, state):
     for batch in loader:
         tot, tasks, _ = eval_fn(state, batch)
         n = int(np.asarray(batch.graph_mask).sum())
-        entries.append((float(tot), {k: float(v) for k, v in tasks.items()}, n))
+        entries.append((tot, tasks, n))
+    entries = jax.device_get(entries)
+    entries = [
+        (float(t), {k: float(v) for k, v in d.items()}, n)
+        for t, d, n in entries
+    ]
     return _weighted_avg(entries)
 
 
@@ -358,6 +376,7 @@ def train_validate_test(
             # Training.continue resumes with <= 1 epoch lost; the decision
             # is agreed across hosts so nobody blocks in a collective
             if preemption.preempted_global():
+                preemption.note_global_stop()
                 if save_fn is not None:
                     save_fn(state, epoch)
                 if verbosity > 0:

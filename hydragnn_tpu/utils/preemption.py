@@ -20,6 +20,12 @@ import threading
 from typing import Optional
 
 _flag = threading.Event()
+# set by the training loop when the CROSS-HOST agreed preemption stop fired
+# (and the loop checkpointed) — consumers gate collective end-of-run saves
+# on this, never on the per-process _flag: SIGTERM delivery can skew across
+# hosts, and a save gated on the local flag would leave non-preempted hosts
+# blocked in a collective orbax save the preempted host skips
+_global_stop = threading.Event()
 _installed: Optional[int] = None
 _prev_handler = None
 
@@ -30,6 +36,7 @@ def install() -> None:
     one handled SIGTERM would stop every later training run at epoch 0."""
     global _installed, _prev_handler
     _flag.clear()
+    _global_stop.clear()
     if _installed is not None:
         return
     if threading.current_thread() is not threading.main_thread():
@@ -91,6 +98,21 @@ def preempted_global() -> bool:
     return bool(np.asarray(flags).any())
 
 
+def note_global_stop() -> None:
+    """Record that the agreed cross-host preemption stop happened (called by
+    the training loop right before its preemption checkpoint). Because
+    ``preempted_global()`` is a collective with one answer, every process
+    records the same decision."""
+    _global_stop.set()
+
+
+def global_stop_noted() -> bool:
+    """True iff the training loop stopped (and checkpointed) on the agreed
+    cross-host preemption decision."""
+    return _global_stop.is_set()
+
+
 def reset() -> None:
-    """Clear the flag (tests / consecutive runs in one process)."""
+    """Clear the flags (tests / consecutive runs in one process)."""
     _flag.clear()
+    _global_stop.clear()

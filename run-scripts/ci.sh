@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI entrypoint — the analog of the reference's GitHub Actions matrix
+# (reference: .github/workflows/CI.yml:26-63: pytest tier + a 2-rank Gloo
+# mpirun tier). Runs the fast-tier suite on a virtual 8-device CPU mesh,
+# then the 2-process jax.distributed tests.
+#
+# Usage: run-scripts/ci.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# CPU everywhere: CI must not claim a TPU; scrub any axon pool relay so
+# subprocess tests cannot block on it
+unset PALLAS_AXON_POOL_IPS || true
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+export HYDRAGNN_CI_FAST=1
+
+echo "== fast-tier suite (8-device CPU mesh) =="
+python -m pytest tests/ -x -q --deselect tests/test_multihost.py "$@"
+
+echo "== 2-process distributed tier =="
+python -m pytest tests/test_multihost.py -x -q
+
+echo "== multichip dryrun (8 virtual devices) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI OK"

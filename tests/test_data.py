@@ -163,3 +163,52 @@ def pytest_loader_prefetch_matches_sync():
     it = iter(pre)
     next(it)
     del it
+
+
+def pytest_minmax_denormalize_node_roundtrip():
+    graphs = deterministic_graph_dataset(number_configurations=10, seed=2)
+    mm = MinMax.fit(graphs)
+    normed = mm.apply(graphs)
+    # node targets are extracted from normalized x columns; denormalize_node
+    # must invert them back to the raw feature scale
+    sl = slice(1, 2)
+    back = mm.denormalize_node(np.asarray(normed[0].x)[:, sl], sl)
+    np.testing.assert_allclose(back, np.asarray(graphs[0].x)[:, sl], rtol=1e-5)
+
+
+def pytest_loader_rejects_overdegree_graphs():
+    """sort_edges + max_in_degree: batch construction fails loudly when a
+    real node's in-degree exceeds the Pallas kernel's static bound (the
+    kernel's output for over-degree segments is unspecified)."""
+    graphs = deterministic_graph_dataset(number_configurations=4, seed=1)
+    top = max(
+        int(np.bincount(np.asarray(g.receivers), minlength=g.num_nodes).max())
+        for g in graphs
+    )
+    # bound >= actual top degree: fine
+    GraphLoader(graphs, 2, sort_edges=True, max_in_degree=top)
+    with pytest.raises(ValueError, match="in-degree"):
+        GraphLoader(graphs, 2, sort_edges=True, max_in_degree=top - 1)
+
+
+def pytest_capped_edges_identical_across_builders(monkeypatch):
+    """With a max_neighbours cap, the scipy and native builders must keep the
+    IDENTICAL edge set — distance ties break on sender index, not builder
+    emission order."""
+    from hydragnn_tpu.data import neighbors as nb
+
+    if nb._native_lib() is None:
+        pytest.skip("native neighbor builder unavailable")
+    rng = np.random.default_rng(0)
+    # integer lattice: many exact distance ties
+    pos = np.array(
+        [[i, j, k] for i in range(4) for j in range(4) for k in range(4)],
+        np.float64,
+    )
+    monkeypatch.setenv("HYDRAGNN_NATIVE_NEIGHBORS", "1")
+    s1, r1 = radius_graph(pos, radius=1.1, max_neighbours=4)
+    monkeypatch.setenv("HYDRAGNN_NATIVE_NEIGHBORS", "0")
+    s2, r2 = radius_graph(pos, radius=1.1, max_neighbours=4)
+    e1 = set(zip(s1.tolist(), r1.tolist()))
+    e2 = set(zip(s2.tolist(), r2.tolist()))
+    assert e1 == e2

@@ -108,3 +108,26 @@ def pytest_handler_restored_and_flag_reset():
     preemption.install()
     assert not preemption.preempted()
     preemption.uninstall()
+
+
+def pytest_final_save_gates_on_global_decision():
+    """The end-of-run save must gate on the cross-host AGREED stop (recorded
+    by the loop via note_global_stop), never the per-process SIGTERM flag:
+    skewed signal delivery would otherwise hang non-preempted hosts in a
+    collective orbax save (ADVICE r2, api.py final-save gate)."""
+    from hydragnn_tpu.utils import preemption
+
+    preemption.reset()
+    # a SIGTERM that arrived but did NOT stop the loop (e.g. after the last
+    # epoch): local flag set, no agreed stop -> final save must proceed
+    preemption._flag.set()
+    assert preemption.preempted()
+    assert not preemption.global_stop_noted()
+    # the loop's agreed stop records the collective decision
+    preemption.note_global_stop()
+    assert preemption.global_stop_noted()
+    # install() for a fresh run clears both
+    preemption.install()
+    assert not preemption.global_stop_noted()
+    assert not preemption.preempted()
+    preemption.uninstall()
