@@ -224,6 +224,37 @@ def prepare_data(
         sample_weights = branch_sample_weights(
             trainset, {i: 1.0 for i in ids}
         )
+    num_branches = len(
+        arch["output_heads"].get("graph", [])
+        if isinstance(arch["output_heads"].get("graph"), list)
+        else []
+    )
+    if (
+        bool(training.get("branch_parallel", False))
+        and num_branches > 1
+        and num_shards > 1
+    ):
+        # branch-parallel decoders need branch-routed shard rows
+        # (parallel/branch.py BranchRoutedLoader)
+        from .parallel.branch import BranchRoutedLoader
+
+        route_kw = dict(
+            branch_count=num_branches,
+            num_shards=num_shards,
+            host_count=host_count,
+            host_index=host_index,
+            sort_edges=shard_kw["sort_edges"],
+        )
+        train_loader = BranchRoutedLoader(
+            trainset, batch_size, seed=0, shuffle=True, **route_kw
+        )
+        val_loader = BranchRoutedLoader(
+            valset, batch_size, shuffle=False, oversampling=False, **route_kw
+        )
+        test_loader = BranchRoutedLoader(
+            testset, batch_size, shuffle=False, oversampling=False, **route_kw
+        )
+        return config, (train_loader, val_loader, test_loader), mm
     train_loader = GraphLoader(
         trainset,
         batch_size,
@@ -290,13 +321,16 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     multihost = jax.process_count() > 1
     training = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
+    # one seed drives init and the train rng stream (dropout etc.);
+    # ``Training.seed`` pins runs for reproducibility studies
+    run_seed = int(training.get("seed", 0))
     with Timer("create_model"):
         model = create_model(config)
         sample = next(iter(train_loader))
         if multihost:
             # loader emits stacked [local_shards, ...] batches: init on one
             sample = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
-        variables = init_model(model, sample, seed=0)
+        variables = init_model(model, sample, seed=run_seed)
     from .utils import print_model
 
     # parameter summary (reference: print_model, model.py:289-297)
@@ -316,43 +350,78 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
 
     # ZeRO-1 analog (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
     # hydragnn/utils/optimizer/optimizer.py:43-113): shard the large optimizer
-    # moments over the data axis of a device mesh; params stay replicated.
-    # Single-host only: the multi-host shard_map step declares the whole
-    # state replicated, which a ZeRO-sharded opt_state would contradict.
-    if training["Optimizer"].get("use_zero_redundancy", False) and multihost:
-        import warnings
+    # moments over the data axis of the (global) device mesh; params stay
+    # replicated. Works single- and multi-host alike: the parallel step runs
+    # tx.update under the outer jit (outside its shard_map), so XLA
+    # partitions the update by the moments' sharding and all-gathers the
+    # resulting param updates (parallel/dp.py).
+    use_zero = training["Optimizer"].get("use_zero_redundancy", False)
+    if use_zero and not multihost and len(jax.devices()) > 1:
+        from .parallel import make_mesh, replicate_state, shard_optimizer_state
 
-        warnings.warn(
-            "use_zero_redundancy is ignored on multi-host runs: the "
-            "shard_map DP step keeps optimizer state replicated"
+        mesh = make_mesh()
+        state = replicate_state(state, mesh)
+        state = state.replace(
+            opt_state=shard_optimizer_state(state.opt_state, mesh)
         )
-    if training["Optimizer"].get("use_zero_redundancy", False) and not multihost:
-        if len(jax.devices()) > 1:
-            from .parallel import make_mesh, replicate_state, shard_optimizer_state
-
-            mesh = make_mesh()
-            state = replicate_state(state, mesh)
-            state = state.replace(
-                opt_state=shard_optimizer_state(state.opt_state, mesh)
-            )
 
     # multi-host DP: shard_map the step over the global (branch, data) mesh —
     # gradients psum across hosts over ICI/DCN, each process feeding the
     # shards its own host-sharded loader built (docs/MULTIHOST.md)
     step_fn = eval_fn = None
     if multihost:
-        from .parallel import make_mesh, promote_batch, replicate_state
+        from .parallel import (
+            make_mesh,
+            promote_batch,
+            replicate_state,
+            shard_optimizer_state,
+        )
         from .parallel.dp import (
             make_parallel_eval_step,
             make_parallel_train_step,
         )
 
-        mesh = make_mesh()
-        state = replicate_state(state, mesh)
         cge = training.get("compute_grad_energy", False)
         mp = training.get("mixed_precision", False)
-        _pstep = make_parallel_train_step(model, tx, mesh, cge, mp)
-        _peval = make_parallel_eval_step(model, mesh, cge, mp)
+        # branch-parallel decoders (Training.branch_parallel): decoder
+        # params/compute sharded over the mesh's branch axis, data routed by
+        # branch — the MultiTaskModelMP analog (parallel/branch.py). The
+        # predicate must MATCH prepare_data's loader-routing gate exactly:
+        # a branch step on unrouted batches computes garbage.
+        branch_parallel = bool(training.get("branch_parallel", False))
+        if branch_parallel and (
+            getattr(model.cfg, "num_branches", 1) < 2
+            or jax.local_device_count() < 2
+        ):
+            raise ValueError(
+                "Training.branch_parallel requires a multibranch model "
+                f"(num_branches={getattr(model.cfg, 'num_branches', 1)}) and "
+                f">=2 local devices (have {jax.local_device_count()}): "
+                "prepare_data could not build branch-routed loaders"
+            )
+        if branch_parallel:
+            from .parallel.branch import (
+                make_branch_parallel_eval_step,
+                make_branch_parallel_train_step,
+                place_branch_state,
+            )
+
+            mesh = make_mesh(branch_size=model.cfg.num_branches)
+            state = place_branch_state(state, tx, mesh)
+            _pstep = make_branch_parallel_train_step(model, tx, mesh, cge, mp)
+            _peval = make_branch_parallel_eval_step(model, mesh, cge, mp)
+        else:
+            mesh = make_mesh()
+            state = replicate_state(state, mesh)
+            if use_zero:
+                # ZeRO-1 on the multi-host mesh: moment leaves sharded
+                # P(data) AFTER the replicate (which would otherwise
+                # clobber them)
+                state = state.replace(
+                    opt_state=shard_optimizer_state(state.opt_state, mesh)
+                )
+            _pstep = make_parallel_train_step(model, tx, mesh, cge, mp)
+            _peval = make_parallel_eval_step(model, mesh, cge, mp)
         step_fn = lambda s, b, r: _pstep(s, promote_batch(b, mesh), r)
         # evaluate() expects (tot, tasks, aux) like make_eval_step
         eval_fn = lambda s, b: _peval(s, promote_batch(b, mesh)) + (None,)
@@ -384,6 +453,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 config,
                 log_name=log_name,
                 verbosity=verbosity,
+                seed=run_seed,
                 save_fn=save_fn,
                 log_fn=log_fn,
                 step_fn=step_fn,
@@ -403,9 +473,12 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         final_epoch = len(hist["train"]) - 1
         save_fn(state, final_epoch if final_epoch >= 0 else None)
     if multihost:
-        # localize the replicated global-mesh state so downstream consumers
-        # (single-host prediction, plotting) see host arrays
-        state = jax.tree_util.tree_map(lambda x: np.asarray(x), state)
+        # localize the global-mesh state so downstream consumers
+        # (single-host prediction, plotting) see host arrays; sharded
+        # leaves (ZeRO-1 moments, branch decoder banks) gather collectively
+        from .parallel.mesh import materialize_replicated
+
+        state = materialize_replicated(state)
     if config.get("Visualization", {}).get("create_plots") and jax.process_index() == 0:
         # parity/error/history plots (reference: train_validate_test.py:100-126,
         # 268-313 drives postprocess/visualizer.py)

@@ -160,6 +160,48 @@ def conv_registry() -> Tuple[str, ...]:
     return tuple(sorted(_CONV_REGISTRY))
 
 
+def _branch_bank(module_cls, num_branches: int, in_axes):
+    """A module class lifted over the branch axis: parameters (and running
+    batch-norm statistics) gain a leading [num_branches] axis, each branch
+    initialized with its own rng (matching the per-branch modules of the
+    reference, MultiTaskModelMP.py:172-201). ``in_axes`` follows jax.vmap:
+    ``None`` broadcasts an argument to every branch, ``0`` maps a stacked
+    per-branch input."""
+    return nn.vmap(
+        module_cls,
+        in_axes=in_axes,
+        out_axes=0,
+        variable_axes={"params": 0, "batch_stats": 0},
+        split_rngs={"params": True, "dropout": True},
+        axis_size=num_branches,
+    )
+
+
+class NodeConvHead(nn.Module):
+    """One branch's conv-chain node head: hidden convs + output conv, each
+    followed by masked batch norm (reference: Base._init_node_conv,
+    Base.py:260-341). Lifted over branches by ``_branch_bank``."""
+
+    cfg: "ModelConfig"
+    out_dim: int
+
+    @nn.compact
+    def __call__(self, x, equiv, batch: GraphBatch, train: bool):
+        cfg = self.cfg
+        _, ctor = get_conv_ctor(cfg.mpnn_type)
+        act = get_activation(cfg.activation)
+        nh = cfg.node_head or NodeHeadConfig()
+        inv, eq = x, equiv
+        in_d = cfg.hidden_dim
+        dims = tuple(nh.dim_headlayers) + (self.out_dim,)
+        for i, hd in enumerate(dims):
+            conv = ctor(cfg, in_d, hd, i == len(dims) - 1)
+            inv, eq = conv(inv, eq, batch, train)
+            inv = act(MaskedBatchNorm()(inv, batch.node_mask, train))
+            in_d = hd
+        return inv
+
+
 class HydraModel(nn.Module):
     """Encoder (conv stack (+GPS)) + multi-head, multi-branch decoders.
 
@@ -213,58 +255,50 @@ class HydraModel(nn.Module):
                     self.edge_lin = nn.Dense(cfg.hidden_dim, use_bias=False)
 
         # ---- decoders (reference: Base._multihead, Base.py:342-440)
+        # Every decoder is a BRANCH BANK: one flax module whose parameter
+        # (and batch_stats) leaves carry a leading [num_branches] axis,
+        # built with nn.vmap over the branch dimension. Dense decode stays
+        # the default (compute all branches + masked select), but the
+        # stacked leaves are what makes decoder params/compute shardable
+        # over the mesh's `branch` axis (parallel/branch.py — the analog of
+        # the reference's MultiTaskModelMP decoder groups,
+        # hydragnn/models/MultiTaskModelMP.py:203-230).
+        B = cfg.num_branches
         if any(t == "graph" for t in cfg.output_type):
             gh = cfg.graph_head or GraphHeadConfig()
-            self.graph_shared = [
-                MLP(
-                    (gh.dim_sharedlayers,) * gh.num_sharedlayers,
-                    cfg.activation,
-                    final_activation=True,
-                )
-                for _ in range(cfg.num_branches)
-            ]
+            self.graph_shared = _branch_bank(MLP, B, in_axes=(None,))(
+                (gh.dim_sharedlayers,) * gh.num_sharedlayers,
+                cfg.activation,
+                final_activation=True,
+            )
         heads = []
         for ihead, (t, d) in enumerate(zip(cfg.output_type, cfg.output_dim)):
             out_d = d * (2 if cfg.var_output else 1)
             if t == "graph":
                 gh = cfg.graph_head or GraphHeadConfig()
                 heads.append(
-                    [
-                        MLP(tuple(gh.dim_headlayers) + (out_d,), cfg.activation)
-                        for _ in range(cfg.num_branches)
-                    ]
+                    _branch_bank(MLP, B, in_axes=(0,))(
+                        tuple(gh.dim_headlayers) + (out_d,), cfg.activation
+                    )
                 )
             elif t == "node":
                 nh = cfg.node_head or NodeHeadConfig()
                 if nh.nn_type in ("mlp", "mlp_per_node"):
                     heads.append(
-                        [
-                            MLPNode(
-                                output_dim=out_d,
-                                hidden_dims=tuple(nh.dim_headlayers),
-                                nn_type=nh.nn_type,
-                                num_nodes=cfg.num_nodes or 0,
-                                activation=cfg.activation,
-                            )
-                            for _ in range(cfg.num_branches)
-                        ]
+                        _branch_bank(MLPNode, B, in_axes=(None, None))(
+                            output_dim=out_d,
+                            hidden_dims=tuple(nh.dim_headlayers),
+                            nn_type=nh.nn_type,
+                            num_nodes=cfg.num_nodes or 0,
+                            activation=cfg.activation,
+                        )
                     )
                 elif nh.nn_type == "conv":
-                    # conv-head chain: hidden convs + per-head output conv
-                    # (reference: Base._init_node_conv, Base.py:260-341)
-                    branch_stacks = []
-                    for _ in range(cfg.num_branches):
-                        stack = []
-                        dims = list(nh.dim_headlayers)
-                        in_d = cfg.hidden_dim
-                        for hd in dims:
-                            stack.append(
-                                (ctor(cfg, in_d, hd, False), MaskedBatchNorm())
-                            )
-                            in_d = hd
-                        stack.append((ctor(cfg, in_d, out_d, True), MaskedBatchNorm()))
-                        branch_stacks.append(stack)
-                    heads.append(branch_stacks)
+                    heads.append(
+                        _branch_bank(
+                            NodeConvHead, B, in_axes=(None, None, None, None)
+                        )(cfg=cfg, out_dim=out_d)
+                    )
                 else:
                     raise ValueError(f"unknown node head type {nh.nn_type!r}")
             else:
@@ -327,15 +361,13 @@ class HydraModel(nn.Module):
 
     def _graph_head(self, ihead, x_graph, dataset_id):
         """Dense all-branch compute + mask select (vs reference's boolean
-        indexing per dataset ID, Base.py:495-509)."""
+        indexing per dataset ID, Base.py:495-509). The branch bank computes
+        every branch in one vmapped call over stacked [B, ...] params."""
         cfg = self.cfg
-        outs = []
-        for b in range(cfg.num_branches):
-            shared = self.graph_shared[b](x_graph)
-            outs.append(self.heads_NN[ihead][b](shared))
+        shared = self.graph_shared(x_graph)  # [B, G, ds]
+        stacked = self.heads_NN[ihead](shared)  # [B, G, d]
         if cfg.num_branches == 1:
-            return outs[0]
-        stacked = jnp.stack(outs, axis=0)  # [B, G, d]
+            return stacked[0]
         return jnp.take_along_axis(
             stacked, dataset_id[None, :, None].astype(jnp.int32), axis=0
         )[0]
@@ -343,21 +375,12 @@ class HydraModel(nn.Module):
     def _node_head(self, ihead, x, equiv, batch, train):
         cfg = self.cfg
         nh = cfg.node_head or NodeHeadConfig()
-        act = get_activation(cfg.activation)
-        outs = []
-        for b in range(cfg.num_branches):
-            if nh.nn_type == "conv":
-                inv = x
-                eq = equiv
-                for conv, bn in self.heads_NN[ihead][b]:
-                    inv, eq = conv(inv, eq, batch, train)
-                    inv = act(bn(inv, batch.node_mask, train))
-                outs.append(inv)
-            else:
-                outs.append(self.heads_NN[ihead][b](x, batch))
+        if nh.nn_type == "conv":
+            stacked = self.heads_NN[ihead](x, equiv, batch, train)  # [B, N, d]
+        else:
+            stacked = self.heads_NN[ihead](x, batch)  # [B, N, d]
         if cfg.num_branches == 1:
-            return outs[0]
-        stacked = jnp.stack(outs, axis=0)  # [B, N, d]
+            return stacked[0]
         node_ds = batch.dataset_id[batch.node_graph]
         return jnp.take_along_axis(
             stacked, node_ds[None, :, None].astype(jnp.int32), axis=0

@@ -1,0 +1,448 @@
+"""Branch-parallel decoders: shard decoder params/compute over the mesh's
+``branch`` axis.
+
+The reference's ``MultiTaskModelMP`` deletes the branches a rank does not own
+and DDPs each decoder over its branch's process subgroup
+(hydragnn/models/MultiTaskModelMP.py:203-230): decoder memory and FLOPs per
+device stay constant as branches grow, while the shared encoder synchronizes
+globally. The TPU-native equivalent built here:
+
+- ``HydraModel`` decoders are *branch banks* (models/base.py `_branch_bank`):
+  every decoder parameter (and running-stat) leaf carries a leading
+  ``[num_branches]`` axis;
+- those leaves are sharded ``P('branch')`` over the mesh, so a device stores
+  only ``num_branches / branch_axis_size`` branch slices;
+- inside the ``shard_map`` step each device applies a *local* model built for
+  its ``B_local`` branch slice on data routed to its branch block
+  (``BranchRoutedLoader``), so decoder FLOPs per device are independent of
+  the total branch count;
+- encoder gradients ``pmean`` over the whole mesh (DDP analog), decoder
+  gradients ``pmean`` over the ``data`` axis only (the reference's per-branch
+  DDP subgroup) — each branch's decoder trains on the mean loss of *its*
+  dataset, exactly the reference's semantics (which differ from the dense
+  masked decode by a per-branch normalization factor).
+
+MACE's per-layer readouts use separately named branch modules and are not
+bank-stacked; branch-parallel execution currently requires ``HydraModel``
+(every conv type except MACE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..models.base import HydraModel
+from ..train.loss import compute_loss
+from ..train.state import TrainState
+from .mesh import BRANCH_AXIS, DATA_AXIS
+
+_BOTH = (BRANCH_AXIS, DATA_AXIS)
+
+# top-level variable-collection keys holding branch-banked decoder leaves
+# (models/base.py setup: self.graph_shared, self.heads_NN list)
+_DECODER_PREFIXES = ("graph_shared", "heads_NN")
+
+
+def _is_decoder_key(top_key: str) -> bool:
+    return any(top_key.startswith(p) for p in _DECODER_PREFIXES)
+
+
+def branch_specs(tree, branched=P(BRANCH_AXIS), replicated=P()):
+    """PartitionSpec pytree for a params/batch_stats collection: decoder-bank
+    subtrees get ``branched`` (leading [B] axis over the branch mesh axis),
+    everything else ``replicated``."""
+    if not isinstance(tree, dict):
+        return jax.tree_util.tree_map(lambda _: replicated, tree)
+    return {
+        k: jax.tree_util.tree_map(
+            lambda _: branched if _is_decoder_key(k) else replicated, v
+        )
+        for k, v in tree.items()
+    }
+
+
+def _path_branch_specs(tree, num_branches: int):
+    """Per-leaf PartitionSpec for an ARBITRARY pytree (optimizer state
+    included): a leaf whose path passes through a decoder-bank dict key and
+    whose leading dim equals ``num_branches`` gets P('branch'). Optax moment
+    trees mirror the param structure, so the decoder param paths appear as
+    sub-paths inside e.g. ScaleByAdamState.mu."""
+
+    def spec_of(path, leaf):
+        on_decoder = any(
+            isinstance(p, jax.tree_util.DictKey) and _is_decoder_key(str(p.key))
+            for p in path
+        )
+        if (
+            on_decoder
+            and getattr(leaf, "ndim", 0) >= 1
+            and leaf.shape[0] == num_branches
+        ):
+            return P(BRANCH_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_of, tree)
+
+
+def place_branch_state(state: TrainState, tx, mesh: Mesh) -> TrainState:
+    """Place a TrainState for branch-parallel training: decoder param/stat
+    leaves (and the matching optimizer-moment leaves — preserved, NOT
+    re-initialized, so ``Training.continue`` resumes with its restored Adam
+    moments) sharded over ``branch``; everything else replicated."""
+    del tx  # kept for API stability; moments are placed, not re-created
+    num_branches = _bank_size(state.params)
+
+    def put(tree):
+        specs = _path_branch_specs(tree, num_branches)
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+        )
+
+    return state.replace(
+        params=put(state.params),
+        batch_stats=put(state.batch_stats),
+        opt_state=put(state.opt_state),
+    )
+
+
+def _bank_size(params) -> int:
+    """num_branches, read off a decoder-bank leaf's leading axis."""
+    for k, sub in params.items():
+        if _is_decoder_key(k):
+            return int(jax.tree_util.tree_leaves(sub)[0].shape[0])
+    raise ValueError("no decoder bank (graph_shared/heads_NN) in params")
+
+
+def _local_model(model: HydraModel, b_local: int) -> HydraModel:
+    if not isinstance(model, HydraModel):
+        raise ValueError(
+            "branch-parallel execution requires HydraModel (bank-stacked "
+            "decoders); MACE readouts are not branch-banked"
+        )
+    cfg = dataclasses.replace(model.cfg, num_branches=b_local)
+    return type(model)(cfg=cfg)
+
+
+def make_branch_parallel_train_step(
+    model: HydraModel,
+    tx,
+    mesh: Mesh,
+    compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
+):
+    """Jitted (state, stacked_batch, rng) -> (state, loss, tasks): DP over
+    ``data`` x decoder-sharded ``branch``. The stacked batch must be
+    branch-routed (``BranchRoutedLoader``): shard row r carries graphs of
+    branch ``r // data_axis_size`` only."""
+    cfg = model.cfg
+    bsize = mesh.shape[BRANCH_AXIS]
+    assert cfg.num_branches % bsize == 0, (
+        f"num_branches {cfg.num_branches} not divisible by branch axis {bsize}"
+    )
+    b_local = cfg.num_branches // bsize
+    local = _local_model(model, b_local)
+    lcfg = local.cfg
+
+    def per_device_loss(params, batch_stats, batch, rng):
+        if mixed_precision:
+            from ..train.loop import mp_cast, mp_restore_stats
+
+            params, batch = mp_cast(params, batch, compute_grad_energy)
+        variables = {"params": params, "batch_stats": batch_stats}
+        tot, tasks, mutated, _ = compute_loss(
+            local, variables, batch, lcfg, True, rng, compute_grad_energy
+        )
+        if mixed_precision:
+            mutated = mp_restore_stats(mutated)
+        return tot.astype(jnp.float32), (tasks, mutated)
+
+    if cfg.conv_checkpointing:
+        per_device_loss = jax.checkpoint(per_device_loss)
+
+    def _mixed_pmean(tree, scale_enc, scale_dec):
+        """pmean with decoder subtrees reduced over data only (per-branch
+        mean), encoder subtrees over the whole mesh (global mean)."""
+        out = {}
+        for k, v in tree.items():
+            if _is_decoder_key(k):
+                out[k] = jax.lax.pmean(
+                    jax.tree_util.tree_map(lambda g: g * scale_dec, v),
+                    DATA_AXIS,
+                )
+            else:
+                out[k] = jax.lax.pmean(
+                    jax.tree_util.tree_map(lambda g: g * scale_enc, v), _BOTH
+                )
+        return out
+
+    def sharded_grads(params, batch_stats, batch, rng):
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        # graphs arrive with GLOBAL dataset ids; remap to this device's
+        # local branch-slice index (padding rows clip harmlessly — their
+        # loss terms are masked out)
+        br = jax.lax.axis_index(BRANCH_AXIS)
+        local_ds = jnp.clip(
+            batch.dataset_id.astype(jnp.int32) - br * b_local, 0, b_local - 1
+        )
+        batch = batch.replace(dataset_id=local_ds)
+        (tot, (tasks, mutated)), grads = jax.value_and_grad(
+            per_device_loss, has_aux=True
+        )(params, batch_stats, batch, rng)
+        n = jnp.sum(batch.graph_mask.astype(jnp.float32))
+        # encoder: weighted mean over every shard (DDP analog)
+        n_tot = jax.lax.psum(n, _BOTH)
+        scale_enc = n * mesh.size / jnp.maximum(n_tot, 1.0)
+        # decoder: weighted mean over this branch block's data shards only
+        # (the reference's per-branch DDP subgroup, MultiTaskModelMP.py:230)
+        n_branch = jax.lax.psum(n, DATA_AXIS)
+        scale_dec = n * mesh.shape[DATA_AXIS] / jnp.maximum(n_branch, 1.0)
+        grads = _mixed_pmean(grads, scale_enc, scale_dec)
+        tot = jax.lax.pmean(tot * scale_enc, _BOTH)
+        tasks = jax.lax.pmean(
+            jax.tree_util.tree_map(lambda t: t * scale_enc, tasks), _BOTH
+        )
+        stats = mutated.get("batch_stats", batch_stats)
+        new_stats = _mixed_pmean(stats, scale_enc, scale_dec)
+        return grads, tot, tasks, new_stats
+
+    rep = P()
+
+    def _specs_like(tree):
+        return branch_specs(tree)
+
+    def step(state: TrainState, batch, rng):
+        grad_map = shard_map(
+            sharded_grads,
+            mesh=mesh,
+            in_specs=(
+                _specs_like(state.params),
+                _specs_like(state.batch_stats),
+                P(_BOTH),
+                rep,
+            ),
+            out_specs=(
+                _specs_like(state.params),
+                rep,
+                rep,
+                _specs_like(state.batch_stats),
+            ),
+            check_vma=False,
+        )
+        grads, tot, tasks, new_stats = grad_map(
+            state.params, state.batch_stats, batch, rng
+        )
+        # optimizer update under the outer jit: decoder grads/moments stay
+        # branch-sharded by propagation, encoder leaves replicated
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                params=params,
+                opt_state=opt_state,
+                batch_stats=new_stats,
+                step=state.step + 1,
+            ),
+            tot,
+            tasks,
+        )
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_branch_parallel_eval_step(
+    model: HydraModel,
+    mesh: Mesh,
+    compute_grad_energy: bool = False,
+    mixed_precision: bool = False,
+):
+    cfg = model.cfg
+    bsize = mesh.shape[BRANCH_AXIS]
+    b_local = cfg.num_branches // bsize
+    local = _local_model(model, b_local)
+    lcfg = local.cfg
+
+    def sharded_eval(params, batch_stats, batch):
+        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
+        br = jax.lax.axis_index(BRANCH_AXIS)
+        local_ds = jnp.clip(
+            batch.dataset_id.astype(jnp.int32) - br * b_local, 0, b_local - 1
+        )
+        batch = batch.replace(dataset_id=local_ds)
+        variables = {"params": params, "batch_stats": batch_stats}
+        if mixed_precision:
+            from ..train.loop import mp_cast_eval
+
+            variables, batch = mp_cast_eval(
+                variables, batch, compute_grad_energy
+            )
+        tot, tasks, _, _ = compute_loss(
+            local, variables, batch, lcfg, False, None, compute_grad_energy
+        )
+        n = jnp.sum(batch.graph_mask.astype(jnp.float32))
+        n_tot = jax.lax.psum(n, _BOTH)
+        scale = n * mesh.size / jnp.maximum(n_tot, 1.0)
+        tot = jax.lax.pmean(tot * scale, _BOTH)
+        tasks = jax.lax.pmean(
+            jax.tree_util.tree_map(lambda t: t * scale, tasks), _BOTH
+        )
+        return tot, tasks
+
+    rep = P()
+
+    def evalf(state: TrainState, batch):
+        mapped = shard_map(
+            sharded_eval,
+            mesh=mesh,
+            in_specs=(
+                branch_specs(state.params),
+                branch_specs(state.batch_stats),
+                P(_BOTH),
+            ),
+            out_specs=(rep, rep),
+            check_vma=False,
+        )
+        return mapped(state.params, state.batch_stats, batch)
+
+    return jax.jit(evalf)
+
+
+class BranchRoutedLoader:
+    """Stacked-batch loader whose shard rows are grouped by branch block.
+
+    Wraps one ``GraphLoader`` per branch (each over that branch's graphs,
+    with ``rows = num_shards / branch_count`` device rows) and concatenates
+    their stacked batches in branch-major order — matching the (branch,
+    data) mesh flattening, so shard row ``r`` lands on mesh position
+    ``(r // data_size, r % data_size)``. The per-branch loaders share one
+    worst-case PadSpec so rows stack into one array.
+
+    The analog of the reference's per-branch datasets + uneven process
+    groups (examples/multibranch/train.py:166-213).
+
+    Batches are always full (``drop_last``) so every host steps in lockstep:
+    up to ``batch_size-1`` tail graphs per branch are excluded per epoch —
+    for eval loaders this slightly truncates the metric sample, the same
+    trade the reference's DistributedSampler makes.
+    """
+
+    def __init__(
+        self,
+        graphs: Sequence,
+        batch_size: int,
+        branch_count: int,
+        num_shards: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        sort_edges: bool = False,
+        oversampling: bool = True,
+        host_count: int = 1,
+        host_index: int = 0,
+    ):
+        """``num_shards``/``batch_size`` are per-host (local rows / local
+        graphs per step). Globally there are ``host_count * num_shards``
+        rows; row ``g`` serves branch ``g // (global_rows/branch_count)``,
+        so one host may serve several branches (many local rows per branch)
+        or one branch may span several hosts (the sub-loader then shards its
+        branch's graphs across exactly those hosts)."""
+        from ..data.graph import SpecLadder
+        from ..data.pipeline import GraphLoader
+
+        L = num_shards
+        G = host_count * L
+        assert G % branch_count == 0, (
+            f"{G} global rows not divisible by {branch_count} branches"
+        )
+        R = G // branch_count  # global rows per branch
+        # a host's rows must not straddle a branch boundary: either whole
+        # branches fit in a host (L % R == 0) or whole hosts fit in a branch
+        # (R % L == 0) — otherwise per-host shards would overlap and step
+        # counts diverge (deadlock in the collective train step)
+        assert (R >= L and R % L == 0) or (R < L and L % R == 0), (
+            f"branch rows R={R} and host rows L={L} misaligned: "
+            f"host_count*local_devices ({G}) must tile branch_count "
+            f"({branch_count}) without a host straddling a branch boundary"
+        )
+        ids = sorted({g.dataset_id for g in graphs})
+        assert len(ids) == branch_count, (
+            f"dataset ids {ids} != branch_count {branch_count}"
+        )
+        # branch of each of this host's local rows (branch-major global order)
+        row_branch = [(host_index * L + r) // R for r in range(L)]
+        served = sorted(set(row_branch))
+        by_branch = {i: [g for g in graphs if g.dataset_id == i] for i in ids}
+        n_max = max(len(b) for b in by_branch.values())
+        # one shared worst-case spec so all branch rows stack; per-shard
+        # graph count is identical for every row by construction
+        assert batch_size % L == 0
+        per_row_bs = batch_size // L
+        ladder = SpecLadder.for_dataset(
+            list(graphs), max(per_row_bs, 1), num_buckets=1
+        )
+        spec = ladder.specs[-1]
+        self.loaders: List = []
+        for b in served:
+            rows_b = row_branch.count(b)  # local rows serving branch b
+            hosts_b = max(R // rows_b, 1)  # hosts sharing branch b
+            # this host's rank within branch b's host group
+            first_global_row = b * R
+            host_rank_b = (host_index * L - first_global_row) // L if hosts_b > 1 else 0
+            bgraphs = by_branch[ids[b]]
+            over = oversampling and len(bgraphs) < n_max
+            self.loaders.append(
+                GraphLoader(
+                    bgraphs,
+                    per_row_bs * rows_b,
+                    shuffle=shuffle,
+                    seed=seed + 17 * b,
+                    num_shards=rows_b,
+                    spec=spec,
+                    sort_edges=sort_edges,
+                    oversampling=over,
+                    num_samples=n_max if over else None,
+                    drop_last=True,
+                    host_count=hosts_b,
+                    host_index=host_rank_b,
+                )
+            )
+        self.graphs = list(graphs)
+        self.batch_size = batch_size
+        self.num_shards = L
+        self.host_count = host_count
+        self.host_index = host_index
+        self.sort_edges = sort_edges
+        self.spec = spec
+        # GLOBALLY agreed step count: every host computes the same min over
+        # ALL branches (not just the ones it serves) from the full graph
+        # list — hosts serving different branches would otherwise disagree
+        # on epoch length and deadlock in the collective step
+        steps = []
+        for b in range(branch_count):
+            nb = len(by_branch[ids[b]])
+            rows_srv = min(R, L)
+            hosts_b = max(R // rows_srv, 1)
+            n_eff = n_max if (oversampling and nb < n_max) else nb
+            steps.append((n_eff // hosts_b) // (per_row_bs * rows_srv))
+        self._len = min(steps)
+
+    def set_epoch(self, epoch: int) -> None:
+        for l in self.loaders:
+            l.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self) -> Iterator:
+        its = [iter(l) for l in self.loaders]
+        for _ in range(len(self)):
+            rows = [next(it) for it in its]
+            yield jax.tree_util.tree_map(
+                lambda *xs: np.concatenate(xs, axis=0), *rows
+            )

@@ -123,6 +123,30 @@ def shard_optimizer_state(state, mesh: Mesh, min_size: int = 1024):
     return jax.tree_util.tree_map(place, state)
 
 
+def materialize_replicated(tree):
+    """Host-local numpy copy of a (possibly sharded) global-state pytree.
+
+    Sharded leaves (ZeRO-1 moments, branch-parallel decoder banks) are
+    re-replicated with a jitted identity first — fetching them directly
+    would fail because they span non-addressable devices. COLLECTIVE on
+    multi-host runs: every process must call it, in the same tree order.
+    """
+
+    def loc(x):
+        if (
+            isinstance(x, jax.Array)
+            and hasattr(x, "sharding")
+            and not x.sharding.is_fully_replicated
+        ):
+            # eager resharding device_put: no per-leaf trace/compile (a
+            # jitted identity here would recompile for every leaf shape at
+            # every checkpoint save)
+            x = jax.device_put(x, NamedSharding(x.sharding.mesh, P()))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(loc, tree)
+
+
 def _scheduler_host_info() -> Tuple[int, int]:
     """(host_count, host_index) from scheduler envs only — safe before the
     XLA backend exists (the reference parses the same envs, SLURM/OMPI,

@@ -29,12 +29,16 @@ def save_model(
     """Serialize state; per-epoch filename + 'latest' pointer file
     (reference: model.py:63-106, HYDRAGNN_EPOCH env drives per-epoch names).
 
-    Rank-gated: on multi-host runs only process 0 writes — every process
-    holds identical replicated state, and concurrent writers on a shared
-    filesystem would corrupt the file (reference: rank-0 save, model.py:63-75).
+    Rank-gated: on multi-host runs only process 0 writes — but sharded
+    leaves (ZeRO-1 moments, branch-parallel decoder banks) are first
+    gathered COLLECTIVELY by every process, so all ranks must call this
+    (reference: rank-0 save, model.py:63-75).
     """
     import jax
 
+    from ..parallel.mesh import materialize_replicated
+
+    state = materialize_replicated(state)
     if jax.process_index() != 0:
         return ""
     if epoch is None:

@@ -197,3 +197,178 @@ def pytest_dp_energy_force_training():
     va_loss, va_tasks = evalf(state, next(iter(val_loader)))
     assert np.isfinite(float(va_loss))
     assert "forces" in va_tasks
+
+
+def pytest_zero_composes_with_parallel_step():
+    """ZeRO-1 sharded optimizer state must ride through the shard_map DP
+    step in ONE jitted program (VERDICT r2 item 5): the update runs under
+    the outer jit, XLA partitions it by the moments' P(data) sharding, and
+    params stay replicated. Asserts training progresses, moments STAY
+    sharded across steps, and the per-device moment footprint is 1/8th."""
+    mesh = make_mesh()
+    config, loader, _ = _setup(num_shards=8)
+    model = create_model(config)
+    sample = next(iter(loader))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = replicate_state(TrainState.create(variables, tx), mesh)
+    state = state.replace(
+        opt_state=shard_optimizer_state(state.opt_state, mesh, min_size=8)
+    )
+    step = make_parallel_train_step(model, tx, mesh)
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for epoch in range(4):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            rng, sub = jax.random.split(rng)
+            state, tot, _ = step(state, batch, sub)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0], f"ZeRO step did not converge: {losses}"
+    # params replicated on all devices
+    p_leaf = jax.tree_util.tree_leaves(state.params)[0]
+    assert len(p_leaf.sharding.device_set) == 8
+    # moment leaves still sharded after N steps: the per-device (addressable)
+    # shard holds 1/8th of the elements == the ZeRO memory saving
+    sharded_leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+        if hasattr(leaf, "sharding")
+        and not leaf.sharding.is_fully_replicated
+    ]
+    assert sharded_leaves, "no optimizer leaf remained ZeRO-sharded"
+    for leaf in sharded_leaves:
+        shard = leaf.addressable_shards[0].data
+        assert shard.size * 8 == leaf.size
+
+
+def _setup_multibranch(branch_count=2):
+    """Two synthetic 'datasets' (dataset_id 0/1) on one 2-branch model."""
+    import dataclasses
+
+    raw = deterministic_graph_dataset(96, seed=11)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    ready = [
+        dataclasses.replace(g, dataset_id=i % branch_count)
+        for i, g in enumerate(ready)
+    ]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    gh = {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 8,
+        "num_headlayers": 2,
+        "dim_headlayers": [10, 10],
+    }
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": [
+                        {"type": f"branch-{b}", "architecture": dict(gh)}
+                        for b in range(branch_count)
+                    ]
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": 16,
+                "num_epoch": 2,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
+            },
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]}, "graph_features": {"dim": [1]}},
+    }
+    config = update_config(config, tr, va, te)
+    return config, tr, va
+
+
+def pytest_branch_parallel_decoders():
+    """Branch-parallel decoder sharding (VERDICT r2 item 4): decoder param
+    leaves are P('branch')-sharded so each device stores and computes only
+    its branch block's decoders; the loss matches the dense masked-decode
+    step on identical weights and data; training converges."""
+    from hydragnn_tpu.parallel.branch import (
+        BranchRoutedLoader,
+        make_branch_parallel_eval_step,
+        make_branch_parallel_train_step,
+        place_branch_state,
+    )
+
+    mesh = make_mesh(branch_size=2)  # (branch=2, data=4)
+    config, tr, va = _setup_multibranch()
+    model = create_model(config)
+    assert model.cfg.num_branches == 2
+    loader = BranchRoutedLoader(tr, batch_size=16, branch_count=2, num_shards=8)
+    batch = next(iter(loader))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], batch)
+    variables = init_model(model, one, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    # deep-copy: device_put can alias buffers, and both steps donate their
+    # state — without the copy, donating one would delete the other's leaves
+    v_copy = jax.tree_util.tree_map(np.array, variables)
+    state = place_branch_state(TrainState.create(v_copy, tx), tx, mesh)
+
+    # decoder leaves: per-device shard holds HALF the branch axis
+    for key in ("graph_shared", "heads_NN_0"):
+        for leaf in jax.tree_util.tree_leaves(state.params[key]):
+            assert not leaf.sharding.is_fully_replicated
+            shard = leaf.addressable_shards[0].data
+            assert shard.shape[0] * 2 == leaf.shape[0] == 2
+    # encoder leaves replicated
+    for leaf in jax.tree_util.tree_leaves(state.params["graph_convs_0"]):
+        assert leaf.sharding.is_fully_replicated
+
+    step = make_branch_parallel_train_step(model, tx, mesh)
+    evalf = make_branch_parallel_eval_step(model, mesh)
+
+    # loss parity vs the dense masked-decode DP step on identical weights
+    dense_state = replicate_state(TrainState.create(variables, tx), mesh)
+    dense_step = make_parallel_train_step(model, tx, mesh)
+    rng = jax.random.PRNGKey(0)
+    _, tot_dense, _ = dense_step(dense_state, batch, rng)
+    state2, tot_branch, _ = step(state, batch, rng)
+    np.testing.assert_allclose(
+        float(tot_branch), float(tot_dense), rtol=1e-5
+    )
+
+    # convergence + decoder leaves STAY sharded through donated steps
+    losses = []
+    state = state2
+    for epoch in range(6):
+        loader.set_epoch(epoch)
+        for b in loader:
+            rng, sub = jax.random.split(rng)
+            state, tot, _ = step(state, b, sub)
+        losses.append(float(tot))
+    assert losses[-1] < losses[0], f"branch-parallel did not converge: {losses}"
+    for leaf in jax.tree_util.tree_leaves(state.params["heads_NN_0"]):
+        assert not leaf.sharding.is_fully_replicated
+    va_tot, _ = evalf(state, batch)
+    assert np.isfinite(float(va_tot))
+
+
+def pytest_branch_routed_loader_routes_by_branch():
+    """Shard rows [0, D) carry branch-0 graphs only, rows [D, 2D) branch 1."""
+    from hydragnn_tpu.parallel.branch import BranchRoutedLoader
+
+    config, tr, va = _setup_multibranch()
+    loader = BranchRoutedLoader(tr, batch_size=16, branch_count=2, num_shards=8)
+    for batch in loader:
+        ds = np.asarray(batch.dataset_id)  # [8, G]
+        gm = np.asarray(batch.graph_mask)
+        for r in range(8):
+            want = 0 if r < 4 else 1
+            assert (ds[r][gm[r]] == want).all()
+        break
