@@ -161,6 +161,15 @@ def _bench_production():
         state, tot, _ = step(state, b, r)
     jax.block_until_ready(tot)
 
+    # BENCH_PROFILE=1: one xprof trace of a few steady-state steps into
+    # logs/bench_profile (drives the MFU work — find the top non-matmul op)
+    if os.getenv("BENCH_PROFILE", "0") == "1":
+        os.makedirs("logs/bench_profile", exist_ok=True)
+        with jax.profiler.trace("logs/bench_profile"):
+            for b, r in list(zip(batches, rngs))[:8]:
+                state, tot, _ = step(state, b, r)
+            jax.block_until_ready(tot)
+
     # several timed trials, best one reported: the remote-tunnel dispatch
     # path has occasional multi-hundred-ms stalls unrelated to the chip
     n_passes = int(os.getenv("BENCH_PASSES", "4"))

@@ -61,6 +61,11 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--branch_size", type=int, default=1)
+    ap.add_argument(
+        "--branch_parallel", action="store_true",
+        help="shard decoder banks over a (branch=2, data) mesh with "
+             "branch-routed loaders (parallel/branch.py)",
+    )
     ap.add_argument("--batch_size", type=int, default=32)
     ap.add_argument(
         "--branch_weights", default=None,
@@ -114,29 +119,54 @@ def main():
     config = update_config(config, tr, va, te)
 
     n_dev = len(jax.devices())
-    mesh = make_mesh(branch_size=args.branch_size)
-    sampling = {}
-    if args.branch_weights:
-        shares = [float(s) for s in args.branch_weights.split(",")]
-        sampling = dict(
-            oversampling=True,
-            sample_weights=branch_sample_weights(tr, dict(enumerate(shares))),
-        )
-    loader = GraphLoader(
-        tr, args.batch_size, seed=0, num_shards=n_dev, drop_last=True, **sampling
-    )
-    val_loader = GraphLoader(
-        va, args.batch_size, spec=loader.spec, shuffle=False, num_shards=n_dev
-    )
-
     model = create_model(config)
-    first = ensure_stacked(next(iter(loader)))
-    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], first)
-    variables = init_model(model, one)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
-    state = replicate_state(TrainState.create(variables, tx), mesh)
-    step = make_parallel_train_step(model, tx, mesh)
-    evalf = make_parallel_eval_step(model, mesh)
+    if args.branch_parallel:
+        # REAL decoder branch-parallelism (MultiTaskModelMP analog): decoder
+        # banks sharded P('branch'), data routed by branch, per-device
+        # decoder FLOPs independent of branch count (parallel/branch.py)
+        from hydragnn_tpu.parallel.branch import (
+            BranchRoutedLoader,
+            make_branch_parallel_eval_step,
+            make_branch_parallel_train_step,
+            place_branch_state,
+        )
+
+        mesh = make_mesh(branch_size=2)
+        loader = BranchRoutedLoader(
+            tr, args.batch_size, branch_count=2, num_shards=n_dev, seed=0
+        )
+        val_loader = BranchRoutedLoader(
+            va, args.batch_size, branch_count=2, num_shards=n_dev,
+            shuffle=False, oversampling=False,
+        )
+        first = next(iter(loader))
+        one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], first)
+        variables = init_model(model, one)
+        state = place_branch_state(TrainState.create(variables, tx), tx, mesh)
+        step = make_branch_parallel_train_step(model, tx, mesh)
+        evalf = make_branch_parallel_eval_step(model, mesh)
+    else:
+        mesh = make_mesh(branch_size=args.branch_size)
+        sampling = {}
+        if args.branch_weights:
+            shares = [float(s) for s in args.branch_weights.split(",")]
+            sampling = dict(
+                oversampling=True,
+                sample_weights=branch_sample_weights(tr, dict(enumerate(shares))),
+            )
+        loader = GraphLoader(
+            tr, args.batch_size, seed=0, num_shards=n_dev, drop_last=True, **sampling
+        )
+        val_loader = GraphLoader(
+            va, args.batch_size, spec=loader.spec, shuffle=False, num_shards=n_dev
+        )
+        first = ensure_stacked(next(iter(loader)))
+        one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], first)
+        variables = init_model(model, one)
+        state = replicate_state(TrainState.create(variables, tx), mesh)
+        step = make_parallel_train_step(model, tx, mesh)
+        evalf = make_parallel_eval_step(model, mesh)
 
     rng = jax.random.PRNGKey(0)
     for epoch in range(args.epochs):

@@ -186,6 +186,17 @@ def prepare_data(
 
     host_count, host_index = local_host_info()
     num_shards = jax.local_device_count() if jax.process_count() > 1 else 1
+    # single-host branch-parallel still needs stacked, branch-routed rows
+    from .models.create import num_branches_from
+
+    num_branches = num_branches_from(arch)
+    if (
+        bool(training.get("branch_parallel", False))
+        and num_branches > 1
+        and jax.process_count() == 1
+        and jax.local_device_count() > 1
+    ):
+        num_shards = jax.local_device_count()
     if batch_size % num_shards != 0:
         raise ValueError(
             f"Training.batch_size {batch_size} must be divisible by the "
@@ -224,18 +235,14 @@ def prepare_data(
         sample_weights = branch_sample_weights(
             trainset, {i: 1.0 for i in ids}
         )
-    num_branches = len(
-        arch["output_heads"].get("graph", [])
-        if isinstance(arch["output_heads"].get("graph"), list)
-        else []
-    )
     if (
         bool(training.get("branch_parallel", False))
         and num_branches > 1
         and num_shards > 1
     ):
         # branch-parallel decoders need branch-routed shard rows
-        # (parallel/branch.py BranchRoutedLoader)
+        # (parallel/branch.py BranchRoutedLoader); ONE worst-case spec over
+        # all splits so eval reuses the train step's compilation
         from .parallel.branch import BranchRoutedLoader
 
         route_kw = dict(
@@ -244,6 +251,7 @@ def prepare_data(
             host_count=host_count,
             host_index=host_index,
             sort_edges=shard_kw["sort_edges"],
+            spec=spec.specs[-1],
         )
         train_loader = BranchRoutedLoader(
             trainset, batch_size, seed=0, shuffle=True, **route_kw
@@ -327,7 +335,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     with Timer("create_model"):
         model = create_model(config)
         sample = next(iter(train_loader))
-        if multihost:
+        if getattr(train_loader, "num_shards", 1) > 1:
             # loader emits stacked [local_shards, ...] batches: init on one
             sample = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
         variables = init_model(model, sample, seed=run_seed)
@@ -356,7 +364,12 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # partitions the update by the moments' sharding and all-gathers the
     # resulting param updates (parallel/dp.py).
     use_zero = training["Optimizer"].get("use_zero_redundancy", False)
-    if use_zero and not multihost and len(jax.devices()) > 1:
+    if (
+        use_zero
+        and not multihost
+        and not training.get("branch_parallel", False)
+        and len(jax.devices()) > 1
+    ):
         from .parallel import make_mesh, replicate_state, shard_optimizer_state
 
         mesh = make_mesh()
@@ -365,11 +378,28 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             opt_state=shard_optimizer_state(state.opt_state, mesh)
         )
 
-    # multi-host DP: shard_map the step over the global (branch, data) mesh —
-    # gradients psum across hosts over ICI/DCN, each process feeding the
-    # shards its own host-sharded loader built (docs/MULTIHOST.md)
+    # mesh-step mode: multi-host DP (shard_map over the global (branch,
+    # data) mesh, grads psum over ICI/DCN) and/or branch-parallel decoders —
+    # single-host multi-device branch_parallel runs the same mesh steps
+    # (promote_batch no-ops with one process)
     step_fn = eval_fn = None
-    if multihost:
+    # branch-parallel decoders (Training.branch_parallel): decoder
+    # params/compute sharded over the mesh's branch axis, data routed by
+    # branch — the MultiTaskModelMP analog (parallel/branch.py). The
+    # predicate must MATCH prepare_data's loader-routing gate exactly:
+    # a branch step on unrouted batches computes garbage.
+    branch_parallel = bool(training.get("branch_parallel", False))
+    if branch_parallel and (
+        getattr(model.cfg, "num_branches", 1) < 2
+        or jax.local_device_count() < 2
+    ):
+        raise ValueError(
+            "Training.branch_parallel requires a multibranch model "
+            f"(num_branches={getattr(model.cfg, 'num_branches', 1)}) and "
+            f">=2 local devices (have {jax.local_device_count()}): "
+            "prepare_data could not build branch-routed loaders"
+        )
+    if multihost or branch_parallel:
         from .parallel import (
             make_mesh,
             promote_batch,
@@ -383,22 +413,6 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
 
         cge = training.get("compute_grad_energy", False)
         mp = training.get("mixed_precision", False)
-        # branch-parallel decoders (Training.branch_parallel): decoder
-        # params/compute sharded over the mesh's branch axis, data routed by
-        # branch — the MultiTaskModelMP analog (parallel/branch.py). The
-        # predicate must MATCH prepare_data's loader-routing gate exactly:
-        # a branch step on unrouted batches computes garbage.
-        branch_parallel = bool(training.get("branch_parallel", False))
-        if branch_parallel and (
-            getattr(model.cfg, "num_branches", 1) < 2
-            or jax.local_device_count() < 2
-        ):
-            raise ValueError(
-                "Training.branch_parallel requires a multibranch model "
-                f"(num_branches={getattr(model.cfg, 'num_branches', 1)}) and "
-                f">=2 local devices (have {jax.local_device_count()}): "
-                "prepare_data could not build branch-routed loaders"
-            )
         if branch_parallel:
             from .parallel.branch import (
                 make_branch_parallel_eval_step,
@@ -467,17 +481,22 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # Gate on the loop's cross-host AGREED decision, not the local SIGTERM
     # flag: under orbax the save is a collective, and skewed signal delivery
     # would otherwise hang the non-preempted hosts in it.
+    from .parallel.mesh import materialize_replicated
     from .utils import preemption
 
-    if not preemption.global_stop_noted():
-        final_epoch = len(hist["train"]) - 1
+    do_final_save = not preemption.global_stop_noted()
+    final_epoch = len(hist["train"]) - 1
+    orbax_backend = training.get("checkpoint_backend", "msgpack") == "orbax"
+    if multihost and not orbax_backend:
+        # localize BEFORE the msgpack save: save_model gathers sharded
+        # leaves anyway (checkpoint.py), so gathering once here serves both
+        # the save and the downstream consumers (prediction, plotting)
+        state = materialize_replicated(state)
+    if do_final_save:
         save_fn(state, final_epoch if final_epoch >= 0 else None)
-    if multihost:
-        # localize the global-mesh state so downstream consumers
-        # (single-host prediction, plotting) see host arrays; sharded
-        # leaves (ZeRO-1 moments, branch decoder banks) gather collectively
-        from .parallel.mesh import materialize_replicated
-
+    if multihost and orbax_backend:
+        # orbax writes shard-parallel — save the SHARDED state first, then
+        # localize for downstream consumers
         state = materialize_replicated(state)
     if config.get("Visualization", {}).get("create_plots") and jax.process_index() == 0:
         # parity/error/history plots (reference: train_validate_test.py:100-126,

@@ -217,7 +217,11 @@ def update_config(
         arch.setdefault(key, None)
 
     # ---- edge dim (reference: update_config_edge_dim, config_utils.py:190-216)
-    edge_models = ("PNAPlus", "PNAEq", "PAINN", "GPS", "CGCNN", "SchNet", "EGNN", "DimeNet", "MACE")
+    # (reference: config_utils.py:190-192 — GAT/PNA included)
+    edge_models = (
+        "GAT", "PNA", "PNAPlus", "PNAEq", "PAINN", "GPS",
+        "CGCNN", "SchNet", "EGNN", "DimeNet", "MACE",
+    )
     from ..data.transforms import descriptor_edge_dim
 
     _edge_dim = descriptor_edge_dim(config.get("Dataset", {}))

@@ -15,8 +15,13 @@ implemented directly:
    element's neutral valence.
 
 Covers the organic set (H C N O F Si P S Cl Br I) the reference's pipeline
-targets; it does not enumerate resonance structures. Output converts to a
-framework ``Graph`` with the bond order as the edge attribute.
+targets, including resonance-structure enumeration
+(``resonance_structures``: all maximal bond-order assignments, filtered by
+the minimal-|formal-charge| valence criterion — benzene yields its Kekulé
+pair) and charged-fragment resolution (a declared net charge is matched
+against the enumeration, the reference's ``charged_fragments=True``).
+Output converts to a framework ``Graph`` with the bond order as the edge
+attribute.
 """
 
 from __future__ import annotations
@@ -95,6 +100,104 @@ def connectivity(
     return pairs
 
 
+def _formal_charges(z: np.ndarray, order: dict) -> np.ndarray:
+    """Formal charge per atom for a bond-order assignment: deviation from
+    the closest permitted valence (under-saturated O -> -1, four-bonded
+    N -> +1, saturated atoms -> 0)."""
+    formal = np.zeros(z.shape[0], np.int64)
+    bo = np.zeros(z.shape[0], np.int64)
+    for (a, b), o in order.items():
+        bo[a] += o
+        bo[b] += o
+    for i in range(z.shape[0]):
+        if int(z[i]) in _VALENCES:
+            allowed = _VALENCES[int(z[i])]
+            best = min(allowed, key=lambda v: abs(v - int(bo[i])))
+            formal[i] = int(bo[i]) - best
+    return formal
+
+
+def enumerate_bond_orders(
+    z: np.ndarray,
+    skeleton: List[Tuple[int, int]],
+    max_structures: int = 64,
+) -> List[dict]:
+    """All distinct MAXIMAL integer bond-order assignments over a bond
+    skeleton — the resonance-structure enumeration of the reference's
+    vendored xyz2mol (its BO-matrix search over unsaturated-atom
+    combinations, hydragnn/utils/descriptors_and_embeddings/
+    xyz2mol.py:1-1007). DFS over promotion choices with memoized states;
+    ``max_structures`` bounds the (worst-case exponential) walk — aromatic
+    rings yield their Kekulé alternatives well within it."""
+    base = {tuple(p): 1 for p in skeleton}
+    caps = {i: max(_VALENCES.get(int(zz), (4,))) for i, zz in enumerate(z)}
+
+    def bo_sums(order):
+        s = {i: 0 for i in range(z.shape[0])}
+        for (a, b), o in order.items():
+            s[a] += o
+            s[b] += o
+        return s
+
+    results: List[dict] = []
+    seen_terminal = set()
+    seen_states = set()
+    stack = [base]
+    while stack and len(results) < max_structures:
+        order = stack.pop()
+        key = tuple(sorted(order.items()))
+        if key in seen_states:
+            continue
+        seen_states.add(key)
+        s = bo_sums(order)
+        cands = [
+            p
+            for p, o in order.items()
+            if o < 3 and caps[p[0]] - s[p[0]] > 0 and caps[p[1]] - s[p[1]] > 0
+        ]
+        if not cands:
+            if key not in seen_terminal:
+                seen_terminal.add(key)
+                results.append(dict(order))
+            continue
+        for p in cands:
+            nxt = dict(order)
+            nxt[p] += 1
+            stack.append(nxt)
+    return results
+
+
+def resonance_structures(
+    z: Sequence[int],
+    pos: np.ndarray,
+    tolerance: float = 1.3,
+    max_structures: int = 64,
+) -> List[Molecule]:
+    """Every distinct maximal bond-order assignment as a Molecule (the
+    reference returns one rdkit mol per resonance structure). The DFS also
+    reaches stuck assignments (promotions alternated such that leftover
+    free valences are non-adjacent); like the reference's BO_is_OK valence
+    filter, only assignments with the minimal total |formal charge| are
+    kept — for benzene that is exactly the Kekulé pair."""
+    z = np.asarray(z, np.int64)
+    pos = np.asarray(pos, np.float64)
+    skeleton = connectivity(z, pos, tolerance)
+    scored = []
+    for order in enumerate_bond_orders(z, skeleton, max_structures):
+        formal = _formal_charges(z, order)
+        scored.append((int(np.abs(formal).sum()), order, formal))
+    if not scored:
+        return []
+    best = min(s for s, _, _ in scored)
+    mols = []
+    for s, order, formal in scored:
+        if s != best:
+            continue
+        bonds = sorted((a, b, o) for (a, b), o in order.items())
+        mols.append(Molecule(z=z, pos=pos, bonds=bonds, formal_charges=formal))
+    return mols
+
+
 def perceive_molecule(
     z: Sequence[int],
     pos: np.ndarray,
@@ -153,12 +256,20 @@ def perceive_molecule(
             best = min(allowed(i), key=lambda v: abs(v - s))
             formal[i] = s - best
     if charge is not None and int(formal.sum()) != charge:
-        # a declared total charge (including an explicit 0) is checked; the
-        # default None skips the check for chargeless use
+        # charged-fragment resolution (reference: xyz2mol
+        # charged_fragments=True): search the resonance enumeration for an
+        # assignment whose formal charges sum to the declared total
+        for alt in enumerate_bond_orders(z, skeleton):
+            alt_formal = _formal_charges(z, alt)
+            if int(alt_formal.sum()) == charge:
+                bonds = sorted((a, b, o) for (a, b), o in alt.items())
+                return Molecule(
+                    z=z, pos=pos, bonds=bonds, formal_charges=alt_formal
+                )
         raise ValueError(
             f"perceived total formal charge {int(formal.sum())} != declared "
-            f"charge {charge}; geometry may be mis-bonded at tolerance="
-            f"{tolerance}"
+            f"charge {charge} in any resonance structure; geometry may be "
+            f"mis-bonded at tolerance={tolerance}"
         )
     bonds = sorted((a, b, o) for (a, b), o in order.items())
     return Molecule(z=z, pos=pos, bonds=bonds, formal_charges=formal)

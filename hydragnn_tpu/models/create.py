@@ -50,6 +50,13 @@ def normalize_output_heads(heads: Dict[str, Any]) -> Dict[str, List[Dict[str, An
     return out
 
 
+def num_branches_from(arch: Dict[str, Any]) -> int:
+    """Branch count as the model factory derives it (list-form graph heads;
+    single source of truth for loader routing and model construction)."""
+    heads = normalize_output_heads(arch.get("output_heads", {}))
+    return len(heads["graph"]) if "graph" in heads else 1
+
+
 def model_config_from(config: Dict[str, Any]) -> ModelConfig:
     """Build the frozen ModelConfig from a *completed* config dict
     (i.e. after ``hydragnn_tpu.config.update_config``)."""

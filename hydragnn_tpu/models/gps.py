@@ -110,6 +110,71 @@ class MultiheadSelfAttention(nn.Module):
         return nn.Dense(C)(out)
 
 
+class RingSelfAttention(nn.Module):
+    """Global attention for ONE graph spanning the device mesh
+    (``global_attn_type: "ring"``): exact softmax attention with K/V blocks
+    ring-rotated over the SP mesh axis (parallel/ring_attention.py), so the
+    [N, N] score matrix never materializes on any one chip — node counts are
+    bounded by total-mesh HBM, not one chip's (the reference's dense
+    per-graph attention requires the whole graph on one device,
+    hydragnn/globalAtt/gps.py:125-141).
+
+    Inside a ``parallel.sp.sp_context`` the node axis is sharded and the
+    ring runs over ICI; outside one it falls back to the SAME math computed
+    densely (one device), so a checkpoint moves freely between modes.
+    Restriction: attention spans every real node in the batch (no per-graph
+    block mask) — the batch must hold a single real graph, the SP regime.
+    """
+
+    channels: int
+    heads: int
+
+    @nn.compact
+    def __call__(self, x, batch: GraphBatch, train: bool = False):
+        from ..parallel.sp import current_sp
+
+        H, C = self.heads, self.channels
+        d = C // H
+        qkv = nn.Dense(3 * C)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(-1, H, d)
+        k = k.reshape(-1, H, d)
+        v = v.reshape(-1, H, d)
+        mesh, axis = current_sp()
+        if mesh is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.ring_attention import ring_self_attention
+
+            out = shard_map(
+                lambda q_, k_, v_, m_: ring_self_attention(
+                    q_, k_, v_, m_, axis_name=axis
+                ),
+                mesh=mesh,
+                in_specs=(P(axis), P(axis), P(axis), P(axis)),
+                out_specs=P(axis),
+                check_vma=False,
+            )(q, k, v, batch.node_mask)
+        else:
+            # dense fallback: same numbers as the ring (up to reassociation)
+            scale = 1.0 / jnp.sqrt(jnp.asarray(d, x.dtype))
+            logits = jnp.einsum("ihd,jhd->hij", q, k) * scale
+            logits = jnp.where(
+                batch.node_mask[None, None, :], logits, jnp.finfo(x.dtype).min
+            )
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("hij,jhd->ihd", probs, v)
+        # ring attention spans EVERY real node — correct only for a batch
+        # holding one real graph (the SP spanning-graph regime). A
+        # multi-graph batch would silently mix molecules, so poison the
+        # output and let the error surface as NaN loss (the house pattern
+        # for silent-wrong-number risks, cf. the Nmax overflow above).
+        multi = jnp.sum(batch.graph_mask.astype(jnp.int32)) > 1
+        out = jnp.where(multi, jnp.nan, out)
+        return nn.Dense(C)(out.reshape(-1, C))
+
+
 class PerformerSelfAttention(nn.Module):
     """Linear (Performer-style) attention per graph segment.
 
@@ -163,6 +228,8 @@ class GPSConv(nn.Module):
         # global attention + dropout + residual + norm2
         if self.attn_type == "performer":
             h = PerformerSelfAttention(self.channels, self.heads)(inv, batch, train)
+        elif self.attn_type == "ring":
+            h = RingSelfAttention(self.channels, self.heads)(inv, batch, train)
         elif self.attn_type == "multihead":
             h = MultiheadSelfAttention(
                 self.channels,

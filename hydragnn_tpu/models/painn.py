@@ -46,11 +46,21 @@ def painn_update(x, v, node_size, last_layer):
         jnp.concatenate([vv_norm, x], axis=-1)
     )
     inner = jnp.sum(uv * vv, axis=1)
+    # residual clamp: the scalar/vector PRODUCT streams can overflow f32
+    # when eval-mode batch-norm statistics are still stale (early epochs) —
+    # inf - inf then poisons everything downstream as NaN. The reference
+    # guards its own product stream the same way ("just in case it
+    # explodes", torch.clamp in SCFStack.py:248-250); 1e6 never activates
+    # in healthy training (values are O(10)).
+    _clamp = lambda t: jnp.clip(t, -1e6, 1e6)
     if last_layer:
         a_sv, a_ss = jnp.split(out, 2, axis=-1)
-        return x + a_sv * inner + a_ss, v
+        return x + _clamp(a_sv * inner + a_ss), v
     a_vv, a_sv, a_ss = jnp.split(out, 3, axis=-1)
-    return x + a_sv * inner + a_ss, v + a_vv[:, None, :] * uv
+    return (
+        x + _clamp(a_sv * inner + a_ss),
+        v + _clamp(a_vv[:, None, :] * uv),
+    )
 
 
 class PainnConv(nn.Module):

@@ -11,12 +11,13 @@ the MXU as a block-diagonal one-hot matmul:
   inner steps stream the edge windows that can touch its rows (degree-capped
   graphs bound edges-per-row-block by ``Nb * max_degree``), and the output
   block is revisited across K as a standard reduction accumulator;
-- the edge->local-row map is precomputed as an owner-encoded one-hot
-  ``oh[e, recv[e] % Nb] = owner(e) + 1`` so one streamed operand carries
-  both the scatter pattern and the this-block mask (exact float compares,
-  values < 2^24);
-- per step: ``acc[Nb, Cb] += onehot_masked.T @ msg_window`` — an
-  [Nb, Eb] x [Eb, Cb] MXU contraction instead of a scatter.
+- the raw receiver ids stream beside the messages (4 bytes/edge) and the
+  kernel builds the one-hot selector in-register with an iota compare
+  ``ids == j*Nb + iota(Nb)`` — nothing but the payload ever touches HBM
+  (an earlier revision materialized an [E, Nb] f32 one-hot operand: 128x
+  the bandwidth of the ids and an extra scatter to build it);
+- per step: ``acc[Nb, Cb] += onehot.T @ msg_window`` — an [Nb, Eb] x
+  [Eb, Cb] MXU contraction instead of a scatter.
 
 The backward pass of a segment sum is a gather, which XLA already does
 well, so the custom VJP uses ``dout[recv]`` directly.
@@ -32,16 +33,18 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _kernel(estart_ref, oh_ref, msg_ref, out_ref):
-    c, j, k = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    del c, k  # block selection happened in the index maps
+def _kernel(estart_ref, ids_ref, msg_ref, out_ref):
+    j = pl.program_id(1)
 
     @pl.when(pl.program_id(2) == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # owner-encoded one-hot: entries equal to j+1 belong to this row block
-    mine = (oh_ref[:] == (j + 1).astype(oh_ref.dtype)).astype(msg_ref.dtype)
+    # in-register one-hot: edge e belongs to local row r iff its receiver id
+    # equals j*Nb + r; padding edges carry id -1 and never match
+    nb = out_ref.shape[0]
+    rows = j * nb + jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1)
+    mine = (ids_ref[:] == rows).astype(msg_ref.dtype)  # [Eb, Nb]
     out_ref[:] += jax.lax.dot_general(
         mine,
         msg_ref[:],
@@ -78,10 +81,11 @@ def sorted_segment_sum(
     holding more than ``max_degree`` edges gets an UNSPECIFIED value (its
     trailing edges fall outside the K streamed windows). Real nodes of this
     framework's batches satisfy the cap (data/neighbors.py caps in-degree;
-    ``GraphLoader(sort_edges=True)`` sorts receivers) — but the final
-    *padding* node receives every padding edge and will exceed it: its slot
-    must be masked downstream, which every consumer of the dummy-node
-    convention already does (data/graph.py padding docs).
+    ``GraphLoader(sort_edges=True)`` sorts receivers; the loader validates
+    real in-degrees against the bound) — but the final *padding* node
+    receives every padding edge and will exceed it: its slot must be masked
+    downstream, which every consumer of the dummy-node convention already
+    does (data/graph.py padding docs).
     Messages are [E, C] float; returns [num_segments, C].
     """
     return _forward(
@@ -102,9 +106,7 @@ def _forward(
     ids = segment_ids.astype(jnp.int32)
     # messages stream in their own dtype (bf16 stays bf16 — half the HBM
     # traffic under mixed precision); the kernel's dot_general accumulates
-    # in f32 via preferred_element_type either way. The one-hot operand must
-    # stay f32: owner encodings are exact-compared and bf16's 8 mantissa
-    # bits would corrupt owners > 256.
+    # in f32 via preferred_element_type either way.
     msg = _pad_to(messages, eb, 0)
     msg = _pad_to(msg, cb, 1)
     n_pad = num_segments + (-num_segments) % nb
@@ -121,12 +123,9 @@ def _forward(
     msg = jnp.pad(msg, ((0, k_windows * eb), (0, 0)))
     e_pad = msg.shape[0]
 
-    # owner-encoded one-hot [E_pad, Nb]; padding edges stay all-zero so the
-    # (oh == j+1 >= 1) comparison never selects them
-    owner = ids // nb + 1
-    local = ids % nb
-    oh = jnp.zeros((e_pad, nb), jnp.float32)
-    oh = oh.at[jnp.arange(e), local].set(owner.astype(jnp.float32))
+    # receiver ids stream beside the messages; padding edges get id -1 so
+    # the in-kernel iota compare never selects them
+    ids_col = jnp.full((e_pad, 1), -1, jnp.int32).at[:e, 0].set(ids)
 
     # first edge-block index each row block may need (receivers sorted)
     j_blocks = n_pad // nb
@@ -138,7 +137,7 @@ def _forward(
     def msg_index(c_i, j, k, estart):
         return (estart[j] + k, c_i)
 
-    def oh_index(c_i, j, k, estart):
+    def ids_index(c_i, j, k, estart):
         return (estart[j] + k, 0)
 
     def out_index(c_i, j, k, estart):
@@ -151,14 +150,14 @@ def _forward(
             num_scalar_prefetch=1,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((eb, nb), oh_index),
+                pl.BlockSpec((eb, 1), ids_index),
                 pl.BlockSpec((eb, cb), msg_index),
             ],
             out_specs=pl.BlockSpec((nb, cb), out_index),
         ),
         out_shape=jax.ShapeDtypeStruct((n_pad, msg.shape[1]), jnp.float32),
         interpret=interpret,
-    )(estart_block, oh, msg)
+    )(estart_block, ids_col, msg)
     return out[:num_segments, :c].astype(dtype)
 
 

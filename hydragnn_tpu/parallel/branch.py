@@ -167,15 +167,23 @@ def make_branch_parallel_train_step(
     if cfg.conv_checkpointing:
         per_device_loss = jax.checkpoint(per_device_loss)
 
-    def _mixed_pmean(tree, scale_enc, scale_dec):
-        """pmean with decoder subtrees reduced over data only (per-branch
-        mean), encoder subtrees over the whole mesh (global mean)."""
+    def _mixed_pmean(tree, scale_enc, scale_dec_vec):
+        """pmean with decoder subtrees reduced over data only (per-BRANCH
+        weighted mean — ``scale_dec_vec`` is a [b_local] vector applied
+        along the leading bank axis), encoder subtrees over the whole mesh
+        (global mean)."""
         out = {}
         for k, v in tree.items():
             if _is_decoder_key(k):
+
+                def dec_scale(g):
+                    s = scale_dec_vec.reshape(
+                        (b_local,) + (1,) * (g.ndim - 1)
+                    )
+                    return g * s
+
                 out[k] = jax.lax.pmean(
-                    jax.tree_util.tree_map(lambda g: g * scale_dec, v),
-                    DATA_AXIS,
+                    jax.tree_util.tree_map(dec_scale, v), DATA_AXIS
                 )
             else:
                 out[k] = jax.lax.pmean(
@@ -196,21 +204,33 @@ def make_branch_parallel_train_step(
         (tot, (tasks, mutated)), grads = jax.value_and_grad(
             per_device_loss, has_aux=True
         )(params, batch_stats, batch, rng)
-        n = jnp.sum(batch.graph_mask.astype(jnp.float32))
+        gm = batch.graph_mask.astype(jnp.float32)
+        n = jnp.sum(gm)
         # encoder: weighted mean over every shard (DDP analog)
         n_tot = jax.lax.psum(n, _BOTH)
         scale_enc = n * mesh.size / jnp.maximum(n_tot, 1.0)
-        # decoder: weighted mean over this branch block's data shards only
-        # (the reference's per-branch DDP subgroup, MultiTaskModelMP.py:230)
-        n_branch = jax.lax.psum(n, DATA_AXIS)
-        scale_dec = n * mesh.shape[DATA_AXIS] / jnp.maximum(n_branch, 1.0)
-        grads = _mixed_pmean(grads, scale_enc, scale_dec)
+        # decoder: weighted mean over each BRANCH's graphs (the reference's
+        # per-branch DDP subgroup, MultiTaskModelMP.py:230). The per-device
+        # loss averages over its shard, so slice j's raw gradient carries a
+        # factor n_j_shard/n_shard; rescaling by n_shard * D / n_j_total
+        # before the data-axis pmean yields exactly the per-branch weighted
+        # mean — also correct when several branches share a device block
+        # (b_local > 1), where a single block-mass scale would train each
+        # branch at ~1/b_local effective LR.
+        branch_mass = jax.ops.segment_sum(
+            gm, batch.dataset_id, num_segments=b_local
+        )  # [b_local] real graphs per local branch slice on this shard
+        branch_tot = jax.lax.psum(branch_mass, DATA_AXIS)
+        scale_dec_vec = (
+            n * mesh.shape[DATA_AXIS] / jnp.maximum(branch_tot, 1.0)
+        )
+        grads = _mixed_pmean(grads, scale_enc, scale_dec_vec)
         tot = jax.lax.pmean(tot * scale_enc, _BOTH)
         tasks = jax.lax.pmean(
             jax.tree_util.tree_map(lambda t: t * scale_enc, tasks), _BOTH
         )
         stats = mutated.get("batch_stats", batch_stats)
-        new_stats = _mixed_pmean(stats, scale_enc, scale_dec)
+        new_stats = _mixed_pmean(stats, scale_enc, scale_dec_vec)
         return grads, tot, tasks, new_stats
 
     rep = P()
@@ -329,8 +349,11 @@ class BranchRoutedLoader:
 
     Batches are always full (``drop_last``) so every host steps in lockstep:
     up to ``batch_size-1`` tail graphs per branch are excluded per epoch —
-    for eval loaders this slightly truncates the metric sample, the same
-    trade the reference's DistributedSampler makes.
+    the same trade the reference's DistributedSampler makes. The epoch
+    length is the MAX over branches (globally agreed); rows whose branch is
+    exhausted emit all-padding batches, so uneven branch sizes neither
+    truncate the larger branches' metrics nor desynchronize the collective
+    step (empty rows carry zero loss weight).
     """
 
     def __init__(
@@ -345,6 +368,7 @@ class BranchRoutedLoader:
         oversampling: bool = True,
         host_count: int = 1,
         host_index: int = 0,
+        spec=None,
     ):
         """``num_shards``/``batch_size`` are per-host (local rows / local
         graphs per step). Globally there are ``host_count * num_shards``
@@ -380,13 +404,16 @@ class BranchRoutedLoader:
         by_branch = {i: [g for g in graphs if g.dataset_id == i] for i in ids}
         n_max = max(len(b) for b in by_branch.values())
         # one shared worst-case spec so all branch rows stack; per-shard
-        # graph count is identical for every row by construction
+        # graph count is identical for every row by construction. Callers
+        # building train/val/test loaders should pass ONE ``spec`` computed
+        # over all splits so eval reuses the train step's compilation.
         assert batch_size % L == 0
         per_row_bs = batch_size // L
-        ladder = SpecLadder.for_dataset(
-            list(graphs), max(per_row_bs, 1), num_buckets=1
-        )
-        spec = ladder.specs[-1]
+        if spec is None:
+            ladder = SpecLadder.for_dataset(
+                list(graphs), max(per_row_bs, 1), num_buckets=1
+            )
+            spec = ladder.specs[-1]
         self.loaders: List = []
         for b in served:
             rows_b = row_branch.count(b)  # local rows serving branch b
@@ -419,10 +446,11 @@ class BranchRoutedLoader:
         self.host_index = host_index
         self.sort_edges = sort_edges
         self.spec = spec
-        # GLOBALLY agreed step count: every host computes the same min over
+        # GLOBALLY agreed step count: every host computes the same MAX over
         # ALL branches (not just the ones it serves) from the full graph
         # list — hosts serving different branches would otherwise disagree
-        # on epoch length and deadlock in the collective step
+        # on epoch length and deadlock in the collective step. Exhausted
+        # branches fill their rows with all-padding batches (zero weight).
         steps = []
         for b in range(branch_count):
             nb = len(by_branch[ids[b]])
@@ -430,7 +458,26 @@ class BranchRoutedLoader:
             hosts_b = max(R // rows_srv, 1)
             n_eff = n_max if (oversampling and nb < n_max) else nb
             steps.append((n_eff // hosts_b) // (per_row_bs * rows_srv))
-        self._len = min(steps)
+        self._len = max(steps)
+        self._templates: dict = {}
+
+    def _empty_rows(self, rows_b: int):
+        """All-padding stacked rows [rows_b, ...]: masks false, edges/nodes
+        parked on the dummy slots (the GraphLoader stacked-path template
+        convention, data/pipeline.py _make)."""
+        if rows_b not in self._templates:
+            from ..data.graph import batch_graphs_np, graph_batch_from_np
+
+            arrs = batch_graphs_np([self.graphs[0]], self.spec)
+            z = {k: np.zeros_like(v) for k, v in arrs.items()}
+            z["senders"] = np.full_like(arrs["senders"], self.spec.n_nodes - 1)
+            z["receivers"] = z["senders"].copy()
+            z["node_graph"] = np.full_like(
+                arrs["node_graph"], self.spec.n_graphs - 1
+            )
+            stacked = {k: np.stack([v] * rows_b) for k, v in z.items()}
+            self._templates[rows_b] = graph_batch_from_np(stacked)
+        return self._templates[rows_b]
 
     def set_epoch(self, epoch: int) -> None:
         for l in self.loaders:
@@ -442,7 +489,18 @@ class BranchRoutedLoader:
     def __iter__(self) -> Iterator:
         its = [iter(l) for l in self.loaders]
         for _ in range(len(self)):
-            rows = [next(it) for it in its]
+            rows = []
+            for it, loader in zip(its, self.loaders):
+                nxt = next(it, None)
+                if nxt is None:  # branch exhausted: zero-weight filler rows
+                    nxt = self._empty_rows(loader.num_shards)
+                elif loader.num_shards == 1:
+                    # a single-row sub-loader emits unstacked batches
+                    # (GraphLoader contract); restore the row axis
+                    nxt = jax.tree_util.tree_map(
+                        lambda x: np.asarray(x)[None], nxt
+                    )
+                rows.append(nxt)
             yield jax.tree_util.tree_map(
                 lambda *xs: np.concatenate(xs, axis=0), *rows
             )

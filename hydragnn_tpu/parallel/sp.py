@@ -1,0 +1,133 @@
+"""Sequence(node)-parallel execution: one giant graph sharded over devices.
+
+The reference's GPS attention is dense per-graph on one device
+(hydragnn/globalAtt/gps.py:125-141) — a graph must fit a single GPU. This
+module removes that bound the TPU way for the long-context regime
+(mesoscale supercells, periodic assemblies):
+
+- the batch's node/edge axes are sharded ``P('data')`` over a 1-D mesh;
+- GPS global attention (``global_attn_type: "ring"``) computes the exact
+  softmax attention with ring-rotated K/V blocks over ICI
+  (parallel/ring_attention.py) — per-device memory stays
+  O(n_local * n_local) per block instead of O(N^2);
+- every other op (convs, segment sums, norms, decoders) is auto-partitioned
+  by XLA GSPMD from the input shardings — linear memory, collectives
+  inserted by the compiler.
+
+The model is built once; the SP context (set while the jitted step traces)
+tells the ring-attention module which mesh axis shards the node dimension.
+Without a context the same module falls back to dense masked attention —
+bitwise the same math — so one checkpoint serves both execution modes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..data.graph import GraphBatch
+
+SP_AXIS = "data"
+
+_ctx = threading.local()
+
+
+def current_sp() -> Tuple[Optional[Mesh], str]:
+    """(mesh, axis) of the active SP context, or (None, axis) outside one.
+    Read at TRACE time by the ring-attention module."""
+    return getattr(_ctx, "mesh", None), getattr(_ctx, "axis", SP_AXIS)
+
+
+@contextlib.contextmanager
+def sp_context(mesh: Mesh, axis: str = SP_AXIS):
+    prev = current_sp()
+    _ctx.mesh, _ctx.axis = mesh, axis
+    try:
+        yield
+    finally:
+        _ctx.mesh, _ctx.axis = prev
+
+
+def make_sp_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices() if n_devices is None else jax.devices()[:n_devices]
+    return Mesh(np.asarray(devs), (SP_AXIS,))
+
+
+def shard_sp_batch(batch: GraphBatch, mesh: Mesh) -> GraphBatch:
+    """Place node/edge-leading arrays sharded P(SP_AXIS); everything whose
+    leading dim does not divide the mesh stays replicated. The pad spec must
+    make n_nodes and n_edges multiples of the mesh size."""
+    sh = NamedSharding(mesh, P(SP_AXIS))
+    rep = NamedSharding(mesh, P())
+    n = mesh.size
+
+    def place(x):
+        if hasattr(x, "ndim") and x.ndim >= 1 and x.shape[0] % n == 0:
+            return jax.device_put(x, sh)
+        return jax.device_put(x, rep)
+
+    return jax.tree_util.tree_map(place, batch)
+
+
+def make_sp_train_step(model, tx, mesh: Mesh, compute_grad_energy: bool = False):
+    """Jitted node-sharded train step for one spanning graph batch: params
+    replicated, batch node/edge axes P('data'); GPS ring attention exact,
+    the rest GSPMD-partitioned. Mirrors train.loop.make_train_step."""
+    import optax
+
+    from ..train.loss import compute_loss
+
+    cfg = model.cfg
+
+    def loss_fn(params, batch_stats, batch, rng):
+        variables = {"params": params, "batch_stats": batch_stats}
+        with sp_context(mesh):
+            tot, tasks, mutated, _ = compute_loss(
+                model, variables, batch, cfg, True, rng, compute_grad_energy
+            )
+        return tot.astype(jnp.float32), (tasks, mutated)
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, batch, rng):
+        (tot, (tasks, mutated)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, batch, rng)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                params=params,
+                opt_state=opt_state,
+                batch_stats=mutated.get("batch_stats", state.batch_stats),
+                step=state.step + 1,
+            ),
+            tot,
+            tasks,
+        )
+
+    return step
+
+
+def make_sp_eval_step(model, mesh: Mesh, compute_grad_energy: bool = False):
+    from ..train.loss import compute_loss
+
+    cfg = model.cfg
+
+    @jax.jit
+    def evalf(state, batch):
+        variables = state.variables()
+        with sp_context(mesh):
+            tot, tasks, _, outputs = compute_loss(
+                model, variables, batch, cfg, False, None, compute_grad_energy
+            )
+        return tot, tasks, outputs
+
+    return evalf

@@ -46,14 +46,22 @@ def make_optimizer(
 
     ``freeze_conv=True`` zeroes updates to conv-stack parameters via a masked
     transform — the optax analog of requires_grad=False
-    (reference: Base.py:175-176, 247-251)."""
+    (reference: Base.py:175-176, 247-251).
+
+    ``Optimizer.clip_grad_norm`` (off by default) prepends global-norm
+    gradient clipping — the stability guard for deep multiplicative stacks
+    (e.g. PaiNN-update chains in conv node heads), where a single outlier
+    step can blow the scalar/vector product streams past float range."""
     kind = opt_config.get("type", "AdamW")
     lr = float(opt_config.get("learning_rate", 1e-3))
+    clip = float(opt_config.get("clip_grad_norm", 0.0) or 0.0)
     if kind not in _OPT_TABLE:
         raise ValueError(f"unknown optimizer {kind!r}; known: {sorted(_OPT_TABLE)}")
 
     def build(learning_rate):
         tx = _OPT_TABLE[kind](learning_rate)
+        if clip > 0.0:
+            tx = optax.chain(optax.clip_by_global_norm(clip), tx)
         if freeze_conv:
             tx = optax.chain(
                 tx, optax.masked(optax.set_to_zero(), freeze_conv_mask)

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# 4-cell perf A/B on the real chip: mixed_precision x sorted_aggregation.
+# Appends one JSON line per cell to logs/ab_matrix.jsonl; run on a host with
+# the TPU reachable (bench.py probes first and records an outage as data).
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p logs
+for MP in 1 0; do
+  for SORTED in 0 1; do
+    echo "== BENCH_MP=$MP BENCH_SORTED=$SORTED ==" >&2
+    BENCH_MP=$MP BENCH_SORTED=$SORTED python bench.py \
+      | tee -a logs/ab_matrix.jsonl
+  done
+done
+echo "A/B matrix done -> logs/ab_matrix.jsonl" >&2

@@ -319,3 +319,24 @@ def pytest_example_qm7x_inference_roundtrip(tmp_path):
         "--num_epoch", "2", cwd=str(tmp_path),
     )
     assert "HLGAP MAE" in out
+
+
+def pytest_example_mesoscale(tmp_path):
+    """GPS ring attention over a node-sharded supercell (VERDICT r2 item 7):
+    one graph spans the 8-device mesh, exact attention via ppermute ring."""
+    out = _run_example(
+        "examples/mesoscale/mesoscale.py",
+        "--cells", "3", "--num_epoch", "6",
+        cwd=str(tmp_path),
+    )
+    assert "ring-attention loss" in out
+
+
+def pytest_example_multibranch_branch_parallel(tmp_path):
+    """Real decoder branch-parallelism through the example driver: decoder
+    banks sharded over the branch axis, branch-routed loaders."""
+    out = _run_example(
+        "examples/multibranch/train.py", "--epochs", "3", "--branch_parallel",
+        cwd=str(tmp_path), timeout=600,
+    )
+    assert "epoch 2:" in out

@@ -74,3 +74,124 @@ def pytest_ring_fully_masked_shard():
     ref = _dense_reference(q, k, v, mask)
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def _gps_ring_setup():
+    """One spanning BCC supercell graph + a GPS-ring model."""
+    import numpy as np
+
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data import (
+        MinMax,
+        VariablesOfInterest,
+        deterministic_graph_dataset,
+        extract_variables,
+    )
+    from hydragnn_tpu.data.graph import PadSpec, batch_graphs
+    from hydragnn_tpu.data.lappe import add_dataset_pe
+    from hydragnn_tpu.models import create_model, init_model
+
+    raw = deterministic_graph_dataset(
+        6, unit_cell_x_range=(3, 4), unit_cell_y_range=(3, 4), seed=3
+    )
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    ready = add_dataset_pe(ready, 1)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "hidden_dim": 16, "num_conv_layers": 2,
+                "global_attn_engine": "GPS", "global_attn_type": "ring",
+                "global_attn_heads": 4, "pe_dim": 1,
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                            "dim_sharedlayers": 8,
+                                            "num_headlayers": 2,
+                                            "dim_headlayers": [8, 8]}},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"], "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {"batch_size": 1, "num_epoch": 1,
+                          "Optimizer": {"type": "AdamW",
+                                         "learning_rate": 1e-3}},
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                    "graph_features": {"dim": [1]}},
+    }
+    config = update_config(config, ready[:4], ready[4:5], ready[5:])
+    model = create_model(config)
+    g = ready[0]
+    # pad one spanning graph to mesh-divisible node/edge counts
+    n_pad = (g.num_nodes // 8 + 2) * 8
+    e_pad = (g.num_edges // 8 + 2) * 8
+    spec = PadSpec(n_nodes=n_pad, n_edges=e_pad, n_graphs=2)
+    batch = batch_graphs([g], spec)
+    variables = init_model(model, batch, seed=0)
+    return config, model, variables, batch, ready
+
+
+def pytest_gps_ring_matches_dense_forward():
+    """GPS-ring model: SP-sharded execution over the 8-device mesh equals
+    the single-device dense fallback on identical weights (VERDICT r2 item
+    7 — ring attention wired into GPS behind a config switch)."""
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.parallel.sp import (
+        make_sp_mesh,
+        shard_sp_batch,
+        sp_context,
+    )
+
+    config, model, variables, batch, _ = _gps_ring_setup()
+    dense = model.apply(variables, batch, train=False)
+
+    mesh = make_sp_mesh()
+    sb = shard_sp_batch(batch, mesh)
+
+    def fwd(v, b):
+        with sp_context(mesh):
+            return model.apply(v, b, train=False)
+
+    ringed = jax.jit(fwd)(variables, sb)
+    for name in dense:
+        np.testing.assert_allclose(
+            np.asarray(dense[name]), np.asarray(ringed[name]),
+            rtol=2e-4, atol=2e-5,
+        )
+
+
+def pytest_gps_ring_trains_spanning_graph():
+    """A supercell graph trains through the node-sharded SP step: loss
+    drops, params stay replicated, finite throughout."""
+    import jax
+    import numpy as np
+
+    from hydragnn_tpu.data.graph import batch_graphs
+    from hydragnn_tpu.parallel.sp import (
+        make_sp_mesh,
+        make_sp_train_step,
+        shard_sp_batch,
+    )
+    from hydragnn_tpu.train import TrainState, make_optimizer
+
+    config, model, variables, batch, ready = _gps_ring_setup()
+    tx = make_optimizer(
+        {"type": "AdamW", "learning_rate": 5e-3}
+    )
+    state = TrainState.create(variables, tx)
+    mesh = make_sp_mesh()
+    step = make_sp_train_step(model, tx, mesh)
+    rng = jax.random.PRNGKey(0)
+    sb = shard_sp_batch(batch, mesh)
+    losses = []
+    for i in range(30):
+        rng, sub = jax.random.split(rng)
+        state, tot, _ = step(state, sb, sub)
+        losses.append(float(tot))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses

@@ -71,3 +71,45 @@ def pytest_to_graph_roundtrip():
     assert g.num_edges == 2  # both directions
     np.testing.assert_array_equal(g.edge_attr.ravel(), [3.0, 3.0])
     np.testing.assert_array_equal(g.z, [7, 7])
+
+
+def pytest_benzene_resonance_enumeration():
+    """Benzene yields its two Kekulé structures: alternating double bonds
+    around the ring (reference xyz2mol enumerates all BO matrices)."""
+    import numpy as np
+
+    from hydragnn_tpu.data.xyz2mol import resonance_structures
+
+    r = 1.39
+    ang = np.arange(6) * np.pi / 3
+    ring = np.stack([r * np.cos(ang), r * np.sin(ang), np.zeros(6)], 1)
+    rh = 2.47
+    hpos = np.stack([rh * np.cos(ang), rh * np.sin(ang), np.zeros(6)], 1)
+    z = [6] * 6 + [1] * 6
+    pos = np.concatenate([ring, hpos])
+    mols = resonance_structures(z, pos)
+    # every structure: 3 ring double bonds, neutral, all carbons saturated
+    assert len(mols) >= 2, f"expected >=2 Kekule structures, got {len(mols)}"
+    ring_patterns = set()
+    for m in mols:
+        doubles = frozenset(
+            (a, b) for a, b, o in m.bonds if o == 2 and a < 6 and b < 6
+        )
+        assert len(doubles) == 3, m.bonds
+        assert int(m.formal_charges.sum()) == 0
+        ring_patterns.add(doubles)
+    assert len(ring_patterns) >= 2  # genuinely distinct alternations
+
+
+def pytest_charged_fragment_resolution():
+    """Hydroxide (OH-): declared charge -1 resolves through the resonance
+    search instead of raising (reference: charged_fragments=True)."""
+    import numpy as np
+
+    from hydragnn_tpu.data.xyz2mol import perceive_molecule
+
+    z = [8, 1]
+    pos = np.array([[0.0, 0.0, 0.0], [0.97, 0.0, 0.0]])
+    mol = perceive_molecule(z, pos, charge=-1)
+    assert int(mol.formal_charges.sum()) == -1
+    assert mol.formal_charges[0] == -1  # the charge sits on oxygen
