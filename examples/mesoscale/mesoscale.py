@@ -137,7 +137,13 @@ def main():
     batches = [shard_sp_batch(batch_graphs([g], spec), mesh) for g in tr]
     test_batch = shard_sp_batch(batch_graphs([te[0]], spec), mesh)
 
-    variables = init_model(model, batches[0], seed=0)
+    # init under the SP context too: the dense fallback would materialize
+    # the full [H, N, N] attention on one device during the init trace —
+    # exactly the memory wall ring attention removes
+    from hydragnn_tpu.parallel.sp import sp_context
+
+    with sp_context(mesh):
+        variables = init_model(model, batches[0], seed=0)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
     state = TrainState.create(variables, tx)
     step = make_sp_train_step(model, tx, mesh)
@@ -160,7 +166,7 @@ def main():
         if epoch % 5 == 0 or epoch == args.num_epoch - 1:
             te_loss, _, _ = evalf(state, test_batch)
             print(f"epoch {epoch}: train {tr_loss:.5f} test {float(te_loss):.5f}")
-    assert np.isfinite(tr_loss) and tr_loss < first or args.num_epoch < 3
+    assert np.isfinite(tr_loss) and (tr_loss < first or args.num_epoch < 3)
     print(f"mesoscale ring-attention loss {first:.5f} -> {tr_loss:.5f}")
 
 

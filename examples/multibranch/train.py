@@ -138,7 +138,7 @@ def main():
         )
         val_loader = BranchRoutedLoader(
             va, args.batch_size, branch_count=2, num_shards=n_dev,
-            shuffle=False, oversampling=False,
+            shuffle=False, oversampling=False, spec=loader.spec,
         )
         first = next(iter(loader))
         one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], first)

@@ -140,14 +140,19 @@ def enumerate_bond_orders(
         return s
 
     results: List[dict] = []
-    seen_terminal = set()
     seen_states = set()
+    # bound the WALK, not just the accepted results: large conjugated
+    # systems have few maximal assignments but exponentially many partial
+    # states, and an unbounded DFS would hang after finding them all
+    max_states = 512 * max_structures
     stack = [base]
     while stack and len(results) < max_structures:
         order = stack.pop()
         key = tuple(sorted(order.items()))
         if key in seen_states:
             continue
+        if len(seen_states) >= max_states:
+            break
         seen_states.add(key)
         s = bo_sums(order)
         cands = [
@@ -156,9 +161,7 @@ def enumerate_bond_orders(
             if o < 3 and caps[p[0]] - s[p[0]] > 0 and caps[p[1]] - s[p[1]] > 0
         ]
         if not cands:
-            if key not in seen_terminal:
-                seen_terminal.add(key)
-                results.append(dict(order))
+            results.append(dict(order))
             continue
         for p in cands:
             nxt = dict(order)
@@ -257,15 +260,24 @@ def perceive_molecule(
             formal[i] = s - best
     if charge is not None and int(formal.sum()) != charge:
         # charged-fragment resolution (reference: xyz2mol
-        # charged_fragments=True): search the resonance enumeration for an
-        # assignment whose formal charges sum to the declared total
+        # charged_fragments=True): among all enumerated assignments whose
+        # formal charges sum to the declared total, pick the one with the
+        # minimal total |formal charge| — the same valence criterion the
+        # resonance filter applies, so the result is chemically sensible
+        # and independent of DFS enumeration order
+        matches = []
         for alt in enumerate_bond_orders(z, skeleton):
             alt_formal = _formal_charges(z, alt)
             if int(alt_formal.sum()) == charge:
-                bonds = sorted((a, b, o) for (a, b), o in alt.items())
-                return Molecule(
-                    z=z, pos=pos, bonds=bonds, formal_charges=alt_formal
-                )
+                matches.append((int(np.abs(alt_formal).sum()), alt, alt_formal))
+        if matches:
+            _, alt, alt_formal = min(
+                matches, key=lambda t: (t[0], sorted(t[1].items()))
+            )
+            bonds = sorted((a, b, o) for (a, b), o in alt.items())
+            return Molecule(
+                z=z, pos=pos, bonds=bonds, formal_charges=alt_formal
+            )
         raise ValueError(
             f"perceived total formal charge {int(formal.sum())} != declared "
             f"charge {charge} in any resonance structure; geometry may be "

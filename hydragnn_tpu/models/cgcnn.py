@@ -25,12 +25,26 @@ class CGConv(nn.Module):
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
-        parts = [inv[batch.receivers], inv[batch.senders]]
-        if self.edge_dim and batch.edge_attr is not None:
-            parts.append(batch.edge_attr)
-        z = jnp.concatenate(parts, axis=-1)
-        gate = nn.sigmoid(nn.Dense(self.output_dim)(z))
-        core = nn.softplus(nn.Dense(self.output_dim)(z))
+        # both z-projections distributed over the concat and hoisted before
+        # the edge gather (node matmuls on [N, C], not [E, 2C]; same
+        # function class as Dense(concat[x_i, x_j, e]))
+        def z_proj(name):
+            out = (
+                nn.Dense(self.output_dim, name=f"{name}_recv")(inv)[
+                    batch.receivers
+                ]
+                + nn.Dense(
+                    self.output_dim, use_bias=False, name=f"{name}_send"
+                )(inv)[batch.senders]
+            )
+            if self.edge_dim and batch.edge_attr is not None:
+                out = out + nn.Dense(
+                    self.output_dim, use_bias=False, name=f"{name}_edge"
+                )(batch.edge_attr)
+            return out
+
+        gate = nn.sigmoid(z_proj("gate"))
+        core = nn.softplus(z_proj("core"))
         agg = segment_sum(gate * core, batch.receivers, batch.num_nodes,
                           batch.edge_mask, sorted_ids=self.sorted_agg,
                           max_degree=self.max_in_degree)

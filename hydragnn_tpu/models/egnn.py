@@ -63,11 +63,28 @@ class EGCL(nn.Module):
         # normalize=True with eps=1.0 (reference E_GCL norm_diff, operations.py)
         unit = vec / (length + 1.0)
 
-        parts = [inv[batch.receivers], inv[batch.senders], length]
+        # First edge-MLP layer distributed over its concat inputs and hoisted
+        # BEFORE the edge gather: Dense(concat[h_i, h_j, d]) == Dense_r(h)_i
+        # + Dense_s(h)_j + Dense_d(d). The node-side matmuls run on [N, C]
+        # instead of [E, 2C] — at the SC25 degree (~20 edges/node) that is
+        # ~20x fewer MXU FLOPs and half the gather bytes for this layer,
+        # with bit-identical function class (reference computes the same
+        # layer post-concat, EGCLStack.py:238-247).
+        pre = (
+            nn.Dense(self.hidden_dim, name="edge_lin_recv")(inv)[batch.receivers]
+            + nn.Dense(self.hidden_dim, use_bias=False, name="edge_lin_send")(
+                inv
+            )[batch.senders]
+            + nn.Dense(self.hidden_dim, use_bias=False, name="edge_lin_len")(
+                length
+            )
+        )
         if self.edge_dim and batch.edge_attr is not None:
-            parts.append(batch.edge_attr)
-        edge_feat = MLP((self.hidden_dim, self.hidden_dim), "relu",
-                        final_activation=True)(jnp.concatenate(parts, axis=-1))
+            pre = pre + nn.Dense(
+                self.hidden_dim, use_bias=False, name="edge_lin_attr"
+            )(batch.edge_attr)
+        act = nn.relu
+        edge_feat = act(nn.Dense(self.hidden_dim, name="edge_lin2")(act(pre)))
 
         if self.equivariant:
             delta = coordinate_displacement(

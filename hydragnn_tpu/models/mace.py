@@ -41,7 +41,7 @@ from ..ops.o3 import couple, irrep_slice, real_sph_harm, sh_dim, tp_paths
 from ..ops.radial import RadialEmbedding, edge_vectors
 from ..ops.segment import segment_sum
 from ..ops.segment import masked_global_mean_pool
-from .base import ModelConfig, NodeHeadConfig
+from .base import ModelConfig, NodeHeadConfig, _branch_bank
 from .layers import MLP, get_activation
 
 NUM_ELEMENTS = 118
@@ -296,48 +296,48 @@ class MACEModel(nn.Module):
         """Per-layer multihead decode of node scalars; graph heads pool first
         (reference: Linear/NonLinearMultiheadDecoderBlock, blocks.py:417-899)."""
         cfg = self.cfg
+        B = cfg.num_branches
         outputs: Dict[str, jnp.ndarray] = {}
         pooled = None
         for ihead, (name, t, d) in enumerate(
             zip(cfg.output_names, cfg.output_type, cfg.output_dim)
         ):
             d_out = d * 2 if cfg.var_output else d
-            branch_outs = []
-            for b in range(cfg.num_branches):
-                prefix = f"readout{idx}_head{ihead}_branch{b}"
-                if t == "graph":
-                    if pooled is None:
-                        pooled = masked_global_mean_pool(
-                            scalars,
-                            batch.node_graph,
-                            batch.num_graphs,
-                            batch.node_mask,
-                        )
-                    if nonlinear:
-                        gh = cfg.graph_head
-                        dims = tuple(gh.dim_headlayers if gh else (scalars.shape[-1],))
-                        branch_outs.append(
-                            MLP(dims + (d_out,), cfg.activation, name=prefix)(pooled)
-                        )
-                    else:
-                        branch_outs.append(
-                            nn.Dense(d_out, name=prefix)(pooled)
-                        )
-                else:
-                    if nonlinear:
-                        nh = cfg.node_head or NodeHeadConfig()
-                        dims = tuple(nh.dim_headlayers)
-                        branch_outs.append(
-                            MLP(dims + (d_out,), cfg.activation, name=prefix)(scalars)
-                        )
-                    else:
-                        branch_outs.append(
-                            nn.Dense(d_out, name=prefix)(scalars)
-                        )
-            if cfg.num_branches == 1:
-                out = branch_outs[0]
+            prefix = f"readout{idx}_head{ihead}"
+            # branch BANK: one module with stacked [B, ...] param leaves
+            # (models/base.py _branch_bank) — same dense decode, but the
+            # banks shard P('branch') under parallel/branch.py like the
+            # HydraModel decoders
+            if t == "graph":
+                if pooled is None:
+                    pooled = masked_global_mean_pool(
+                        scalars,
+                        batch.node_graph,
+                        batch.num_graphs,
+                        batch.node_mask,
+                    )
+                inp = pooled
             else:
-                stacked = jnp.stack(branch_outs, axis=0)
+                inp = scalars
+            if nonlinear:
+                if t == "graph":
+                    gh = cfg.graph_head
+                    dims = tuple(
+                        gh.dim_headlayers if gh else (scalars.shape[-1],)
+                    )
+                else:
+                    nh = cfg.node_head or NodeHeadConfig()
+                    dims = tuple(nh.dim_headlayers)
+                stacked = _branch_bank(MLP, B, in_axes=(None,))(
+                    dims + (d_out,), cfg.activation, name=prefix
+                )(inp)
+            else:
+                stacked = _branch_bank(nn.Dense, B, in_axes=(None,))(
+                    d_out, name=prefix
+                )(inp)
+            if B == 1:
+                out = stacked[0]
+            else:
                 ds = (
                     batch.dataset_id
                     if t == "graph"

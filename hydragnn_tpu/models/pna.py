@@ -71,14 +71,20 @@ class PNAConv(nn.Module):
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
-        x_i = inv[batch.receivers]
-        x_j = inv[batch.senders]
-        parts = [x_i, x_j]
-        if self.edge_dim and batch.edge_attr is not None:
-            parts.append(batch.edge_attr)
-        # pre-MLP, pre_layers=1
+        # pre-MLP (pre_layers=1), distributed over the concat and hoisted
+        # BEFORE the edge gather: Dense(concat[x_i, x_j, e]) ==
+        # Dense_r(x)_i + Dense_s(x)_j + Dense_e(e) — node-side matmuls on
+        # [N, C] instead of [E, 2C] (~degree-times fewer MXU FLOPs), same
+        # function class as the reference's post-concat layer.
         f_in = inv.shape[-1]
-        msg = nn.Dense(f_in)(jnp.concatenate(parts, axis=-1))
+        msg = (
+            nn.Dense(f_in, name="pre_recv")(inv)[batch.receivers]
+            + nn.Dense(f_in, use_bias=False, name="pre_send")(inv)[batch.senders]
+        )
+        if self.edge_dim and batch.edge_attr is not None:
+            msg = msg + nn.Dense(f_in, use_bias=False, name="pre_edge")(
+                batch.edge_attr
+            )
 
         scaled = pna_aggregate(msg, batch, self.deg_hist,
                                self.sorted_agg, self.max_in_degree)

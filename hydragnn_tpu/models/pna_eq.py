@@ -44,12 +44,22 @@ class PNAEqConv(nn.Module):
         unit = vec / length
         rbf = bessel_basis_enveloped(r, self.radius, self.num_radial)
 
-        # pre-MLP over [x_i, x_j, rbf_emb(, edge)] (PNAEqStack.py:268-344)
-        parts = [x[batch.receivers], x[batch.senders],
-                 nn.tanh(nn.Dense(self.node_size)(rbf))]
+        # pre-MLP over [x_i, x_j, rbf_emb(, edge)] (PNAEqStack.py:268-344),
+        # distributed over the concat and hoisted before the edge gather
+        # (node matmuls on [N, C], not [E, 2C]; same function class)
+        msg = (
+            nn.Dense(self.node_size, name="pre_recv")(x)[batch.receivers]
+            + nn.Dense(self.node_size, use_bias=False, name="pre_send")(x)[
+                batch.senders
+            ]
+            + nn.Dense(self.node_size, use_bias=False, name="pre_rbf")(
+                nn.tanh(nn.Dense(self.node_size)(rbf))
+            )
+        )
         if self.edge_dim and batch.edge_attr is not None:
-            parts.append(nn.Dense(self.node_size)(batch.edge_attr))
-        msg = nn.Dense(self.node_size)(jnp.concatenate(parts, axis=-1))
+            msg = msg + nn.Dense(
+                self.node_size, use_bias=False, name="pre_attr"
+            )(nn.Dense(self.node_size)(batch.edge_attr))
         msg = MLP((self.node_size, self.node_size, 3 * self.node_size),
                   "silu")(nn.tanh(msg))
         # Hadamard with rbf projection, then split for scalar/vector duty

@@ -40,8 +40,13 @@ class PNAPlusConv(nn.Module):
             e = nn.Dense(f_in)(jnp.concatenate([batch.edge_attr, rbf_emb], axis=-1))
         else:
             e = rbf_emb
-        h = jnp.concatenate([inv[batch.receivers], inv[batch.senders], e], axis=-1)
-        msg = nn.Dense(f_in)(h)
+        # pre-MLP distributed over the concat and hoisted before the edge
+        # gather (node matmuls on [N, C], not [E, 2C]; same function class)
+        msg = (
+            nn.Dense(f_in, name="pre_recv")(inv)[batch.receivers]
+            + nn.Dense(f_in, use_bias=False, name="pre_send")(inv)[batch.senders]
+            + nn.Dense(f_in, use_bias=False, name="pre_edge")(e)
+        )
         # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276)
         msg = msg * nn.Dense(f_in, use_bias=False)(rbf)
 

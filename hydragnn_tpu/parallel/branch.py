@@ -22,9 +22,8 @@ globally. The TPU-native equivalent built here:
   dataset, exactly the reference's semantics (which differ from the dense
   masked decode by a per-branch normalization factor).
 
-MACE's per-layer readouts use separately named branch modules and are not
-bank-stacked; branch-parallel execution currently requires ``HydraModel``
-(every conv type except MACE).
+Both ``HydraModel`` heads and ``MACEModel`` per-layer readouts are
+branch-banked, so every conv type — MACE included — runs branch-parallel.
 """
 
 from __future__ import annotations
@@ -48,7 +47,7 @@ _BOTH = (BRANCH_AXIS, DATA_AXIS)
 
 # top-level variable-collection keys holding branch-banked decoder leaves
 # (models/base.py setup: self.graph_shared, self.heads_NN list)
-_DECODER_PREFIXES = ("graph_shared", "heads_NN")
+_DECODER_PREFIXES = ("graph_shared", "heads_NN", "readout")
 
 
 def _is_decoder_key(top_key: str) -> bool:
@@ -121,12 +120,10 @@ def _bank_size(params) -> int:
     raise ValueError("no decoder bank (graph_shared/heads_NN) in params")
 
 
-def _local_model(model: HydraModel, b_local: int) -> HydraModel:
-    if not isinstance(model, HydraModel):
-        raise ValueError(
-            "branch-parallel execution requires HydraModel (bank-stacked "
-            "decoders); MACE readouts are not branch-banked"
-        )
+def _local_model(model, b_local: int):
+    """Rebuild the model for a local branch slice. Works for any model whose
+    decoders are branch BANKS (HydraModel heads, MACEModel readouts) —
+    identical module tree, bank leaves sliced by the shard_map specs."""
     cfg = dataclasses.replace(model.cfg, num_branches=b_local)
     return type(model)(cfg=cfg)
 

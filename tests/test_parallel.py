@@ -426,3 +426,75 @@ def pytest_branch_parallel_via_api_single_host():
     for leaf in jax.tree_util.tree_leaves(state.params["heads_NN_0"]):
         assert leaf.shape[0] == 2
         assert not np.allclose(leaf[0], leaf[1])
+
+
+def pytest_branch_parallel_mace_readout_banks():
+    """MACE's per-layer readout banks shard over the branch axis too: one
+    branch-parallel step on a 2-branch MACE runs finite with readout leaves
+    split across the branch mesh axis."""
+    import dataclasses
+
+    from hydragnn_tpu.parallel.branch import (
+        BranchRoutedLoader,
+        make_branch_parallel_train_step,
+        place_branch_state,
+    )
+
+    mesh = make_mesh(branch_size=2)
+    raw = deterministic_graph_dataset(32, seed=17)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [
+        dataclasses.replace(extract_variables(g, voi), dataset_id=i % 2)
+        for i, g in enumerate(raw)
+    ]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    gh = {"num_sharedlayers": 1, "dim_sharedlayers": 8,
+          "num_headlayers": 2, "dim_headlayers": [8, 8]}
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "MACE", "hidden_dim": 8, "num_conv_layers": 2,
+                "radius": 2.0, "max_neighbours": 100,
+                "num_radial": 4, "max_ell": 1, "node_max_ell": 1,
+                "correlation": 2, "radial_type": "bessel",
+                "envelope_exponent": 5,
+                "output_heads": {"graph": [
+                    {"type": "branch-0", "architecture": dict(gh)},
+                    {"type": "branch-1", "architecture": dict(gh)},
+                ]},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"], "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {"batch_size": 16, "num_epoch": 1,
+                          "Optimizer": {"type": "AdamW",
+                                         "learning_rate": 1e-3}},
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                    "graph_features": {"dim": [1]}},
+    }
+    config = update_config(config, tr, va, te)
+    model = create_model(config)
+    loader = BranchRoutedLoader(tr, batch_size=16, branch_count=2, num_shards=8)
+    batch = next(iter(loader))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], batch)
+    variables = init_model(model, one, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = place_branch_state(TrainState.create(variables, tx), tx, mesh)
+    # readout banks sharded over the branch axis
+    readout_sharded = [
+        k for k in state.params
+        if k.startswith("readout")
+        and any(
+            not l.sharding.is_fully_replicated
+            for l in jax.tree_util.tree_leaves(state.params[k])
+        )
+    ]
+    assert readout_sharded, sorted(state.params)
+    step = make_branch_parallel_train_step(model, tx, mesh)
+    state, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+    assert np.isfinite(float(tot))
