@@ -129,6 +129,18 @@ def enumerate_bond_orders(
     xyz2mol.py:1-1007). DFS over promotion choices with memoized states;
     ``max_structures`` bounds the (worst-case exponential) walk — aromatic
     rings yield their Kekulé alternatives well within it."""
+    return _enumerate_bond_orders(z, skeleton, max_structures)[0]
+
+
+def _enumerate_bond_orders(
+    z: np.ndarray,
+    skeleton: List[Tuple[int, int]],
+    max_structures: int = 64,
+) -> Tuple[List[dict], bool]:
+    """(results, truncated): ``truncated`` tells the caller the walk hit its
+    state bound, so an empty/short result list may be incomplete rather than
+    exhaustive (perceive_molecule escalates the bound before declaring a
+    declared charge unreachable)."""
     base = {tuple(p): 1 for p in skeleton}
     caps = {i: max(_VALENCES.get(int(zz), (4,))) for i, zz in enumerate(z)}
 
@@ -145,6 +157,7 @@ def enumerate_bond_orders(
     # systems have few maximal assignments but exponentially many partial
     # states, and an unbounded DFS would hang after finding them all
     max_states = 512 * max_structures
+    truncated = False
     stack = [base]
     while stack and len(results) < max_structures:
         order = stack.pop()
@@ -152,6 +165,7 @@ def enumerate_bond_orders(
         if key in seen_states:
             continue
         if len(seen_states) >= max_states:
+            truncated = True
             break
         seen_states.add(key)
         s = bo_sums(order)
@@ -167,7 +181,9 @@ def enumerate_bond_orders(
             nxt = dict(order)
             nxt[p] += 1
             stack.append(nxt)
-    return results
+    if stack and len(results) >= max_structures:
+        truncated = True
+    return results, truncated
 
 
 def resonance_structures(
@@ -265,11 +281,21 @@ def perceive_molecule(
         # minimal total |formal charge| — the same valence criterion the
         # resonance filter applies, so the result is chemically sensible
         # and independent of DFS enumeration order
-        matches = []
-        for alt in enumerate_bond_orders(z, skeleton):
-            alt_formal = _formal_charges(z, alt)
-            if int(alt_formal.sum()) == charge:
-                matches.append((int(np.abs(alt_formal).sum()), alt, alt_formal))
+        # the walk bound can hide the matching assignment on large
+        # conjugated systems — escalate it before declaring the charge
+        # unreachable (each retry is 16x more visited states)
+        truncated = False
+        for bound in (64, 1024, 16384):
+            matches = []
+            alts, truncated = _enumerate_bond_orders(z, skeleton, bound)
+            for alt in alts:
+                alt_formal = _formal_charges(z, alt)
+                if int(alt_formal.sum()) == charge:
+                    matches.append(
+                        (int(np.abs(alt_formal).sum()), alt, alt_formal)
+                    )
+            if matches or not truncated:
+                break
         if matches:
             _, alt, alt_formal = min(
                 matches, key=lambda t: (t[0], sorted(t[1].items()))
@@ -280,8 +306,11 @@ def perceive_molecule(
             )
         raise ValueError(
             f"perceived total formal charge {int(formal.sum())} != declared "
-            f"charge {charge} in any resonance structure; geometry may be "
-            f"mis-bonded at tolerance={tolerance}"
+            f"charge {charge} in any "
+            + ("ENUMERATED (walk bound hit — result incomplete) "
+               if truncated else "")
+            + f"resonance structure; geometry may be mis-bonded at "
+            f"tolerance={tolerance}"
         )
     bonds = sorted((a, b, o) for (a, b), o in order.items())
     return Molecule(z=z, pos=pos, bonds=bonds, formal_charges=formal)

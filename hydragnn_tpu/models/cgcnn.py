@@ -15,6 +15,7 @@ from flax import linen as nn
 
 from ..ops.segment import segment_sum
 from .base import register_conv
+from .layers import hoisted_pair_dense
 
 
 class CGConv(nn.Module):
@@ -29,19 +30,15 @@ class CGConv(nn.Module):
         # the edge gather (node matmuls on [N, C], not [E, 2C]; same
         # function class as Dense(concat[x_i, x_j, e]))
         def z_proj(name):
-            out = (
-                nn.Dense(self.output_dim, name=f"{name}_recv")(inv)[
-                    batch.receivers
-                ]
-                + nn.Dense(
-                    self.output_dim, use_bias=False, name=f"{name}_send"
-                )(inv)[batch.senders]
+            terms = (
+                [(f"{name}_edge", batch.edge_attr)]
+                if self.edge_dim and batch.edge_attr is not None
+                else []
             )
-            if self.edge_dim and batch.edge_attr is not None:
-                out = out + nn.Dense(
-                    self.output_dim, use_bias=False, name=f"{name}_edge"
-                )(batch.edge_attr)
-            return out
+            return hoisted_pair_dense(
+                self.output_dim, inv, batch, f"{name}_recv", f"{name}_send",
+                terms,
+            )
 
         gate = nn.sigmoid(z_proj("gate"))
         core = nn.softplus(z_proj("core"))

@@ -19,7 +19,7 @@ from flax import linen as nn
 from ..ops.radial import edge_vectors
 from ..ops.segment import segment_mean, segment_sum
 from .base import register_conv
-from .layers import MLP
+from .layers import MLP, hoisted_pair_dense
 
 
 def coordinate_displacement(unit, gate_feat, batch, hidden_dim, tanh=False,
@@ -63,26 +63,14 @@ class EGCL(nn.Module):
         # normalize=True with eps=1.0 (reference E_GCL norm_diff, operations.py)
         unit = vec / (length + 1.0)
 
-        # First edge-MLP layer distributed over its concat inputs and hoisted
-        # BEFORE the edge gather: Dense(concat[h_i, h_j, d]) == Dense_r(h)_i
-        # + Dense_s(h)_j + Dense_d(d). The node-side matmuls run on [N, C]
-        # instead of [E, 2C] — at the SC25 degree (~20 edges/node) that is
-        # ~20x fewer MXU FLOPs and half the gather bytes for this layer,
-        # with bit-identical function class (reference computes the same
-        # layer post-concat, EGCLStack.py:238-247).
-        pre = (
-            nn.Dense(self.hidden_dim, name="edge_lin_recv")(inv)[batch.receivers]
-            + nn.Dense(self.hidden_dim, use_bias=False, name="edge_lin_send")(
-                inv
-            )[batch.senders]
-            + nn.Dense(self.hidden_dim, use_bias=False, name="edge_lin_len")(
-                length
-            )
-        )
+        # matmul-before-gather first edge-MLP layer (layers.hoisted_pair_dense;
+        # reference computes the same layer post-concat, EGCLStack.py:238-247)
+        terms = [("edge_lin_len", length)]
         if self.edge_dim and batch.edge_attr is not None:
-            pre = pre + nn.Dense(
-                self.hidden_dim, use_bias=False, name="edge_lin_attr"
-            )(batch.edge_attr)
+            terms.append(("edge_lin_attr", batch.edge_attr))
+        pre = hoisted_pair_dense(
+            self.hidden_dim, inv, batch, "edge_lin_recv", "edge_lin_send", terms
+        )
         act = nn.relu
         edge_feat = act(nn.Dense(self.hidden_dim, name="edge_lin2")(act(pre)))
 

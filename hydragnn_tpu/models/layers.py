@@ -102,3 +102,26 @@ class MaskedBatchNorm(nn.Module):
 
         y = (x - mean) / jnp.sqrt(var + self.epsilon)
         return y * scale + bias
+
+
+def hoisted_pair_dense(dim, inv, batch, name_recv, name_send, edge_terms=()):
+    """First edge-MLP layer distributed over its concat inputs and computed
+    on node-sized operands BEFORE the edge gather:
+
+        Dense(concat[x_i, x_j, e...]) == Dense_r(x)_i + Dense_s(x)_j
+                                          + sum_k Dense_k(e_k)
+
+    (bias kept only on the receiver projection — one bias total, same as the
+    post-concat layer). The node-side matmuls run on [N, C] instead of
+    [E, 2C]: at degree ~20 that is ~20x fewer MXU FLOPs and half the gather
+    bytes for this layer, with identical function class to the reference's
+    post-concat edge MLPs (e.g. EGCLStack.py:238-247, PNAPlusStack.py:268).
+
+    ``edge_terms`` is an iterable of (name, [E, d] array) extra edge-aligned
+    operands, each getting its own bias-free projection.
+    """
+    out = nn.Dense(dim, name=name_recv)(inv)[batch.receivers]
+    out = out + nn.Dense(dim, use_bias=False, name=name_send)(inv)[batch.senders]
+    for name, arr in edge_terms:
+        out = out + nn.Dense(dim, use_bias=False, name=name)(arr)
+    return out

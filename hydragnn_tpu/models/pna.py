@@ -25,6 +25,7 @@ from ..ops.segment import (
     segment_std,
 )
 from .base import register_conv
+from .layers import hoisted_pair_dense
 
 
 def _avg_deg_stats(deg_hist: Tuple[int, ...]) -> Tuple[float, float]:
@@ -71,20 +72,15 @@ class PNAConv(nn.Module):
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
-        # pre-MLP (pre_layers=1), distributed over the concat and hoisted
-        # BEFORE the edge gather: Dense(concat[x_i, x_j, e]) ==
-        # Dense_r(x)_i + Dense_s(x)_j + Dense_e(e) — node-side matmuls on
-        # [N, C] instead of [E, 2C] (~degree-times fewer MXU FLOPs), same
-        # function class as the reference's post-concat layer.
+        # pre-MLP (pre_layers=1) as a matmul-before-gather layer
+        # (layers.hoisted_pair_dense; reference post-concat: PNAStack.py)
         f_in = inv.shape[-1]
-        msg = (
-            nn.Dense(f_in, name="pre_recv")(inv)[batch.receivers]
-            + nn.Dense(f_in, use_bias=False, name="pre_send")(inv)[batch.senders]
+        terms = (
+            [("pre_edge", batch.edge_attr)]
+            if self.edge_dim and batch.edge_attr is not None
+            else []
         )
-        if self.edge_dim and batch.edge_attr is not None:
-            msg = msg + nn.Dense(f_in, use_bias=False, name="pre_edge")(
-                batch.edge_attr
-            )
+        msg = hoisted_pair_dense(f_in, inv, batch, "pre_recv", "pre_send", terms)
 
         scaled = pna_aggregate(msg, batch, self.deg_hist,
                                self.sorted_agg, self.max_in_degree)

@@ -15,7 +15,7 @@ from flax import linen as nn
 from ..ops.radial import bessel_basis_enveloped, edge_vectors
 from ..ops.segment import segment_sum
 from .base import register_conv
-from .layers import MLP
+from .layers import MLP, hoisted_pair_dense
 from .painn import _vector_state, painn_update
 from .pna import pna_aggregate
 
@@ -47,19 +47,12 @@ class PNAEqConv(nn.Module):
         # pre-MLP over [x_i, x_j, rbf_emb(, edge)] (PNAEqStack.py:268-344),
         # distributed over the concat and hoisted before the edge gather
         # (node matmuls on [N, C], not [E, 2C]; same function class)
-        msg = (
-            nn.Dense(self.node_size, name="pre_recv")(x)[batch.receivers]
-            + nn.Dense(self.node_size, use_bias=False, name="pre_send")(x)[
-                batch.senders
-            ]
-            + nn.Dense(self.node_size, use_bias=False, name="pre_rbf")(
-                nn.tanh(nn.Dense(self.node_size)(rbf))
-            )
-        )
+        terms = [("pre_rbf", nn.tanh(nn.Dense(self.node_size)(rbf)))]
         if self.edge_dim and batch.edge_attr is not None:
-            msg = msg + nn.Dense(
-                self.node_size, use_bias=False, name="pre_attr"
-            )(nn.Dense(self.node_size)(batch.edge_attr))
+            terms.append(("pre_attr", nn.Dense(self.node_size)(batch.edge_attr)))
+        msg = hoisted_pair_dense(
+            self.node_size, x, batch, "pre_recv", "pre_send", terms
+        )
         msg = MLP((self.node_size, self.node_size, 3 * self.node_size),
                   "silu")(nn.tanh(msg))
         # Hadamard with rbf projection, then split for scalar/vector duty

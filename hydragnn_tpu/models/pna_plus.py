@@ -14,6 +14,7 @@ from flax import linen as nn
 
 from ..ops.radial import bessel_basis_enveloped, edge_vectors
 from .base import register_conv
+from .layers import hoisted_pair_dense
 from .pna import pna_aggregate
 
 
@@ -40,12 +41,9 @@ class PNAPlusConv(nn.Module):
             e = nn.Dense(f_in)(jnp.concatenate([batch.edge_attr, rbf_emb], axis=-1))
         else:
             e = rbf_emb
-        # pre-MLP distributed over the concat and hoisted before the edge
-        # gather (node matmuls on [N, C], not [E, 2C]; same function class)
-        msg = (
-            nn.Dense(f_in, name="pre_recv")(inv)[batch.receivers]
-            + nn.Dense(f_in, use_bias=False, name="pre_send")(inv)[batch.senders]
-            + nn.Dense(f_in, use_bias=False, name="pre_edge")(e)
+        # pre-MLP as a matmul-before-gather layer (layers.hoisted_pair_dense)
+        msg = hoisted_pair_dense(
+            f_in, inv, batch, "pre_recv", "pre_send", [("pre_edge", e)]
         )
         # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276)
         msg = msg * nn.Dense(f_in, use_bias=False)(rbf)

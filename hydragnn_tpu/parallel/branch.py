@@ -117,7 +117,9 @@ def _bank_size(params) -> int:
     for k, sub in params.items():
         if _is_decoder_key(k):
             return int(jax.tree_util.tree_leaves(sub)[0].shape[0])
-    raise ValueError("no decoder bank (graph_shared/heads_NN) in params")
+    raise ValueError(
+        f"no decoder bank ({'/'.join(_DECODER_PREFIXES)}) in params"
+    )
 
 
 def _local_model(model, b_local: int):
