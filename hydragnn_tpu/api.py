@@ -206,17 +206,46 @@ def prepare_data(
     # specializations instead of one worst-case padding for every batch
     # (default set by update_config)
     num_buckets = int(training["num_pad_buckets"])
-    spec = SpecLadder.for_dataset(
-        trainset + valset + testset,
-        batch_size // num_shards,
-        num_buckets=num_buckets,
-        with_triplets=arch["mpnn_type"] == "DimeNet",
-    )
+    # opt-in size-homogeneous batch composition; measured on the OC20-shaped
+    # distribution it LOSES to random batching at batch sizes >= 32 (CLT
+    # already concentrates random batch totals; docs/PERFORMANCE.md), so the
+    # default stays off — the ladder simulation must match the policy either
+    # way, or bucketed small batches never fit a level
+    size_bucketing = bool(training.get("size_bucketed_batching", False))
+    # packed batching: greedy bin-packing into ONE fixed budget, variable
+    # real-graph count per batch — a single jit specialization at ~95%
+    # occupancy; multi-host epoch lengths agree communication-free via
+    # simulated packing of every host's slice (docs/PERFORMANCE.md)
+    pack = bool(training.get("pack_batches", False))
+    if pack:
+        # ONE budget over all three splits, so eval reuses the train step's
+        # compilation (the whole point of pack mode; per-split auto budgets
+        # would each be their own jit specialization)
+        from .data.pipeline import _pack_spec
+
+        spec = _pack_spec(
+            trainset + valset + testset, max(batch_size // num_shards, 1)
+        )
+    else:
+        spec = SpecLadder.for_dataset(
+            trainset + valset + testset,
+            batch_size // num_shards,
+            num_buckets=num_buckets,
+            with_triplets=arch["mpnn_type"] == "DimeNet",
+            size_bucketing=size_bucketing,
+        )
+    if pack and arch["mpnn_type"] == "DimeNet":
+        raise ValueError(
+            "Training.pack_batches does not support DimeNet's triplet "
+            "channel yet (auto budgets don't size it); use num_pad_buckets"
+        )
     shard_kw = dict(
         spec=spec,
+        pack=pack,
         host_count=host_count,
         host_index=host_index,
         num_shards=num_shards,
+        size_bucketing=size_bucketing,
         # receiver-sorted edges feed the Pallas segment kernel (TPU). No
         # max_in_degree here: update_config already validated the dataset's
         # top in-degree against the bound (config.py:194-207); the loader
@@ -240,6 +269,12 @@ def prepare_data(
         and num_branches > 1
         and num_shards > 1
     ):
+        if pack:
+            raise ValueError(
+                "Training.pack_batches is not supported with branch_parallel "
+                "(branch-routed rows need fixed graph counts); use "
+                "num_pad_buckets"
+            )
         # branch-parallel decoders need branch-routed shard rows
         # (parallel/branch.py BranchRoutedLoader); ONE worst-case spec over
         # all splits so eval reuses the train step's compilation

@@ -199,6 +199,8 @@ class SpecLadder:
         with_triplets: bool = False,
         num_sim: int = 256,
         seed: int = 0,
+        size_bucketing: bool = False,
+        bucket_window: int = 16,
     ) -> "SpecLadder":
         # one scan of per-graph sizes serves both the worst-case spec and the
         # quantile levels (triplet counting in particular is O(E) per graph)
@@ -223,9 +225,27 @@ class SpecLadder:
         if num_buckets <= 1 or len(graphs) <= batch_size:
             return SpecLadder((worst,))
         rng = np.random.default_rng(seed)
-        picks = np.stack(
-            [rng.choice(len(graphs), size=k, replace=False) for _ in range(num_sim)]
-        )
+        if size_bucketing:
+            # simulate the loader's size-bucketed batch composition
+            # (pipeline.GraphLoader._bucket_order): levels must be quantiles
+            # of the totals batches will ACTUALLY have — bucketed batches of
+            # small graphs need levels far below the random-batch median
+            picks_l: List[np.ndarray] = []
+            w = max(bucket_window * k, k)
+            while len(picks_l) < num_sim:
+                order = rng.permutation(len(graphs))
+                for s in range(0, len(order) - k + 1, w):
+                    win = order[s : s + w]
+                    win = win[np.argsort(n_sizes[win], kind="stable")]
+                    picks_l.extend(
+                        win[b : b + k]
+                        for b in range(0, len(win) - k + 1, k)
+                    )
+            picks = np.stack(picks_l[:num_sim])
+        else:
+            picks = np.stack(
+                [rng.choice(len(graphs), size=k, replace=False) for _ in range(num_sim)]
+            )
         node_tot = n_sizes[picks].sum(axis=1)
         edge_tot = e_sizes[picks].sum(axis=1)
         trip_tot = t_sizes[picks].sum(axis=1) if t_sizes is not None else None

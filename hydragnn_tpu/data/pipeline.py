@@ -19,11 +19,28 @@ from .graph import (
     GraphBatch,
     PadSpec,
     SpecLadder,
+    _round_up,
     _triplet_count,
     batch_graphs,
     batch_graphs_np,
     graph_batch_from_np,
 )
+
+
+def _pack_spec(graphs: Sequence[Graph], per_shard: int) -> PadSpec:
+    """Budget spec for packed batching: mean-size * per_shard (+5% headroom),
+    never below the largest single graph, with 2x graph slots so bins of
+    small graphs aren't cut short by the slot cap. Triplet channels are not
+    auto-sized — DimeNet callers pass an explicit spec."""
+    ns = np.asarray([g.num_nodes for g in graphs])
+    es = np.asarray([g.num_edges for g in graphs])
+    budget_n = max(int(ns.mean() * per_shard * 1.05) + 2, int(ns.max()) + 2)
+    budget_e = max(int(es.mean() * per_shard * 1.05) + 1, int(es.max()) + 1)
+    return PadSpec(
+        n_nodes=_round_up(budget_n, 8),
+        n_edges=_round_up(budget_e, 128),
+        n_graphs=2 * per_shard + 1,
+    )
 
 
 @dataclasses.dataclass
@@ -242,6 +259,9 @@ class GraphLoader:
         sort_edges: bool = False,
         max_in_degree: Optional[int] = None,
         prefetch: int = 0,
+        size_bucketing: bool = False,
+        bucket_window: int = 16,
+        pack: bool = False,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -261,9 +281,29 @@ class GraphLoader:
                 f"{num_shards} (each device takes batch_size/num_shards graphs)"
             )
         per_shard = max(batch_size // num_shards, 1)
-        if spec is None:
+        # packed mode: batches are formed by greedy bin-packing into ONE
+        # fixed node/edge budget with a VARIABLE real-graph count (graph
+        # slots are padded and masked like everything else). One PadSpec =
+        # one jit specialization — no ladder, no per-level recompiles —
+        # at ~the same occupancy the ladder reaches (docs/PERFORMANCE.md).
+        self.pack = bool(pack)
+        self._pack_cache = None  # (seed, epoch) -> (bins, agreed length)
+        if self.pack:
+            if isinstance(spec, SpecLadder):
+                spec = spec.specs[-1]
+            self.ladder = SpecLadder(
+                (spec if spec is not None
+                 else _pack_spec(graphs, per_shard),)
+            )
+        elif spec is None:
             self.ladder = SpecLadder.for_dataset(
-                graphs, per_shard, num_buckets=num_buckets
+                graphs,
+                per_shard,
+                num_buckets=num_buckets,
+                # levels must be quantiles of the totals the active batch-
+                # composition policy actually produces
+                size_bucketing=size_bucketing,
+                bucket_window=bucket_window,
             )
         elif isinstance(spec, SpecLadder):
             self.ladder = spec
@@ -319,6 +359,20 @@ class GraphLoader:
         # hydragnn/preprocess/load_data.py:93-203; its core-affinity pinning
         # has no analog here — XLA owns the host threads)
         self.prefetch = int(prefetch)
+        # size-bucketed batch composition: batches drawn from a shuffled
+        # window sorted by node count, so per-batch node totals concentrate
+        # near window-median * batch_size instead of spreading over the full
+        # batch-total distribution — most batches then *fill* their ladder
+        # level and padding waste drops (the big padding-cost lever at
+        # OC20-like size spreads; see docs/PERFORMANCE.md). Batch ORDER is
+        # re-shuffled so SGD still sees random batch sequencing.
+        self.size_bucketing = bool(size_bucketing)
+        self.bucket_window = int(bucket_window)
+        self._node_counts = (
+            np.asarray([g.num_nodes for g in graphs], np.int64)
+            if self.size_bucketing
+            else None
+        )
         self.epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
@@ -326,12 +380,92 @@ class GraphLoader:
         self.epoch = epoch
 
     def __len__(self) -> int:
+        if self.pack:
+            return self._pack_state()[1]
         n = len(self._local_indices())
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
-    def _local_indices(self) -> np.ndarray:
+    def _count_from_ngroups(self, n_groups: int) -> int:
+        """Batch count ``n_groups`` packed bins yield under the current
+        shard/drop_last settings."""
+        if self.num_shards == 1:
+            return max(n_groups - 1, 0) if self.drop_last else n_groups
+        if self.drop_last:
+            return n_groups // self.num_shards
+        return (n_groups + self.num_shards - 1) // self.num_shards
+
+    def _pack_count_for(self, idx: np.ndarray) -> int:
+        """Packed-batch count an index stream yields under current settings."""
+        if self.size_bucketing and len(idx) > self.batch_size:
+            idx = self._bucket_order(idx)
+        return self._count_from_ngroups(len(self._pack_groups(idx)))
+
+    def _pack_state(self) -> Tuple[List[List[int]], int]:
+        """(local bins, agreed epoch length), computed once per (seed, epoch).
+
+        The agreed length needs no communication: the epoch permutation is a
+        pure function of (seed, epoch), so each host simulates every host's
+        packing and takes the min — the packed analog of the equal-shard
+        truncation in _global_indices (surplus bins on faster-packing hosts
+        are dropped, like DistributedSampler's tail)."""
+        key = (self.seed, self.epoch)
+        if self._pack_cache is not None and self._pack_cache[0] == key:
+            return self._pack_cache[1], self._pack_cache[2]
+        idx = self._local_indices()
+        if self.size_bucketing and len(idx) > self.batch_size:
+            idx = self._bucket_order(idx)
+        groups = self._pack_groups(idx)
+        counts = [self._count_from_ngroups(len(groups))]
+        if self.host_count > 1:
+            gidx = self._global_indices()
+            counts.extend(
+                self._pack_count_for(gidx[h :: self.host_count])
+                for h in range(self.host_count)
+                if h != self.host_index
+            )
+        agreed = min(counts)
+        self._pack_cache = (key, groups, agreed)
+        return groups, agreed
+
+    def _pack_groups(self, idx: np.ndarray) -> List[List[int]]:
+        """Greedy stream packing: consecutive samples accumulate into a bin
+        until the next one would overflow the node/edge/triplet budget or the
+        graph-slot cap. Every bin fits ``self.spec`` by construction."""
+        spec = self.spec
+        cap_n, cap_e = spec.n_nodes - 1, spec.n_edges  # -1: dummy node slot
+        cap_g, cap_t = spec.n_graphs - 1, spec.n_triplets
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        n = e = t = 0
+        for i in idx:
+            g = self.graphs[i]
+            gn, ge = g.num_nodes, g.num_edges
+            gt = _triplet_count(g) if cap_t else 0
+            if gn > cap_n or ge > cap_e or (cap_t and gt > cap_t):
+                raise ValueError(
+                    f"graph {i} (nodes={gn}, edges={ge}) exceeds the pack "
+                    f"budget {spec}; pass a larger spec"
+                )
+            if cur and (
+                n + gn > cap_n
+                or e + ge > cap_e
+                or len(cur) >= cap_g
+                or (cap_t and t + gt > cap_t)
+            ):
+                groups.append(cur)
+                cur, n, e, t = [], 0, 0, 0
+            cur.append(int(i))
+            n, e, t = n + gn, e + ge, t + gt
+        if cur:
+            groups.append(cur)
+        return groups
+
+    def _global_indices(self) -> np.ndarray:
+        """The full (permuted) epoch index stream BEFORE host slicing —
+        identical on every host, which is what makes both the equal-shard
+        truncation and the packed-mode lockstep agreement communication-free."""
         rng = np.random.default_rng(self.seed + self.epoch)
         if self.oversampling:
             n = self.num_samples or len(self.graphs)
@@ -349,10 +483,44 @@ class GraphLoader:
             # stay in lockstep (a one-sample imbalance would leave one host
             # issuing an extra collective and deadlock the others)
             idx = idx[: len(idx) // self.host_count * self.host_count]
-        return idx[self.host_index :: self.host_count]
+        return idx
+
+    def _local_indices(self) -> np.ndarray:
+        return self._global_indices()[self.host_index :: self.host_count]
+
+    def _bucket_order(self, idx: np.ndarray) -> np.ndarray:
+        """Reorder ``idx`` so contiguous ``batch_size`` slices are size-
+        homogeneous: sort by node count within shuffled windows of
+        ``bucket_window * batch_size`` samples (the whole set when not
+        shuffling — eval wants maximal packing), then shuffle the order of
+        the resulting full batches."""
+        bs = self.batch_size
+        # the remainder stays OUT of the sorting: a size-sorted tail would
+        # make the final (dropped under drop_last) partial batch
+        # systematically the largest graphs — the input order's tail is
+        # unbiased (shuffled) or matches the plain loader (eval)
+        n_full = len(idx) // bs
+        head, tail = idx[: n_full * bs], idx[n_full * bs :]
+        w = self.bucket_window * bs if self.shuffle else len(head)
+        parts = []
+        for s in range(0, len(head), max(w, bs)):
+            win = head[s : s + max(w, bs)]
+            order = np.argsort(self._node_counts[win], kind="stable")
+            parts.append(win[order])
+        head = np.concatenate(parts) if parts else head
+        if self.shuffle and n_full > 1:
+            rng = np.random.default_rng((self.seed + self.epoch) ^ 0x5EEDB)
+            batch_order = rng.permutation(n_full)
+            head = head.reshape(n_full, bs)[batch_order].reshape(-1)
+        return np.concatenate([head, tail])
 
     def _batches(self) -> Iterator[GraphBatch]:
+        if self.pack:
+            yield from self._packed_batches()
+            return
         idx = self._local_indices()
+        if self.size_bucketing and len(idx) > self.batch_size:
+            idx = self._bucket_order(idx)
         bs = self.batch_size
         n_full = len(idx) // bs
         for b in range(n_full):
@@ -360,6 +528,35 @@ class GraphLoader:
         rem = len(idx) - n_full * bs
         if rem and not self.drop_last:
             yield self._make([self.graphs[i] for i in idx[n_full * bs :]])
+
+    def _packed_batches(self) -> Iterator[GraphBatch]:
+        # multi-host: stop at the globally agreed count so every host issues
+        # the same number of (collective-bearing) steps
+        groups, limit = self._pack_state()
+        emitted = 0
+        if self.num_shards == 1:
+            if self.drop_last and len(groups) > 1:
+                groups = groups[:-1]  # only the final bin can be sparse
+            for grp in groups:
+                if emitted >= limit:
+                    return
+                emitted += 1
+                yield batch_graphs(
+                    [self.graphs[i] for i in grp],
+                    self.spec,
+                    sort_edges=self.sort_edges,
+                )
+            return
+        for c in range(0, len(groups), self.num_shards):
+            chunk = groups[c : c + self.num_shards]
+            if emitted >= limit or (
+                len(chunk) < self.num_shards and self.drop_last
+            ):
+                return
+            emitted += 1
+            yield self._make_stacked(
+                [[self.graphs[i] for i in grp] for grp in chunk], self.spec
+            )
 
     def __iter__(self) -> Iterator[GraphBatch]:
         if self.prefetch <= 0:
@@ -421,6 +618,13 @@ class GraphLoader:
             if with_trip
             else 0,
         )
+        return self._make_stacked(shards, spec)
+
+    def _make_stacked(
+        self, shards: List[List[Graph]], spec: PadSpec
+    ) -> GraphBatch:
+        """Stack per-shard padded batches into a leading device axis;
+        missing shards become all-padding rows."""
         arrs = [
             batch_graphs_np(s, spec, sort_edges=self.sort_edges)
             for s in shards
