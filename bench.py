@@ -21,6 +21,16 @@ import os
 import sys
 import time
 
+# persistent XLA compilation cache: compiles through the axon tunnel are the
+# slowest part of a bench run (20-40s+ per specialization) — a disk cache
+# makes restarts and the driver's round-end run hit warm executables. Must be
+# set before the first jax import (all jax imports here are lazy).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "logs", "xla_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
+
 # graphs/sec/chip recorded at round 1 (BENCH_r01.json) on this chip for the
 # synthetic-PNA workload; used for the vs_baseline regression ratio
 RECORDED_BASELINE = 68055.28
@@ -53,12 +63,16 @@ def _flops_of(step, *args) -> float:
         return 0.0
 
 
-def _production_workload():
+def _production_workload(mixed_precision=None, sorted_aggregation=None):
     """SC25-shaped EGNN on the OC20-shaped dataset, via the real pipeline."""
     from hydragnn_tpu.api import prepare_data
     from hydragnn_tpu.data.pipeline import split_dataset
     from hydragnn_tpu.data.synthetic import oc20_shaped_dataset
 
+    if mixed_precision is None:
+        mixed_precision = os.getenv("BENCH_MP", "1") == "1"
+    if sorted_aggregation is None:
+        sorted_aggregation = os.getenv("BENCH_SORTED", "0") == "1"
     batch_size = int(os.getenv("BENCH_BATCH_SIZE", "32"))
     hidden = int(os.getenv("BENCH_HIDDEN", "866"))
     head_dim = int(os.getenv("BENCH_HEAD_DIM", "889"))
@@ -84,7 +98,7 @@ def _production_workload():
                 "hidden_dim": hidden,
                 "num_conv_layers": 4,
                 # Pallas sorted-segment aggregation A/B (BENCH_SORTED=1)
-                "use_sorted_aggregation": os.getenv("BENCH_SORTED", "0") == "1",
+                "use_sorted_aggregation": sorted_aggregation,
                 "task_weights": [1.0, 100.0],
                 "output_heads": {
                     "graph": {
@@ -110,9 +124,15 @@ def _production_workload():
                 "batch_size": batch_size,
                 "num_epoch": 1,
                 "loss_function_type": "mae",
-                "num_pad_buckets": 3,
+                # fill measured on the OC20-shaped distribution: 6 levels
+                # reach 97% node / 96% edge occupancy vs 92/90 at 3 (random
+                # batching + quantile ladder; see docs/PERFORMANCE.md)
+                "num_pad_buckets": int(os.getenv("BENCH_PAD_BUCKETS", "6")),
+                # BENCH_PACK=1: packed batching — ONE spec (one compile,
+                # the dominant cost through the tunnel) at ~95% fill
+                "pack_batches": os.getenv("BENCH_PACK", "0") == "1",
                 # bf16 compute vs f32 master weights (BENCH_MP=0 for f32)
-                "mixed_precision": os.getenv("BENCH_MP", "1") == "1",
+                "mixed_precision": mixed_precision,
                 "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
             },
         },
@@ -121,14 +141,17 @@ def _production_workload():
     return config, train_loader
 
 
-def _bench_production():
+def _bench_production(mixed_precision=None, sorted_aggregation=None,
+                      profile=None):
     import jax
     import numpy as np
 
     from hydragnn_tpu.models import create_model, init_model
     from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
 
-    config, loader = _production_workload()
+    if profile is None:
+        profile = os.getenv("BENCH_PROFILE", "0") == "1"
+    config, loader = _production_workload(mixed_precision, sorted_aggregation)
     batches = list(loader)
     model = create_model(config)
     variables = init_model(model, batches[0], seed=0)
@@ -163,7 +186,7 @@ def _bench_production():
 
     # BENCH_PROFILE=1: one xprof trace of a few steady-state steps into
     # logs/bench_profile (drives the MFU work — find the top non-matmul op)
-    if os.getenv("BENCH_PROFILE", "0") == "1":
+    if profile:
         os.makedirs("logs/bench_profile", exist_ok=True)
         with jax.profiler.trace("logs/bench_profile"):
             for b, r in list(zip(batches, rngs))[:8]:
@@ -257,7 +280,88 @@ def _probe_device(timeout_s: int = 180) -> bool:
         return False
 
 
+def main_ab():
+    """All four mixed_precision x sorted_aggregation cells in ONE process.
+
+    The axon tunnel's pool-side server has wedged mid-round on fresh PJRT
+    clients (each new python process is a new client; see BASELINE.md round-3
+    notes) — running the whole matrix in a single client avoids the
+    reconnect-churn trigger entirely. Emits one JSON line per cell (same
+    schema as main()) plus a final summary line; appends to
+    logs/ab_matrix.jsonl as it goes so a later wedge doesn't lose cells."""
+    import gc
+    import signal
+
+    os.makedirs("logs", exist_ok=True)
+    out_path = os.path.join("logs", "ab_matrix.jsonl")
+
+    # outage-as-data without the probe subprocess (a probe would be an extra
+    # PJRT client — the reconnect churn suspected of wedging the pool): an
+    # alarm bounds the FIRST device contact in-process; once one op has
+    # completed the tunnel is up and the alarm is disarmed
+    def _wedged(signum, frame):
+        print(
+            json.dumps(
+                {
+                    "metric": "OC20-S2EF-shaped A/B matrix",
+                    "value": 0.0,
+                    "unit": "graphs/sec/chip",
+                    "vs_baseline": 0.0,
+                    "error": (
+                        "device unreachable: first device op did not "
+                        "complete within 300s (known pool-side wedge)"
+                    ),
+                }
+            ),
+            flush=True,
+        )
+        os._exit(2)
+
+    signal.signal(signal.SIGALRM, _wedged)
+    signal.alarm(300)
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.ones((8, 8)).sum())
+    signal.alarm(0)
+
+    syn = _bench_synthetic_pna()  # small leg first: big HBM footprint skews it
+    results = {}
+    for mp in (True, False):
+        for sorted_agg in (False, True):
+            # profile only the production default cell (mp on, sorted off)
+            prod = _bench_production(
+                mixed_precision=mp,
+                sorted_aggregation=sorted_agg,
+                profile=(mp and not sorted_agg
+                         and os.getenv("BENCH_PROFILE", "0") == "1"),
+            )
+            line = json.dumps(
+                {
+                    "metric": "OC20-S2EF-shaped A/B cell",
+                    "value": round(prod["graphs_per_sec"], 2),
+                    "unit": "graphs/sec/chip",
+                    "mfu": round(prod["mfu"], 4),
+                    "flops_per_graph": round(prod["flops_per_graph"]),
+                    "train_loss": round(prod["loss"], 5),
+                    "mixed_precision": mp,
+                    "sorted_aggregation": sorted_agg,
+                    "vs_baseline": round(syn / RECORDED_BASELINE, 3),
+                    "synthetic_pna_graphs_per_sec": round(syn, 2),
+                }
+            )
+            print(line, flush=True)
+            with open(out_path, "a") as fh:
+                fh.write(line + "\n")
+            results[(mp, sorted_agg)] = prod["graphs_per_sec"]
+            gc.collect()
+    print(json.dumps({"metric": "ab_matrix_done", "cells": len(results)}))
+
+
 def main():
+    if os.getenv("BENCH_AB", "0") == "1":
+        main_ab()
+        return
     if not _probe_device():
         print(
             json.dumps(

@@ -1,15 +1,12 @@
 #!/usr/bin/env bash
 # 4-cell perf A/B on the real chip: mixed_precision x sorted_aggregation.
-# Appends one JSON line per cell to logs/ab_matrix.jsonl; run on a host with
-# the TPU reachable (bench.py probes first and records an outage as data).
+# Runs ALL cells in ONE python process (BENCH_AB=1): every new process is a
+# fresh PJRT client, and the axon pool has wedged mid-round on client
+# reconnect churn (BASELINE.md round-3 notes) — a single client avoids the
+# trigger. Cells append to logs/ab_matrix.jsonl as they finish, so a wedge
+# mid-matrix still keeps the completed cells.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 mkdir -p logs
-for MP in 1 0; do
-  for SORTED in 0 1; do
-    echo "== BENCH_MP=$MP BENCH_SORTED=$SORTED ==" >&2
-    BENCH_MP=$MP BENCH_SORTED=$SORTED python bench.py \
-      | tee -a logs/ab_matrix.jsonl
-  done
-done
+BENCH_AB=1 BENCH_PROFILE="${BENCH_PROFILE:-1}" python bench.py
 echo "A/B matrix done -> logs/ab_matrix.jsonl" >&2
