@@ -175,6 +175,64 @@ def _weighted_avg(entries: List[Tuple[float, Dict[str, float], int]]):
     return tot, tasks
 
 
+def device_prefetch(iterator, depth: int = 2, device=None):
+    """Double-buffered device staging: a background thread ``device_put``s
+    upcoming batches so the H2D copy overlaps the current step's compute.
+    The reference pays this cost inline every step (``data.to(device)``,
+    train_validate_test.py:514); async dispatch hides *compute* but the
+    transfer itself still serializes with the dispatching thread — staging
+    from a second thread takes it off the critical path entirely.
+
+    Single-device only at the call sites (sharded stacked batches are placed
+    by the parallel step's own sharding logic)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(int(depth), 1))
+    stop = threading.Event()
+    _END, _ERR = object(), object()
+
+    def put_or_stop(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for batch in iterator:
+                if not put_or_stop(jax.device_put(batch, device)):
+                    return
+            put_or_stop(_END)
+        except BaseException as e:  # surfaced in the consumer
+            put_or_stop((_ERR, e))
+
+    threading.Thread(target=producer, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+                raise item[1]
+            yield item
+    finally:
+        stop.set()
+
+
+def _maybe_device_prefetch(iterator):
+    """Wrap with device_prefetch on single-device runs (multi-device batch
+    placement belongs to the parallel step); HYDRAGNN_DEVICE_PREFETCH=0
+    disables, a positive value sets the queue depth."""
+    depth = int(os.getenv("HYDRAGNN_DEVICE_PREFETCH", "2"))
+    if depth <= 0 or jax.local_device_count() > 1 or jax.process_count() > 1:
+        return iterator
+    return device_prefetch(iterator, depth=depth)
+
+
 def train_epoch(loader, step_fn, state, rng):
     from ..utils import tracer as tr
 
@@ -185,7 +243,7 @@ def train_epoch(loader, step_fn, state, rng):
     # serialize the pipeline — the reference tolerates this because torch
     # .item() overlaps with DDP bucket comms, XLA does not).
     entries = []
-    it = iter(loader)
+    it = _maybe_device_prefetch(iter(loader))
     for i in range(len(loader)):
         # dataload span covers host batching + H2D staging (the reference's
         # per-step data.to(device), train_validate_test.py:506-514; here the
@@ -200,7 +258,8 @@ def train_epoch(loader, step_fn, state, rng):
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
         state, tot, tasks = step_fn(state, batch, sub)
-        # graph_mask is a host numpy array from the loader — no device sync
+        # graph_mask is loader data (host numpy, or an already-transferred
+        # leaf under device_prefetch) — reading it never waits on compute
         n = int(np.asarray(batch.graph_mask).sum())
         tr.stop("train_step")
         entries.append((tot, tasks, n))
@@ -219,7 +278,7 @@ def train_epoch(loader, step_fn, state, rng):
 
 def evaluate(loader, eval_fn, state):
     entries = []
-    for batch in loader:
+    for batch in _maybe_device_prefetch(iter(loader)):
         tot, tasks, _ = eval_fn(state, batch)
         n = int(np.asarray(batch.graph_mask).sum())
         entries.append((tot, tasks, n))

@@ -238,3 +238,41 @@ def pytest_print_model_summary(capsys):
     # silent at low verbosity, still returns the count
     assert print_model(variables, verbosity=0) == 24
     assert "Total" not in capsys.readouterr().out
+
+
+def pytest_device_prefetch_equivalence():
+    """device_prefetch yields the same batches in the same order as plain
+    iteration (as device arrays), surfaces producer errors, and releases its
+    thread when abandoned mid-epoch."""
+    import numpy as np
+
+    from hydragnn_tpu.data import GraphLoader, deterministic_graph_dataset
+    from hydragnn_tpu.train.loop import device_prefetch
+
+    graphs = deterministic_graph_dataset(24, seed=7)
+    plain = list(GraphLoader(graphs, 6, seed=0))
+    pre = list(device_prefetch(iter(GraphLoader(graphs, 6, seed=0)), depth=2))
+    assert len(plain) == len(pre)
+    for a, b in zip(plain, pre):
+        np.testing.assert_array_equal(np.asarray(a.x), np.asarray(b.x))
+        np.testing.assert_array_equal(
+            np.asarray(a.receivers), np.asarray(b.receivers)
+        )
+
+    def boom():
+        yield plain[0]
+        raise RuntimeError("producer boom")
+
+    it = device_prefetch(boom(), depth=1)
+    next(it)
+    try:
+        next(it)
+    except RuntimeError as e:
+        assert "producer boom" in str(e)
+    else:
+        raise AssertionError("expected producer error to surface")
+
+    # abandoned mid-epoch: generator close must not hang
+    it2 = device_prefetch(iter(GraphLoader(graphs, 6, seed=0)), depth=1)
+    next(it2)
+    it2.close()
