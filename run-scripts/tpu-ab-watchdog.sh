@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Retry the single-client A/B matrix until the pool answers or the round
+# ends. Each attempt is exactly ONE PJRT client (minimal reconnect churn —
+# the suspected wedge trigger); bench.py's in-process alarm turns a wedged
+# attempt into rc=2 within 300s, a mid-matrix wedge into a bounded exit
+# with completed cells kept in logs/ab_matrix.jsonl.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p logs
+while true; do
+  BENCH_AB=1 BENCH_PROFILE="${BENCH_PROFILE:-1}" python bench.py \
+    >> logs/ab_watchdog.jsonl 2>> logs/ab_watchdog.err
+  rc=$?
+  echo "$(date -u +%FT%TZ) attempt rc=$rc" >> logs/ab_watchdog.err
+  if [ "$rc" -eq 0 ]; then
+    echo "$(date -u +%FT%TZ) A/B matrix complete" >> logs/ab_watchdog.err
+    exit 0
+  fi
+  sleep "${BENCH_AB_RETRY_SECS:-900}"
+done
