@@ -201,7 +201,12 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
     # logs/bench_profile (drives the MFU work — find the top non-matmul op)
     if profile:
         os.makedirs("logs/bench_profile", exist_ok=True)
-        with jax.profiler.trace("logs/bench_profile"):
+        # perfetto trace alongside the xplane pb: parseable with stdlib
+        # (run-scripts/analyze_trace.py summarizes top device ops + the
+        # matmul vs non-matmul split for the MFU push)
+        with jax.profiler.trace(
+            "logs/bench_profile", create_perfetto_trace=True
+        ):
             for b, r in list(zip(batches, rngs))[:8]:
                 state, tot, _ = step(state, b, r)
             jax.block_until_ready(tot)
