@@ -308,16 +308,23 @@ def main_ab():
     schema as main()) plus a final summary line; appends to
     logs/ab_matrix.jsonl as it goes so a later wedge doesn't lose cells."""
     import gc
-    import signal
+    import threading
 
     os.makedirs("logs", exist_ok=True)
     out_path = os.path.join("logs", "ab_matrix.jsonl")
 
     # outage-as-data without the probe subprocess (a probe would be an extra
-    # PJRT client — the reconnect churn suspected of wedging the pool): an
-    # alarm bounds the FIRST device contact in-process; once one op has
-    # completed the tunnel is up and the alarm is disarmed
-    def _wedged(signum, frame):
+    # PJRT client — the reconnect churn suspected of wedging the pool).
+    # NOT signal.alarm: a wedged device op blocks the MAIN thread inside a
+    # C recv, and CPython only runs signal handlers between bytecodes on
+    # the main thread — the handler would never fire (observed: a 300s
+    # alarm never interrupted a 30-minute wedge). A watcher THREAD calling
+    # os._exit terminates regardless of what the main thread is stuck in.
+    deadline = {"t": time.monotonic() + 300.0}
+
+    def _watch():
+        while time.monotonic() < deadline["t"]:
+            time.sleep(1.0)
         print(
             json.dumps(
                 {
@@ -326,7 +333,7 @@ def main_ab():
                     "unit": "graphs/sec/chip",
                     "vs_baseline": 0.0,
                     "error": (
-                        "device wedge: a device op exceeded the alarm guard "
+                        "device wedge: a device op exceeded the guard "
                         "(300s before first contact, BENCH_AB_GUARD_SECS "
                         "for the whole matrix); completed cells are in "
                         "logs/ab_matrix.jsonl"
@@ -337,16 +344,17 @@ def main_ab():
         )
         os._exit(2)
 
-    signal.signal(signal.SIGALRM, _wedged)
-    signal.alarm(300)
+    threading.Thread(target=_watch, daemon=True).start()
     import jax
     import jax.numpy as jnp
 
     jax.block_until_ready(jnp.ones((8, 8)).sum())
-    # tunnel is up — re-arm a generous whole-run guard instead of
-    # disarming: a mid-matrix wedge must still terminate the process with
-    # the completed cells on disk, not hang until the round ends
-    signal.alarm(int(os.getenv("BENCH_AB_GUARD_SECS", "5400")))
+    # tunnel is up — extend to a generous whole-run guard: a mid-matrix
+    # wedge must still terminate the process with the completed cells on
+    # disk, not hang until the round ends
+    deadline["t"] = time.monotonic() + float(
+        os.getenv("BENCH_AB_GUARD_SECS", "5400")
+    )
 
     syn = _bench_synthetic_pna()  # small leg first: big HBM footprint skews it
     # 4-cell mixed_precision x sorted_aggregation matrix, then the packed-
@@ -392,7 +400,7 @@ def main_ab():
             fh.write(line + "\n")
         n_done += 1
         gc.collect()
-    signal.alarm(0)
+    deadline["t"] = float("inf")
     print(json.dumps({"metric": "ab_matrix_done", "cells": n_done}))
 
 
