@@ -43,17 +43,70 @@ def irrep_slice(l: int) -> slice:
     return slice(l * l, (l + 1) * (l + 1))
 
 
+def _double_fact(n: int) -> float:
+    out = 1.0
+    while n > 1:
+        out *= n
+        n -= 2
+    return out
+
+
+def _real_sph_harm_general(u: jnp.ndarray, lmax: int) -> jnp.ndarray:
+    """Arbitrary-``lmax`` component-normalized real spherical harmonics of
+    unit vectors via the reduced associated-Legendre recurrence.
+
+    Everything is a POLYNOMIAL in (x, y, z): the azimuthal factors
+    ``c_m = Re[(x+iy)^m]`` / ``s_m = Im[(x+iy)^m]`` absorb the sin^m(theta)
+    of P_l^m, and the reduced ``Q_l^m(z) = P_l^m / sin^m`` follows
+    ``(l-m) Q_l^m = (2l-1) z Q_{l-1}^m - (l+m-1) Q_{l-2}^m`` with
+    ``Q_m^m = (2m-1)!!`` — so there is no pole sqrt and autograd forces
+    stay smooth everywhere (the closed forms below are the same
+    polynomials, hand-expanded for l <= 3)."""
+    x, y, z = u[..., 0], u[..., 1], u[..., 2]
+    cs = [(jnp.ones_like(x), jnp.zeros_like(x))]
+    for m in range(1, lmax + 1):
+        cp, sp = cs[-1]
+        cs.append((cp * x - sp * y, cp * y + sp * x))
+    q: Dict[Tuple[int, int], jnp.ndarray] = {}
+    for m in range(0, lmax + 1):
+        q[(m, m)] = jnp.full_like(z, _double_fact(2 * m - 1))
+        if m + 1 <= lmax:
+            q[(m + 1, m)] = (2 * m + 1) * z * q[(m, m)]
+        for l in range(m + 2, lmax + 1):
+            q[(l, m)] = (
+                (2 * l - 1) * z * q[(l - 1, m)]
+                - (l + m - 1) * q[(l - 2, m)]
+            ) / (l - m)
+    out = []
+    for l in range(lmax + 1):
+        for m in range(-l, l + 1):
+            am = abs(m)
+            norm = math.sqrt((2 * l + 1) * _fact(l - am) / _fact(l + am))
+            if m != 0:
+                norm *= math.sqrt(2.0)
+            base = norm * q[(l, am)]
+            if m < 0:
+                out.append(base * cs[am][1])
+            elif m == 0:
+                out.append(base)
+            else:
+                out.append(base * cs[am][0])
+    return jnp.stack(out, axis=-1)
+
+
 def real_sph_harm(vec: jnp.ndarray, lmax: int, eps: float = 1e-12) -> jnp.ndarray:
     """Component-normalized real spherical harmonics of (auto-normalized)
     3-vectors. vec: [..., 3] -> [..., (lmax+1)^2].
 
     Replaces e3nn ``o3.SphericalHarmonics(normalize=True,
-    normalization="component")`` (reference: MACEStack.py:146-150).
+    normalization="component")`` (reference: MACEStack.py:146-150) at
+    arbitrary ``lmax``: hand-expanded closed forms for l <= 3 (the MACE
+    default max_ell range), the Legendre-recurrence path beyond.
     """
-    if lmax > 3:
-        raise NotImplementedError("real_sph_harm implemented for lmax <= 3")
     n = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
     u = vec / n
+    if lmax > 3:
+        return _real_sph_harm_general(u, lmax)
     x, y, z = u[..., 0], u[..., 1], u[..., 2]
     out = [jnp.ones_like(x)]
     if lmax >= 1:
