@@ -469,3 +469,20 @@ def pytest_train_pack_batches(tmp_path, monkeypatch):
     config = make_config("PNA", num_epoch=30)
     config["NeuralNetwork"]["Training"]["pack_batches"] = True
     _check_thresholds(config, tmp_path, monkeypatch)
+
+
+def pytest_train_pack_gps_sorted_composition(tmp_path, monkeypatch):
+    """Feature interplay: packed batching x GPS global attention x Pallas
+    sorted aggregation (interpret mode on CPU) in ONE training run — the
+    three perf paths compose with variable real-graph counts per batch."""
+    config = make_config(
+        "PNA",
+        num_epoch=25,
+        global_attn_engine="GPS",
+        global_attn_type="multihead",
+        global_attn_heads=8,
+        pe_dim=1,
+        use_sorted_aggregation=True,
+    )
+    config["NeuralNetwork"]["Training"]["pack_batches"] = True
+    _check_thresholds(config, tmp_path, monkeypatch)
