@@ -372,14 +372,35 @@ def main_ab():
     n_done = 0
     for cell in cells:
         mp, sorted_agg = cell["mp"], cell["sorted"]
-        prod = _bench_production(
-            mixed_precision=mp,
-            sorted_aggregation=sorted_agg,
-            # profile only the production default cell (mp on, sorted off)
-            profile=(mp and not sorted_agg and "env" not in cell
-                     and os.getenv("BENCH_PROFILE", "0") == "1"),
-            env_overrides=cell.get("env"),
-        )
+        try:
+            prod = _bench_production(
+                mixed_precision=mp,
+                sorted_aggregation=sorted_agg,
+                # profile only the production default cell (mp on, sorted off)
+                profile=(mp and not sorted_agg and "env" not in cell
+                         and os.getenv("BENCH_PROFILE", "0") == "1"),
+                env_overrides=cell.get("env"),
+            )
+        except Exception as e:
+            # a failing cell (e.g. an OOM at batch 64) must not sink the
+            # matrix — record it as data and move on, or the watchdog would
+            # retry the whole run forever
+            err_line = json.dumps(
+                {
+                    "metric": "OC20-S2EF-shaped A/B cell",
+                    "value": 0.0,
+                    "unit": "graphs/sec/chip",
+                    "mixed_precision": mp,
+                    "sorted_aggregation": sorted_agg,
+                    **({"variant": cell["tag"]} if "tag" in cell else {}),
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            )
+            print(err_line, flush=True)
+            with open(out_path, "a") as fh:
+                fh.write(err_line + "\n")
+            gc.collect()
+            continue
         line = json.dumps(
             {
                 "metric": "OC20-S2EF-shaped A/B cell",
