@@ -224,7 +224,9 @@ def prepare_data(
         from .data.pipeline import _pack_spec
 
         spec = _pack_spec(
-            trainset + valset + testset, max(batch_size // num_shards, 1)
+            trainset + valset + testset,
+            max(batch_size // num_shards, 1),
+            with_triplets=arch["mpnn_type"] == "DimeNet",
         )
     else:
         spec = SpecLadder.for_dataset(
@@ -233,11 +235,6 @@ def prepare_data(
             num_buckets=num_buckets,
             with_triplets=arch["mpnn_type"] == "DimeNet",
             size_bucketing=size_bucketing,
-        )
-    if pack and arch["mpnn_type"] == "DimeNet":
-        raise ValueError(
-            "Training.pack_batches does not support DimeNet's triplet "
-            "channel yet (auto budgets don't size it); use num_pad_buckets"
         )
     shard_kw = dict(
         spec=spec,

@@ -27,19 +27,28 @@ from .graph import (
 )
 
 
-def _pack_spec(graphs: Sequence[Graph], per_shard: int) -> PadSpec:
+def _pack_spec(
+    graphs: Sequence[Graph], per_shard: int, with_triplets: bool = False
+) -> PadSpec:
     """Budget spec for packed batching: mean-size * per_shard (+5% headroom),
     never below the largest single graph, with 2x graph slots so bins of
-    small graphs aren't cut short by the slot cap. Triplet channels are not
-    auto-sized — DimeNet callers pass an explicit spec."""
+    small graphs aren't cut short by the slot cap. ``with_triplets`` also
+    budgets the DimeNet triplet channel (counted per graph, O(E) each)."""
     ns = np.asarray([g.num_nodes for g in graphs])
     es = np.asarray([g.num_edges for g in graphs])
     budget_n = max(int(ns.mean() * per_shard * 1.05) + 2, int(ns.max()) + 2)
     budget_e = max(int(es.mean() * per_shard * 1.05) + 1, int(es.max()) + 1)
+    n_triplets = 0
+    if with_triplets:
+        ts = np.asarray([_triplet_count(g) for g in graphs])
+        n_triplets = _round_up(
+            max(int(ts.mean() * per_shard * 1.05) + 1, int(ts.max()) + 1), 128
+        )
     return PadSpec(
         n_nodes=_round_up(budget_n, 8),
         n_edges=_round_up(budget_e, 128),
         n_graphs=2 * per_shard + 1,
+        n_triplets=n_triplets,
     )
 
 

@@ -486,3 +486,16 @@ def pytest_train_pack_gps_sorted_composition(tmp_path, monkeypatch):
     )
     config["NeuralNetwork"]["Training"]["pack_batches"] = True
     _check_thresholds(config, tmp_path, monkeypatch)
+
+
+def pytest_train_pack_batches_dimenet(tmp_path, monkeypatch):
+    """Packed batching with DimeNet: the triplet channel is budgeted in the
+    single pack spec (bins respect node/edge/triplet caps); short run, loss
+    must decrease."""
+    config = make_config("DimeNet", num_epoch=10, num_configs=60)
+    config["NeuralNetwork"]["Training"]["pack_batches"] = True
+    monkeypatch.chdir(tmp_path)
+    model, state, hist, cfg, loaders, mm = run_training(config)
+    assert hist["train"][-1] < hist["train"][0]
+    tl = loaders[0]
+    assert len(tl.ladder.specs) == 1 and tl.spec.n_triplets > 0
