@@ -33,7 +33,11 @@ class Profiler:
             import jax
 
             os.makedirs(self.log_dir, exist_ok=True)
-            jax.profiler.start_trace(self.log_dir)
+            # perfetto alongside the xplane pb: stdlib-parseable
+            # (run-scripts/analyze_trace.py rolls up device op time)
+            jax.profiler.start_trace(
+                self.log_dir, create_perfetto_trace=True
+            )
             self._active = True
 
     def epoch_end(self, epoch: int) -> None:
