@@ -16,8 +16,9 @@ from hydragnn_tpu.api import run_prediction, run_training
 # Fast CI tier: HYDRAGNN_CI_FAST=1 runs the same full 13-model matrix with
 # half the epochs and 2x-relaxed thresholds — still fails on broken models
 # (errors on normalized targets sit near 1.0 when learning is broken) at
-# roughly 20% less wall-clock than full tier; pytest-xdist (-n 4) is the
-# real lever (VERDICT r1 next-steps #10).
+# roughly 20% less wall-clock than full tier (xdist workers measured
+# slower: XLA's threadpool already saturates the cores) (VERDICT r1
+# next-steps #10).
 _FAST = os.getenv("HYDRAGNN_CI_FAST") == "1"
 
 
