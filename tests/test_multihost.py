@@ -53,6 +53,22 @@ _CHILD = textwrap.dedent(
     e3 = multihost_utils.process_allgather(np.asarray(loader._local_indices()))
     assert np.array_equal(np.sort(np.asarray(e3).ravel()), np.arange(40))
 
+    # packed batching lockstep across REAL processes: both hosts derive the
+    # same epoch length with NO communication (each simulates every host's
+    # packing, data/pipeline.py _pack_state) and iterate exactly that many
+    # batches
+    from hydragnn_tpu.data.synthetic import oc20_shaped_dataset
+
+    pgraphs = oc20_shaped_dataset(60)
+    pl = GraphLoader(
+        pgraphs, 8, pack=True, seed=0,
+        host_count=host_count, host_index=host_index,
+    )
+    plens = np.asarray(
+        multihost_utils.process_allgather(np.asarray([len(pl)]))
+    ).ravel()
+    assert plens[0] == plens[1] == len(list(pl)), plens
+
     # cross-host max reduction used by the edge-length normalization
     from hydragnn_tpu.data.transforms import global_max_edge_attr
     from hydragnn_tpu.data.graph import Graph
