@@ -423,6 +423,11 @@ def main_ab():
         gc.collect()
     deadline["t"] = float("inf")
     print(json.dumps({"metric": "ab_matrix_done", "cells": n_done}))
+    if n_done == 0:
+        # every cell failed (e.g. the pool raised instead of hanging):
+        # exit nonzero so the watchdog keeps retrying — rc=0 means
+        # "matrix complete", and zero measured cells is not that
+        sys.exit(3)
 
 
 def main():

@@ -297,6 +297,12 @@ class GraphLoader:
         # at ~the same occupancy the ladder reaches (docs/PERFORMANCE.md).
         self.pack = bool(pack)
         self._pack_cache = None  # (seed, epoch) -> (bins, agreed length)
+        # per-graph triplet counts, computed at most ONCE per loader:
+        # _triplet_count is O(E) interpreted python per graph, and the
+        # packing/ladder paths would otherwise recompute it every epoch
+        # (times host_count lockstep simulations) and again per batch
+        self._trip_counts: Optional[np.ndarray] = None
+        self._trip_by_id: Dict[int, int] = {}
         if self.pack:
             if isinstance(spec, SpecLadder):
                 spec = spec.specs[-1]
@@ -405,6 +411,22 @@ class GraphLoader:
             return n_groups // self.num_shards
         return (n_groups + self.num_shards - 1) // self.num_shards
 
+    def _trip_count_table(self) -> np.ndarray:
+        """Lazy one-time scan: triplet count per dataset graph (also memoized
+        by object id for the _make shard-spec lookup)."""
+        if self._trip_counts is None:
+            self._trip_counts = np.asarray(
+                [_triplet_count(g) for g in self.graphs], np.int64
+            )
+            self._trip_by_id = {
+                id(g): int(c) for g, c in zip(self.graphs, self._trip_counts)
+            }
+        return self._trip_counts
+
+    def _trip_count_of(self, g: Graph) -> int:
+        got = self._trip_by_id.get(id(g))
+        return _triplet_count(g) if got is None else got
+
     def _pack_count_for(self, idx: np.ndarray) -> int:
         """Packed-batch count an index stream yields under current settings."""
         if self.size_bucketing and len(idx) > self.batch_size:
@@ -445,13 +467,14 @@ class GraphLoader:
         spec = self.spec
         cap_n, cap_e = spec.n_nodes - 1, spec.n_edges  # -1: dummy node slot
         cap_g, cap_t = spec.n_graphs - 1, spec.n_triplets
+        trips = self._trip_count_table() if cap_t else None
         groups: List[List[int]] = []
         cur: List[int] = []
         n = e = t = 0
         for i in idx:
             g = self.graphs[i]
             gn, ge = g.num_nodes, g.num_edges
-            gt = _triplet_count(g) if cap_t else 0
+            gt = int(trips[i]) if cap_t else 0
             if gn > cap_n or ge > cap_e or (cap_t and gt > cap_t):
                 raise ValueError(
                     f"graph {i} (nodes={gn}, edges={ge}) exceeds the pack "
@@ -612,18 +635,26 @@ class GraphLoader:
             stop.set()
 
     def _make(self, graphs: List[Graph]) -> GraphBatch:
+        with_trip = bool(self.spec.n_triplets)
+        if with_trip:
+            self._trip_count_table()  # populate the id memo once
         if self.num_shards == 1:
-            return batch_graphs(
-                graphs, self.ladder.select_for(graphs), sort_edges=self.sort_edges
+            spec = self.ladder.select(
+                sum(g.num_nodes for g in graphs),
+                sum(g.num_edges for g in graphs),
+                sum(self._trip_count_of(g) for g in graphs) if with_trip else 0,
             )
+            return batch_graphs(graphs, spec, sort_edges=self.sort_edges)
         shards = [graphs[s :: self.num_shards] for s in range(self.num_shards)]
         # one spec for the whole stacked batch: the smallest level fitting
         # the largest shard (all shards must share static shapes)
-        with_trip = bool(self.spec.n_triplets)
         spec = self.ladder.select(
             max(sum(g.num_nodes for g in s) for s in shards if s),
             max(sum(g.num_edges for g in s) for s in shards if s),
-            max((sum(_triplet_count(g) for g in s) for s in shards if s), default=0)
+            max(
+                (sum(self._trip_count_of(g) for g in s) for s in shards if s),
+                default=0,
+            )
             if with_trip
             else 0,
         )

@@ -101,7 +101,11 @@ def real_sph_harm(vec: jnp.ndarray, lmax: int, eps: float = 1e-12) -> jnp.ndarra
     Replaces e3nn ``o3.SphericalHarmonics(normalize=True,
     normalization="component")`` (reference: MACEStack.py:146-150) at
     arbitrary ``lmax``: hand-expanded closed forms for l <= 3 (the MACE
-    default max_ell range), the Legendre-recurrence path beyond.
+    default max_ell range), the Legendre-recurrence path beyond. The two
+    paths are the same polynomials (tests pin them to 2e-5), but NOT
+    bitwise: the closed forms stay the l <= 3 default so existing
+    fixed-seed training results (the accuracy matrix, pinned example
+    seeds) are not perturbed by a float-associativity change.
     """
     n = jnp.sqrt(jnp.sum(vec * vec, axis=-1, keepdims=True) + eps)
     u = vec / n
