@@ -275,29 +275,6 @@ def _bench_synthetic_pna():
     return best
 
 
-def _probe_device(timeout_s: int = 180) -> bool:
-    """The axon TPU tunnel can wedge indefinitely after an earlier killed
-    TPU process (PJRT init hangs; see .claude/skills/verify/SKILL.md).
-    Probe in a subprocess with a timeout so the bench reports the outage
-    as data instead of hanging the driver."""
-    import subprocess
-
-    try:
-        proc = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import jax, jax.numpy as jnp; "
-                "print(float(jnp.ones((8, 8)).sum()))",
-            ],
-            timeout=timeout_s,
-            capture_output=True,
-        )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def main_ab():
     """All four mixed_precision x sorted_aggregation cells in ONE process.
 
@@ -434,7 +411,18 @@ def main():
     if os.getenv("BENCH_AB", "0") == "1":
         main_ab()
         return
-    if not _probe_device():
+    # outage guard WITHOUT a probe subprocess: an extra PJRT client is the
+    # reconnect churn suspected of wedging the pool (BASELINE.md round-3
+    # notes: two probe clients answered, the third process wedged). A
+    # daemon watcher thread bounds the first device contact — signal.alarm
+    # cannot fire while the main thread is blocked in the PJRT recv.
+    import threading
+
+    deadline = {"t": time.monotonic() + 300.0}
+
+    def _watch():
+        while time.monotonic() < deadline["t"]:
+            time.sleep(1.0)
         print(
             json.dumps(
                 {
@@ -447,14 +435,24 @@ def main():
                     "unit": "graphs/sec/chip",
                     "vs_baseline": 0.0,
                     "error": (
-                        "device unreachable: the axon TPU tunnel did not "
-                        "answer a trivial op within 180s (known wedge mode "
-                        "after a killed TPU process; recovery is pool-side)"
+                        "device wedge: a device op exceeded the guard (300s "
+                        "before first contact, BENCH_GUARD_SECS for the "
+                        "whole run; pool-side recovery required)"
                     ),
                 }
-            )
+            ),
+            flush=True,
         )
-        return
+        os._exit(0)  # the one JSON line is on stdout; nothing else coming
+
+    threading.Thread(target=_watch, daemon=True).start()
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.ones((8, 8)).sum())
+    deadline["t"] = time.monotonic() + float(
+        os.getenv("BENCH_GUARD_SECS", "3600")
+    )
     # synthetic leg first: the production leg's HBM footprint in the same
     # process skews the small workload ~5x (measured), not vice versa
     syn = _bench_synthetic_pna()
