@@ -143,3 +143,50 @@ def pytest_spherical_bessel_zero_values():
         assert len(row) == 4
         for z in row:
             assert abs(_sph_jl_np(l, np.array(z))) < 1e-8
+
+
+def pytest_hoisted_pair_dense_equals_post_concat():
+    """The matmul-before-gather identity behind the -40% step-FLOP change:
+    Dense(concat[x_i, x_j, e]) == Dense_r(x)_i + Dense_s(x)_j + Dense_e(e)
+    when the three blocks of the concat kernel are the split weights (bias
+    on the receiver projection only). Verified numerically by wiring the
+    helper's learned params into one concat kernel."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from flax import linen as nn
+
+    from hydragnn_tpu.data import GraphLoader, deterministic_graph_dataset
+    from hydragnn_tpu.models.layers import hoisted_pair_dense
+
+    class Hoisted(nn.Module):
+        dim: int = 12
+
+        @nn.compact
+        def __call__(self, inv, batch, e):
+            return hoisted_pair_dense(
+                self.dim, inv, batch, "recv", "send", [("edge", e)]
+            )
+
+    graphs = deterministic_graph_dataset(4, seed=3)
+    batch = next(iter(GraphLoader(graphs, 4, seed=0)))
+    rng = np.random.default_rng(0)
+    inv = jnp.asarray(rng.normal(size=(batch.num_nodes, 5)), jnp.float32)
+    e = jnp.asarray(
+        rng.normal(size=(batch.num_edges, 3)), jnp.float32
+    )
+    m = Hoisted()
+    v = m.init(jax.random.PRNGKey(0), inv, batch, e)
+    out = m.apply(v, inv, batch, e)
+
+    p = v["params"]
+    concat_kernel = jnp.concatenate(
+        [p["recv"]["kernel"], p["send"]["kernel"], p["edge"]["kernel"]], axis=0
+    )
+    x = jnp.concatenate(
+        [inv[batch.receivers], inv[batch.senders], e], axis=-1
+    )
+    ref = x @ concat_kernel + p["recv"]["bias"]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+    )
