@@ -14,6 +14,16 @@ loader pipeline. MFU = XLA-counted step FLOPs / elapsed / chip peak (bf16).
 ``vs_baseline`` regresses the round-1 recorded measurement honestly: the
 same synthetic-PNA workload round 1 measured (68,055 graphs/sec/chip) is
 re-run and its ratio reported.
+
+Salvage ladder: the run climbs (a) trivial-op first contact, (b) the
+synthetic-PNA leg (one small compile), (c) the SC25 production cell, and —
+under ``BENCH_AB=1`` — (d) the full A/B matrix. Every completed stage is
+appended to ``logs/bench_salvage.jsonl`` IMMEDIATELY, and a wedge (or a
+stage exception) reports the best number already banked instead of 0.0.
+A flaky pool that answers for two minutes therefore still lands a real
+measurement. Exit codes: 0 = ladder completed (possibly with a recorded
+stage error), 2 = wedge (watcher fired; whatever was banked is in the
+JSON), 3 = A/B mode with zero measured cells.
 """
 
 import json
@@ -34,6 +44,63 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "2")
 # graphs/sec/chip recorded at round 1 (BENCH_r01.json) on this chip for the
 # synthetic-PNA workload; used for the vs_baseline regression ratio
 RECORDED_BASELINE = 68055.28
+
+_PROD_METRIC = (
+    "OC20-S2EF-shaped train throughput, SC25 production shape "
+    "(EGNN hidden 866, 4 conv layers, r=5, max_neigh=20, "
+    "energy+forces heads)"
+)
+
+# ---------------------------------------------------------------------------
+# Salvage ladder bookkeeping: every completed stage is appended to
+# logs/bench_salvage.jsonl the moment it finishes, and the wedge watcher
+# reports the best banked number instead of 0.0. Shared dict, written only
+# by the main thread, read by the watcher thread at fire time.
+# ---------------------------------------------------------------------------
+_STAGES = {}
+_SALVAGE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "logs", "bench_salvage.jsonl"
+)
+
+
+def _record_stage(name, payload):
+    _STAGES[name] = payload
+    try:
+        os.makedirs(os.path.dirname(_SALVAGE_PATH), exist_ok=True)
+        with open(_SALVAGE_PATH, "a") as fh:
+            fh.write(json.dumps({"stage": name, "ts": time.time(), **payload}) + "\n")
+    except OSError:
+        pass  # salvage file is best-effort; the in-memory dict still serves
+
+
+def _salvage_json(error):
+    """The one-line report for a run that did not complete the ladder.
+
+    value = best stage already banked (production > synthetic > 0.0), so a
+    mid-run wedge still reports a real measurement (VERDICT r3 #1/#6)."""
+    if "production" in _STAGES:
+        value = _STAGES["production"].get("graphs_per_sec", 0.0)
+        metric = _PROD_METRIC
+    elif "synthetic_pna" in _STAGES:
+        value = _STAGES["synthetic_pna"].get("graphs_per_sec", 0.0)
+        metric = (
+            "synthetic-PNA train throughput (salvage: production stage "
+            "did not complete; see stages/error)"
+        )
+    else:
+        value = 0.0
+        metric = _PROD_METRIC
+    syn = _STAGES.get("synthetic_pna", {}).get("graphs_per_sec", 0.0)
+    return json.dumps(
+        {
+            "metric": metric,
+            "value": round(value, 2),
+            "unit": "graphs/sec/chip",
+            "vs_baseline": round(syn / RECORDED_BASELINE, 3),
+            "stages": _STAGES,
+            "error": error,
+        }
+    )
 
 # peak dense bf16 FLOP/s by TPU generation (public figures)
 _PEAK_FLOPS = {
@@ -303,29 +370,31 @@ def main_ab():
         while time.monotonic() < deadline["t"]:
             time.sleep(1.0)
         print(
-            json.dumps(
-                {
-                    "metric": "OC20-S2EF-shaped A/B matrix",
-                    "value": 0.0,
-                    "unit": "graphs/sec/chip",
-                    "vs_baseline": 0.0,
-                    "error": (
-                        "device wedge: a device op exceeded the guard "
-                        "(300s before first contact, BENCH_AB_GUARD_SECS "
-                        "for the whole matrix); completed cells are in "
-                        "logs/ab_matrix.jsonl"
-                    ),
-                }
+            _salvage_json(
+                "device wedge: a device op exceeded the guard (300s before "
+                "first contact, BENCH_AB_GUARD_SECS for the whole matrix); "
+                "completed cells are in logs/ab_matrix.jsonl; value is the "
+                "best stage banked before the wedge"
             ),
             flush=True,
         )
         os._exit(2)
 
     threading.Thread(target=_watch, daemon=True).start()
+    t_contact = time.perf_counter()
     import jax
     import jax.numpy as jnp
 
     jax.block_until_ready(jnp.ones((8, 8)).sum())
+    _record_stage(
+        "contact",
+        {
+            "ok": True,
+            "secs": round(time.perf_counter() - t_contact, 2),
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+        },
+    )
     # tunnel is up — extend to a generous whole-run guard: a mid-matrix
     # wedge must still terminate the process with the completed cells on
     # disk, not hang until the round ends
@@ -333,7 +402,22 @@ def main_ab():
         os.getenv("BENCH_AB_GUARD_SECS", "5400")
     )
 
-    syn = _bench_synthetic_pna()  # small leg first: big HBM footprint skews it
+    try:
+        # small leg first: the big HBM footprint would skew it, not vice versa
+        syn = _bench_synthetic_pna()
+    except Exception as e:  # noqa: BLE001 — a raising pool is outage data
+        err = f"synthetic stage raised {type(e).__name__}: {e}"[:500]
+        _record_stage("synthetic_error", {"error": err})
+        print(_salvage_json(err), flush=True)
+        sys.exit(3)
+    _record_stage(
+        "synthetic_pna",
+        {
+            "graphs_per_sec": round(syn, 2),
+            "round1_baseline": RECORDED_BASELINE,
+            "vs_round1": round(syn / RECORDED_BASELINE, 3),
+        },
+    )
     # 4-cell mixed_precision x sorted_aggregation matrix, then the packed-
     # batching and batch-64 cells on the winning precision (extra levers
     # from VERDICT r2 #3: batch size and padding occupancy)
@@ -396,6 +480,16 @@ def main_ab():
         print(line, flush=True)
         with open(out_path, "a") as fh:
             fh.write(line + "\n")
+        if mp and not sorted_agg and "env" not in cell:
+            # the production default cell doubles as the ladder's stage (c)
+            _record_stage(
+                "production",
+                {
+                    "graphs_per_sec": round(prod["graphs_per_sec"], 2),
+                    "mfu": round(prod["mfu"], 4),
+                    "flops_per_graph": round(prod["flops_per_graph"]),
+                },
+            )
         n_done += 1
         gc.collect()
     deadline["t"] = float("inf")
@@ -424,47 +518,79 @@ def main():
         while time.monotonic() < deadline["t"]:
             time.sleep(1.0)
         print(
-            json.dumps(
-                {
-                    "metric": (
-                        "OC20-S2EF-shaped train throughput, SC25 production "
-                        "shape (EGNN hidden 866, 4 conv layers, r=5, "
-                        "max_neigh=20, energy+forces heads)"
-                    ),
-                    "value": 0.0,
-                    "unit": "graphs/sec/chip",
-                    "vs_baseline": 0.0,
-                    "error": (
-                        "device wedge: a device op exceeded the guard (300s "
-                        "before first contact, BENCH_GUARD_SECS for the "
-                        "whole run; pool-side recovery required)"
-                    ),
-                }
+            _salvage_json(
+                "device wedge: a device op exceeded the guard (300s "
+                "before first contact, BENCH_GUARD_SECS for the whole "
+                "run; pool-side recovery required); value is the best "
+                "stage banked before the wedge"
             ),
             flush=True,
         )
-        os._exit(0)  # the one JSON line is on stdout; nothing else coming
+        # nonzero: a wedged run must not look like a successful measurement
+        # to exit-code-checking callers (the JSON may still carry a banked
+        # partial number — "error" distinguishes it)
+        os._exit(2)
 
     threading.Thread(target=_watch, daemon=True).start()
+    # ---- stage (a): trivial-op first contact -----------------------------
+    t_contact = time.perf_counter()
     import jax
     import jax.numpy as jnp
 
     jax.block_until_ready(jnp.ones((8, 8)).sum())
+    _record_stage(
+        "contact",
+        {
+            "ok": True,
+            "secs": round(time.perf_counter() - t_contact, 2),
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+        },
+    )
     deadline["t"] = time.monotonic() + float(
         os.getenv("BENCH_GUARD_SECS", "3600")
     )
-    # synthetic leg first: the production leg's HBM footprint in the same
-    # process skews the small workload ~5x (measured), not vice versa
-    syn = _bench_synthetic_pna()
-    prod = _bench_production()
+    # ---- stage (b): synthetic-PNA leg (small compile, regression guard) --
+    # runs first: the production leg's HBM footprint in the same process
+    # skews the small workload ~5x (measured, not vice versa). Every stage
+    # is exception-wrapped: a raising pool is outage data, and the banked
+    # stages must still reach stdout as the one JSON line.
+    try:
+        syn = _bench_synthetic_pna()
+    except Exception as e:  # noqa: BLE001
+        err = f"synthetic stage raised {type(e).__name__}: {e}"[:500]
+        _record_stage("synthetic_error", {"error": err})
+        print(_salvage_json(err), flush=True)
+        return
+    _record_stage(
+        "synthetic_pna",
+        {
+            "graphs_per_sec": round(syn, 2),
+            "round1_baseline": RECORDED_BASELINE,
+            "vs_round1": round(syn / RECORDED_BASELINE, 3),
+        },
+    )
+    # ---- stage (c): SC25 production cell ---------------------------------
+    try:
+        prod = _bench_production()
+    except Exception as e:  # noqa: BLE001 — a raising pool is outage data
+        err = f"production stage raised {type(e).__name__}: {e}"[:500]
+        _record_stage("production_error", {"error": err})
+        print(_salvage_json(err), flush=True)
+        return
+    _record_stage(
+        "production",
+        {
+            "graphs_per_sec": round(prod["graphs_per_sec"], 2),
+            "mfu": round(prod["mfu"], 4),
+            "flops_per_graph": round(prod["flops_per_graph"]),
+        },
+    )
+    deadline["t"] = float("inf")
     print(
         json.dumps(
             {
-                "metric": (
-                    "OC20-S2EF-shaped train throughput, SC25 production shape "
-                    "(EGNN hidden 866, 4 conv layers, r=5, max_neigh=20, "
-                    "energy+forces heads)"
-                ),
+                "metric": _PROD_METRIC,
                 "value": round(prod["graphs_per_sec"], 2),
                 "unit": "graphs/sec/chip",
                 "vs_baseline": round(syn / RECORDED_BASELINE, 3),

@@ -16,6 +16,7 @@ import argparse
 import csv
 import json
 import os
+import shutil
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
@@ -29,11 +30,31 @@ from hydragnn_tpu.data.smiles import SmilesError, smiles_to_graph
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
+_N_NODE_COLS = 8  # [Z, deg, charge, arom, nH, sp, sp2, sp3]
+
+
+def _stale_schema(path):
+    """True when a cached dataset predates the current feature table (e.g.
+    the 5-column pre-hybridization layout) — serve-from-cache would then
+    feed a config that indexes columns the arrays don't have."""
+    meta_path = os.path.join(path, "shard00000", "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+        return meta["fields"]["x"]["suffix"] != [_N_NODE_COLS]
+    except (OSError, KeyError, ValueError):
+        return True
+
+
 def build_dataset(path, num_samples, csv_file=None):
     if os.path.isdir(path):
-        return
+        if not _stale_schema(path):
+            return
+        print(f"rebuilding {path}: cached schema is stale")
+        shutil.rmtree(path)
+    smiles = None
     if csv_file:
-        graphs = []
+        graphs, smiles = [], []
         with open(csv_file) as f:
             for row in csv.DictReader(f):
                 try:
@@ -43,9 +64,15 @@ def build_dataset(path, num_samples, csv_file=None):
                     continue
                 g.graph_y = np.asarray([float(row["gap"])], np.float32)
                 graphs.append(g)
+                smiles.append(row["smiles"])
     else:
         graphs = smiles_table_dataset(number_configurations=num_samples)
-    ColumnarWriter(path).add(graphs).save()
+    w = ColumnarWriter(path).add(graphs)
+    if smiles:
+        # source strings ride along per sample, like the reference's
+        # SMILES packing into the .bp (adiosdataset.py:334-389)
+        w.add_string("smiles", smiles)
+    w.save()
     print(f"wrote {len(graphs)} CSCE gap molecules -> {path}")
 
 

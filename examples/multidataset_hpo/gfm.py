@@ -37,7 +37,43 @@ def main():
     ap.add_argument("--num_epoch", type=int, default=3)
     ap.add_argument("--trial_offset", type=int, default=0,
                     help="offset into the search (parallel HPO shards)")
+    ap.add_argument("--results", default=None,
+                    help="append trial records to this JSONL (worker mode)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help=">1: orchestrate N parallel worker subprocesses "
+                         "(DeepHyper-analog, hpo.launch_hpo_workers) and "
+                         "merge their shards")
     args = ap.parse_args()
+
+    if args.workers > 1:
+        from hydragnn_tpu.hpo import launch_hpo_workers
+
+        best, trials = launch_hpo_workers(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--num_trials", "{num_trials}",
+                "--trial_offset", "{trial_offset}",
+                "--results", "{results}",
+                "--num_per_dataset", str(args.num_per_dataset),
+                "--num_epoch", str(args.num_epoch),
+            ],
+            num_workers=args.workers,
+            num_trials=args.num_trials,
+            workdir=os.path.join(os.getcwd(), "hpo_workers"),
+            # independent studies on other machines shard disjointly by
+            # passing distinct base offsets (worker i draws offset+i)
+            trial_offset=args.trial_offset,
+            # HPO_HOSTS="host1 host2 ..." carves one worker per node over
+            # ssh (run-scripts/hpo-parallel.sh; the DeepHyper node-carving
+            # analog) — workdir must be on a shared filesystem then
+            hosts=os.environ.get("HPO_HOSTS", "").split() or None,
+        )
+        a = best["NeuralNetwork"]["Architecture"]
+        print(
+            f"parallel study: {len(trials)} trials over {args.workers} "
+            f"workers; best {a['mpnn_type']} hidden {a['hidden_dim']}"
+        )
+        return
 
     import train as multidataset_train  # examples/multidataset/train.py
 
@@ -56,6 +92,12 @@ def main():
     def objective(config):
         import hydragnn_tpu
 
+        # the search draws both equivariant and invariant conv types over a
+        # base config with equivariance on — follow the drawn model
+        arch = config["NeuralNetwork"]["Architecture"]
+        arch["equivariance"] = arch["mpnn_type"] in (
+            "EGNN", "SchNet", "PNAEq", "PAINN", "MACE"
+        )
         _, _, hist, *_ = hydragnn_tpu.run_training(config, datasets=datasets)
         return float(np.min(hist["val"]))
 
@@ -66,6 +108,10 @@ def main():
         trial_offset=args.trial_offset,
         objective=objective,
     )
+    if args.results:
+        from hydragnn_tpu.hpo import append_trial_records
+
+        append_trial_records(args.results, trials)
     for i, t in enumerate(trials):
         a = t["config"]["NeuralNetwork"]["Architecture"]
         print(f"trial {i}: loss {t['loss']:.5f} {a['mpnn_type']} hidden {a['hidden_dim']}")

@@ -197,6 +197,16 @@ def prepare_data(
         and jax.local_device_count() > 1
     ):
         num_shards = jax.local_device_count()
+    # single-host ZeRO-2 runs the mesh step (the gradient-sharding
+    # constraint lives there), so its batches must be stacked too —
+    # keep in lockstep with run_training's zero2_mesh predicate
+    if (
+        int(training.get("Optimizer", {}).get("zero_stage", 0)) >= 2
+        and jax.process_count() == 1
+        and jax.local_device_count() > 1
+        and not bool(training.get("branch_parallel", False))
+    ):
+        num_shards = jax.local_device_count()
     if batch_size % num_shards != 0:
         raise ValueError(
             f"Training.batch_size {batch_size} must be divisible by the "
@@ -396,8 +406,25 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # partitions the update by the moments' sharding and all-gathers the
     # resulting param updates (parallel/dp.py).
     use_zero = training["Optimizer"].get("use_zero_redundancy", False)
+    # ZeRO stage selection (reference: DeepSpeed ds_config zero stage,
+    # run_training.py:136-149): stage 1 = moment sharding, stage 2 adds
+    # gradient sharding over the data axis inside the mesh step
+    # (parallel/dp.py zero2). use_zero_redundancy alone means stage 1.
+    zero_stage = int(training["Optimizer"].get("zero_stage", 1 if use_zero else 0))
+    use_zero = use_zero or zero_stage >= 1
+    # stage >= 2 needs the mesh step (the gradient constraint lives inside
+    # shard_map's caller), so single-host multi-device stage-2 runs take the
+    # mesh path below — this predicate must MATCH prepare_data's loader
+    # num_shards gate, or the mesh step would see unstacked batches
+    zero2_mesh = (
+        zero_stage >= 2
+        and not multihost
+        and not training.get("branch_parallel", False)
+        and jax.local_device_count() > 1
+    )
     if (
         use_zero
+        and zero_stage < 2
         and not multihost
         and not training.get("branch_parallel", False)
         and len(jax.devices()) > 1
@@ -431,7 +458,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             f">=2 local devices (have {jax.local_device_count()}): "
             "prepare_data could not build branch-routed loaders"
         )
-    if multihost or branch_parallel:
+    if multihost or branch_parallel or zero2_mesh:
         from .parallel import (
             make_mesh,
             promote_batch,
@@ -466,7 +493,9 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 state = state.replace(
                     opt_state=shard_optimizer_state(state.opt_state, mesh)
                 )
-            _pstep = make_parallel_train_step(model, tx, mesh, cge, mp)
+            _pstep = make_parallel_train_step(
+                model, tx, mesh, cge, mp, zero2=zero_stage >= 2
+            )
             _peval = make_parallel_eval_step(model, mesh, cge, mp)
         step_fn = lambda s, b, r: _pstep(s, promote_batch(b, mesh), r)
         # evaluate() expects (tot, tasks, aux) like make_eval_step

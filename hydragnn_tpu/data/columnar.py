@@ -67,6 +67,7 @@ class ColumnarWriter:
         self.path = path
         self.shard_dir = os.path.join(path, f"shard{shard_index:05d}")
         self._fields: Dict[str, List[np.ndarray]] = {}
+        self._strings: Dict[str, List[str]] = {}
         self._attrs: Dict[str, Any] = {}
         self._n = 0
 
@@ -92,9 +93,31 @@ class ColumnarWriter:
         """(reference: AdiosWriter.add_global, adiosdataset.py:115-126)"""
         self._attrs[name] = value
 
+    def add_string(self, name: str, values) -> "ColumnarWriter":
+        """Per-sample ragged strings (reference: AdiosWriter's SMILES char
+        packing with per-sample counts, adiosdataset.py:334-389). One value
+        per added graph; stored as a UTF-8 uint8 column with the same
+        counts/offset layout every array field uses."""
+        if isinstance(values, str):
+            values = [values]
+        self._strings.setdefault(name, []).extend(str(v) for v in values)
+        return self
+
     def save(self) -> str:
         os.makedirs(self.shard_dir, exist_ok=True)
         meta: Dict[str, Any] = {"num_samples": self._n, "fields": {}, "attrs": {}}
+        for name, vals in self._strings.items():
+            if len(vals) != self._n:
+                raise ValueError(
+                    f"string column {name!r} has {len(vals)} values for "
+                    f"{self._n} samples"
+                )
+            key = f"strings/{name}"
+            if key in self._fields:
+                raise ValueError(f"duplicate column {key!r}")
+            self._fields[key] = [
+                np.frombuffer(v.encode("utf-8"), np.uint8) for v in vals
+            ]
         for k, arrs in self._fields.items():
             a0 = arrs[0]
             suffix = list(a0.shape[1:])
@@ -223,6 +246,34 @@ class ColumnarDataset(AbstractBaseDataset):
                 return self._build(fields, idx - start)
         raise IndexError(idx)
 
+    def string_columns(self) -> List[str]:
+        """Names of ragged per-sample string columns (ADIOS SMILES-packing
+        analog, adiosdataset.py:334-389)."""
+        names = set()
+        for _, _, fields in self._shards:
+            for k in fields:
+                if k.startswith("strings/"):
+                    names.add(k.split("/", 1)[1])
+        return sorted(names)
+
+    def get_string(self, name: str, idx: int) -> str:
+        """Per-sample string from column ``name`` (UTF-8 decoded)."""
+        if idx < 0:
+            idx += self._total
+        key = f"strings/{name}"
+        for start, n, fields in self._shards:
+            if start <= idx < start + n:
+                if key not in fields:
+                    raise KeyError(
+                        f"no string column {name!r}; have {self.string_columns()}"
+                    )
+                arr, counts, offsets = fields[key]
+                i = idx - start
+                return bytes(
+                    np.array(arr[offsets[i] : offsets[i + 1]])
+                ).decode("utf-8")
+        raise IndexError(idx)
+
     def _build(self, fields, i: int) -> Graph:
         def take(k):
             arr, counts, offsets = fields[k]
@@ -232,6 +283,8 @@ class ColumnarDataset(AbstractBaseDataset):
         node_targets = {}
         opt: Dict[str, Optional[np.ndarray]] = {f: None for f in _OPTIONAL_FIELDS}
         for k in fields:
+            if k.startswith("strings/"):
+                continue  # ragged string columns are read via get_string
             if k.startswith("graph_targets/"):
                 graph_targets[k.split("/", 1)[1]] = take(k)
             elif k.startswith("node_targets/"):

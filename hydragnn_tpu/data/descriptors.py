@@ -129,7 +129,7 @@ def smiles_to_graph(smiles: str, radius: float = 10.0) -> Graph:
         warnings.warn(
             "rdkit unavailable: smiles_to_graph is using the in-tree SMILES "
             "reader, whose node-feature table ([Z, degree, charge, aromatic, "
-            "n_H] + bond-order edge_attr) differs from the rdkit path's "
+            "n_H, sp, sp2, sp3] + bond-order edge_attr) differs from the rdkit path's "
             "atomic_descriptors table — datasets/checkpoints built with one "
             "path are not feature-compatible with the other",
             stacklevel=2,

@@ -271,6 +271,7 @@ class GraphLoader:
         size_bucketing: bool = False,
         bucket_window: int = 16,
         pack: bool = False,
+        with_triplets: bool = False,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -306,9 +307,14 @@ class GraphLoader:
         if self.pack:
             if isinstance(spec, SpecLadder):
                 spec = spec.specs[-1]
+            # with_triplets must reach the auto budget: a directly
+            # constructed DimeNet pack loader would otherwise get
+            # n_triplets=0 batches (the api.prepare_data path always
+            # passes a spec)
             self.ladder = SpecLadder(
                 (spec if spec is not None
-                 else _pack_spec(graphs, per_shard),)
+                 else _pack_spec(graphs, per_shard,
+                                 with_triplets=with_triplets),)
             )
         elif spec is None:
             self.ladder = SpecLadder.for_dataset(
@@ -319,6 +325,7 @@ class GraphLoader:
                 # composition policy actually produces
                 size_bucketing=size_bucketing,
                 bucket_window=bucket_window,
+                with_triplets=with_triplets,
             )
         elif isinstance(spec, SpecLadder):
             self.ladder = spec

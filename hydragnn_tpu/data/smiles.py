@@ -239,6 +239,38 @@ def _embed_3d(z: np.ndarray, bonds: List[Tuple[int, int, float]],
     return pos
 
 
+def _hybridization(z: int, aromatic: bool, charge: int,
+                   sigma: int, order_sum: float) -> Tuple[int, int, int]:
+    """(sp, sp2, sp3) one-hot, rdkit-free.
+
+    The reference one-hot encodes HybridizationType SP/SP2/SP3 per atom
+    (smiles_utils.py:58-70). Without rdkit the same labels follow from
+    bond structure: pi = total bond order minus sigma bonds (aromatic
+    bonds contribute 0.5 each); >=2 pi -> SP, 1 pi or aromatic -> SP2,
+    otherwise the VSEPR steric number (sigma bonds + lone pairs, lone
+    pairs from the valence-electron count) picks 4 -> SP3, 3 -> SP2,
+    2 -> SP. Hydrogen and bare ions are unhybridized (all zeros), like
+    rdkit's HybridizationType.S.
+    """
+    if z == 1 or sigma == 0:
+        return 0, 0, 0
+    pi = int(round(order_sum - sigma))
+    if aromatic:
+        return 0, 1, 0
+    if pi >= 2:
+        return 1, 0, 0
+    if pi == 1:
+        return 0, 1, 0
+    ve = {5: 3, 6: 4, 7: 5, 8: 6, 9: 7, 15: 5, 16: 6, 17: 7, 35: 7, 53: 7}
+    lone = max(0, (ve.get(z, 4) - charge - int(round(order_sum)))) // 2
+    steric = sigma + lone
+    if steric >= 4:
+        return 0, 0, 1
+    if steric == 3:
+        return 0, 1, 0
+    return 1, 0, 0
+
+
 def smiles_to_graph(
     s: str,
     add_hydrogens: bool = True,
@@ -247,10 +279,13 @@ def smiles_to_graph(
     seed: int = 0,
 ) -> Graph:
     """SMILES -> ``Graph`` with the reference's feature-table convention
-    (smiles_utils.py: one-hot atom type + degree + H-count columns).
+    (smiles_utils.py: one-hot atom type + degree + H-count columns,
+    IsAromatic + HSP/HSP2/HSP3 hybridization one-hots, smiles_utils.py:19-70).
 
-    Node feature table columns: ``[Z, degree, charge, aromatic, n_H]``;
-    bonds become bidirectional edges with ``edge_attr = [bond_order]``.
+    Node feature table columns: ``[Z, degree, charge, aromatic, n_H,
+    sp, sp2, sp3]`` (hybridization appended last so pre-round-4 column
+    indices remain valid); bonds become bidirectional edges with
+    ``edge_attr = [bond_order]``.
     """
     symbols, aromatic, charges, explicit_h, bonds = parse_smiles(s)
     order_sum = np.zeros(len(symbols))
@@ -286,6 +321,23 @@ def smiles_to_graph(
         deg = np.concatenate([deg[:heavy_n], np.ones(len(z) - heavy_n)])
         n_h = list(n_h) + [0] * (len(z) - heavy_n)
     z_arr = np.asarray(z, np.int32)
+    # hybridization from the full bond structure (sigma = bonded neighbors
+    # incl. hydrogens = deg; order_sum recomputed over the final bond list)
+    full_order = np.zeros(len(z))
+    for a, b, o in bonds:
+        full_order[a] += o
+        full_order[b] += o
+    imp_h = np.zeros(len(z)) if add_hydrogens else np.asarray(n_h, float)
+    hyb = np.asarray(
+        [
+            _hybridization(
+                int(z_arr[i]), bool(aromatic[i]), int(charges[i]),
+                int(deg[i] + imp_h[i]), float(full_order[i] + imp_h[i]),
+            )
+            for i in range(len(z))
+        ],
+        np.float32,
+    )
     x = np.stack([
         z_arr.astype(np.float32),
         deg.astype(np.float32),
@@ -293,6 +345,7 @@ def smiles_to_graph(
         np.asarray(aromatic, np.float32),
         np.asarray(n_h, np.float32),
     ], axis=1)
+    x = np.concatenate([x, hyb], axis=1)
     senders, receivers, orders = [], [], []
     for a, b, o in bonds:
         senders += [a, b]

@@ -22,8 +22,12 @@ e.g. ``{"NeuralNetwork/Architecture/hidden_dim": [32, 64, 128],
 from __future__ import annotations
 
 import copy
+import json
 import math
+import os
 import re
+import subprocess
+import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -168,3 +172,140 @@ def run_hpo(
         trials.append({"config": config, "loss": loss})
     best = min(trials, key=lambda t: t["loss"])
     return best["config"], trials
+
+
+def append_trial_records(path: str, trials: Sequence[Dict[str, Any]]) -> None:
+    """Append trial records as JSONL (one ``{"loss", "config"}`` per line) —
+    the worker side of a parallel study."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a") as fh:
+        for t in trials:
+            fh.write(json.dumps({"loss": t["loss"], "config": t["config"]}) + "\n")
+
+
+def merge_hpo_results(paths: Sequence[str]) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Merge per-worker JSONL trial records -> (best_config, all trials)."""
+    trials: List[Dict[str, Any]] = []
+    for p in paths:
+        if not os.path.exists(p):
+            continue
+        with open(p) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    trials.append(json.loads(line))
+    if not trials:
+        raise RuntimeError(f"no HPO trial records found in {list(paths)}")
+    best = min(trials, key=lambda t: t["loss"])
+    return best["config"], trials
+
+
+def launch_hpo_workers(
+    argv_template: Sequence[str],
+    num_workers: int,
+    num_trials: int,
+    workdir: str,
+    hosts: Optional[Sequence[str]] = None,
+    env: Optional[Dict[str, str]] = None,
+    timeout: Optional[float] = None,
+    trial_offset: int = 0,
+) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
+    """Async multi-worker HPO orchestration (the DeepHyper analog: the
+    reference carves a SLURM node list into per-trial srun launch commands,
+    deephyper.py:47-177; here each worker is a subprocess — optionally
+    ssh-prefixed onto a carved host — exploring a disjoint ``trial_offset``
+    shard of the study and appending JSONL records the parent merges).
+
+    ``argv_template`` tokens may contain ``{worker}``, ``{num_trials}``,
+    ``{trial_offset}``, ``{results}`` placeholders. Trials are split as
+    evenly as possible; worker ``i`` gets ``trial_offset=trial_offset+i``
+    (a distinct sampler stream per worker, and ``trial_offset`` lets
+    independent parallel studies on different machines shard disjointly,
+    same as the sequential convention). ``timeout`` bounds the WHOLE study;
+    on timeout or a failed worker every remaining subprocess is terminated.
+    ``hosts`` round-robins workers over ssh (tokens are shell-quoted for
+    the remote side; ``workdir`` must live on a filesystem shared with the
+    hosts — on clusters without one, point it at the shared scratch the
+    scheduler provides, as the reference's per-node DeepHyper launches do).
+    Returns the merged ``(best_config, trials)``.
+    """
+    import time as _time
+
+    if num_workers < 1:
+        raise ValueError("num_workers must be >= 1")
+    os.makedirs(workdir, exist_ok=True)
+    shares = [
+        num_trials // num_workers + (1 if i < num_trials % num_workers else 0)
+        for i in range(num_workers)
+    ]
+    procs: List[Tuple[int, subprocess.Popen, str]] = []
+    results: List[str] = []
+    logs: List[Any] = []
+    failures: List[Tuple[int, Any]] = []
+    try:
+        for i, share in enumerate(shares):
+            if share == 0:
+                continue
+            res = os.path.join(workdir, f"trials_worker{i}.jsonl")
+            if os.path.exists(res):
+                os.remove(res)
+            results.append(res)
+            argv = [
+                tok.format(
+                    worker=i, num_trials=share,
+                    trial_offset=trial_offset + i, results=res,
+                )
+                for tok in argv_template
+            ]
+            if hosts:
+                # ssh concatenates the remote argv into one shell line —
+                # quote each token or paths with spaces/metachars re-split
+                import shlex
+
+                argv = ["ssh", hosts[i % len(hosts)]] + [
+                    shlex.quote(t) for t in argv
+                ]
+            log = open(os.path.join(workdir, f"worker{i}.log"), "w")
+            logs.append(log)
+            procs.append(
+                (
+                    i,
+                    subprocess.Popen(
+                        argv, stdout=log, stderr=subprocess.STDOUT,
+                        env=dict(env) if env is not None else None,
+                    ),
+                    res,
+                )
+            )
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        for i, proc, res in procs:
+            remain = (
+                None if deadline is None
+                else max(deadline - _time.monotonic(), 0.0)
+            )
+            try:
+                rc = proc.wait(timeout=remain)
+            except subprocess.TimeoutExpired:
+                failures.append((i, "timeout"))
+                continue
+            if rc != 0:
+                failures.append((i, rc))
+    finally:
+        # never leave detached workers training unsupervised: on any
+        # failure/timeout/exception, terminate whatever still runs
+        for _, proc, _ in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for _, proc, _ in procs:
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for log in logs:
+            log.close()
+    if failures:
+        raise RuntimeError(
+            f"HPO workers failed (worker, reason): {failures}; logs in {workdir}"
+        )
+    return merge_hpo_results(results)

@@ -270,6 +270,7 @@ class HydraModel(nn.Module):
                 (gh.dim_sharedlayers,) * gh.num_sharedlayers,
                 cfg.activation,
                 final_activation=True,
+                mirror_init=True,
             )
         heads = []
         for ihead, (t, d) in enumerate(zip(cfg.output_type, cfg.output_dim)):
@@ -278,7 +279,9 @@ class HydraModel(nn.Module):
                 gh = cfg.graph_head or GraphHeadConfig()
                 heads.append(
                     _branch_bank(MLP, B, in_axes=(0,))(
-                        tuple(gh.dim_headlayers) + (out_d,), cfg.activation
+                        tuple(gh.dim_headlayers) + (out_d,),
+                        cfg.activation,
+                        mirror_init=True,
                     )
                 )
             elif t == "node":
@@ -405,7 +408,7 @@ class MLPNode(nn.Module):
     def __call__(self, x, batch: GraphBatch):
         feats = tuple(self.hidden_dims) + (self.output_dim,)
         if self.nn_type == "mlp":
-            return MLP(feats, self.activation)(x)
+            return MLP(feats, self.activation, mirror_init=True)(x)
         # mlp_per_node: a separate MLP per node position within each graph
         assert self.num_nodes > 0, "mlp_per_node requires fixed graph size"
         node_pos = _node_position_in_graph(batch)
@@ -415,7 +418,7 @@ class MLPNode(nn.Module):
             out_axes=0,
             variable_axes={"params": 0},
             split_rngs={"params": True},
-        )(feats, self.activation)
+        )(feats, self.activation, mirror_init=True)
         # evaluate all per-node MLPs on gathered inputs ordered by node pos
         onehot = jax.nn.one_hot(node_pos % self.num_nodes, self.num_nodes, axis=0)
         xs = jnp.einsum("pn,nf->pnf", onehot, x)

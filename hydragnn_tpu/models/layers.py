@@ -7,6 +7,36 @@ from typing import Callable, Optional, Sequence
 import jax.numpy as jnp
 from flax import linen as nn
 
+
+def mirrored_lecun_normal():
+    """LeCun-normal kernel init with columns drawn in ``(w, -w)`` pairs.
+
+    For a ReLU layer whose inputs are nonnegative (everything downstream of
+    a ReLU encoder — exactly the decoder-head position), a zero-bias unit is
+    dead on the WHOLE dataset iff ``w·x < 0`` for every sample; with few
+    units the probability that every unit draws dead is seed-visible (a
+    hidden-8 matrix run measured GIN/EGNN stalled at the conv-free minimum
+    at Training.seed=0). Pairing each column with its negation guarantees
+    that for any input with ``w·x != 0`` one unit of the pair is active, so
+    no seed can produce a fully dead layer and gradients always flow.
+    The ReLU gates break the pair symmetry after the first update, and the
+    per-column scale is the usual lecun_normal (same as flax's default), so
+    trained behavior is unchanged. This replaces the round-3 workaround of
+    pinning a measured healthy seed.
+    """
+
+    base = nn.initializers.lecun_normal()
+
+    def init(key, shape, dtype=jnp.float_):
+        if len(shape) != 2:
+            return base(key, shape, dtype)
+        fan_in, fan_out = shape
+        half = (fan_out + 1) // 2
+        w = base(key, (fan_in, half), dtype)
+        return jnp.concatenate([w, -w[:, : fan_out - half]], axis=1)
+
+    return init
+
 ACTIVATIONS = {
     "relu": nn.relu,
     "gelu": nn.gelu,
@@ -38,13 +68,20 @@ class MLP(nn.Module):
     features: Sequence[int]
     activation: str = "relu"
     final_activation: bool = False
+    # decoder-position MLPs (nonnegative inputs) use the mirrored init so no
+    # rng draw can produce a fully ReLU-dead layer; see mirrored_lecun_normal
+    mirror_init: bool = False
 
     @nn.compact
     def __call__(self, x):
         act = get_activation(self.activation)
         for i, f in enumerate(self.features):
-            x = nn.Dense(f)(x)
-            if i < len(self.features) - 1 or self.final_activation:
+            last = i == len(self.features) - 1
+            if self.mirror_init and (not last or self.final_activation):
+                x = nn.Dense(f, kernel_init=mirrored_lecun_normal())(x)
+            else:
+                x = nn.Dense(f)(x)
+            if not last or self.final_activation:
                 x = act(x)
         return x
 

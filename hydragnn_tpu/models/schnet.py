@@ -54,6 +54,20 @@ class CFConv(nn.Module):
                           batch.edge_mask, sorted_ids=self.sorted_agg,
                           max_degree=self.max_in_degree)
         out = nn.Dense(self.output_dim)(agg)
+        # Residual interaction update (original SchNet, Schütt et al. 2017:
+        # x^{l+1} = x^l + v^l, with an atom-embedding layer mapping inputs
+        # to hidden width BEFORE the first interaction). The reference's
+        # SCFStack drops this self path (CFConv returns lin2(aggregate)
+        # only, SCFStack.py:259-290), which makes the receiving node's own
+        # features unrecoverable except through closed 2-hop paths —
+        # measured as a ~0.24-RMSE floor on the pointwise vector-output CI
+        # task. Width-matching layers add the identity residual; the first
+        # layer (input_dim -> hidden) adds a learned embedding of the input
+        # instead, exactly the paper's embedding-then-residual structure.
+        if inv.shape[-1] == self.output_dim:
+            out = out + inv
+        else:
+            out = out + nn.Dense(self.output_dim, use_bias=False)(inv)
 
         if self.equivariant:
             # Coordinate update from the *running* positions, normalize=True

@@ -83,7 +83,13 @@ def segment_sum(
     if sorted_ids and max_degree and msg.ndim == 2 and _pallas_route_enabled():
         from .pallas_segment import sorted_segment_sum
 
-        return sorted_segment_sum(msg, segment_ids, num_segments, max_degree)
+        # forcing the route on a non-TPU backend (HYDRAGNN_PALLAS_SEGMENT=1,
+        # e.g. the CPU-mesh dryrun) runs the kernel in interpret mode —
+        # same program, Python-evaluated blocks
+        return sorted_segment_sum(
+            msg, segment_ids, num_segments, max_degree,
+            interpret=jax.default_backend() != "tpu",
+        )
     return jax.ops.segment_sum(msg, segment_ids, num_segments=num_segments)
 
 

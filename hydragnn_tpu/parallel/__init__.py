@@ -12,6 +12,7 @@ from .mesh import (
     setup_distributed,
     shard_batch,
     shard_optimizer_state,
+    zero2_grad_constraint,
 )
 
 __all__ = [
@@ -29,4 +30,5 @@ __all__ = [
     "setup_distributed",
     "shard_batch",
     "shard_optimizer_state",
+    "zero2_grad_constraint",
 ]

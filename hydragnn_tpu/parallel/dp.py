@@ -50,8 +50,16 @@ def make_parallel_train_step(
     mesh: Mesh,
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
+    zero2: bool = False,
+    zero2_min_size: int = 1024,
 ):
-    """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh."""
+    """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh.
+
+    ``zero2=True`` shards the gradient leaves over the data axis between the
+    gradient reduction and the optimizer update (ZeRO-2 analog — see
+    mesh.zero2_grad_constraint); compose with ``shard_optimizer_state`` on
+    the state (same ``min_size``) for the full stage-2 memory profile
+    (sharded grads + moments, replicated params)."""
     cfg = model.cfg
 
     def per_device_loss(params, batch_stats, batch, rng):
@@ -118,8 +126,19 @@ def make_parallel_train_step(
         # makes XLA all-gather the updates, which IS the ZeRO-1 exchange
         # (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
         # hydragnn/utils/optimizer/optimizer.py:43-101).
+        if zero2:
+            from .mesh import zero2_grad_constraint
+
+            grads = zero2_grad_constraint(grads, mesh, min_size=zero2_min_size)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
+        if zero2:
+            # pin the post-update params back to replicated: the sharded
+            # updates make XLA all-gather here (the ZeRO-2 param exchange)
+            # instead of falling back to full-grad replication upstream
+            params = jax.lax.with_sharding_constraint(
+                params, NamedSharding(mesh, P())
+            )
         return (
             state.replace(
                 params=params,

@@ -101,6 +101,20 @@ def replicate_state(state, mesh: Mesh):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, rep), state)
 
 
+def _zero_leaf_eligible(x, data_n: int, min_size: int) -> bool:
+    """Shared ZeRO eligibility predicate: large leaves whose leading dim
+    divides the data axis. ONE definition for both the stage-1 moment
+    placement and the stage-2 gradient constraint so their slices always
+    line up (a de-synced pair would leave some moment leaves sharded with
+    replicated gradients, defeating the reduce-scatter lowering)."""
+    return (
+        hasattr(x, "ndim")
+        and x.ndim >= 1
+        and x.size >= min_size
+        and x.shape[0] % data_n == 0
+    )
+
+
 def shard_optimizer_state(state, mesh: Mesh, min_size: int = 1024):
     """ZeRO-1 analog: shard large optimizer-moment arrays over the data axis
     (reference capability: DeepSpeed ZeRO stage 1 / ZeroRedundancyOptimizer,
@@ -111,16 +125,35 @@ def shard_optimizer_state(state, mesh: Mesh, min_size: int = 1024):
     rep = replicated(mesh)
 
     def place(x):
-        if (
-            hasattr(x, "ndim")
-            and x.ndim >= 1
-            and x.size >= min_size
-            and x.shape[0] % data_n == 0
-        ):
+        if _zero_leaf_eligible(x, data_n, min_size):
             return jax.device_put(x, sharded)
         return jax.device_put(x, rep)
 
     return jax.tree_util.tree_map(place, state)
+
+
+def zero2_grad_constraint(grads, mesh: Mesh, min_size: int = 1024):
+    """ZeRO-2 analog: constrain large gradient leaves to ``P(data)`` sharding
+    inside the jitted step (reference capability: DeepSpeed ZeRO stage 2,
+    accepted by run_training.py:136-149).
+
+    Applied between the gradient ``pmean`` and the optimizer update, XLA
+    lowers the reduce+constraint pair to a reduce-scatter: each device then
+    holds only its 1/data_n gradient slice, updates the matching ZeRO-1
+    moment slice, and the replicated-params output constraint turns the
+    param update into the all-gather — the full ZeRO-2 exchange, expressed
+    as shardings instead of hand-written collectives. Eligibility matches
+    ``shard_optimizer_state`` so gradient and moment slices line up.
+    """
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    data_n = mesh.shape[DATA_AXIS]
+
+    def place(g):
+        if _zero_leaf_eligible(g, data_n, min_size):
+            return jax.lax.with_sharding_constraint(g, sharded)
+        return g
+
+    return jax.tree_util.tree_map(place, grads)
 
 
 def materialize_replicated(tree):

@@ -4,7 +4,10 @@
 # mpirun tier). Runs the fast-tier suite on a virtual 8-device CPU mesh,
 # then the 2-process jax.distributed tests.
 #
-# Usage: run-scripts/ci.sh [extra pytest args]
+# Usage: run-scripts/ci.sh [--full] [extra pytest args]
+#   --full: run the matrix at the reference's real thresholds (no
+#   HYDRAGNN_CI_FAST halving/relaxation) — the driver-verifiable tier;
+#   tee the pytest summary into logs/ci_full_*.txt for the round artifact.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -13,7 +16,13 @@ cd "$(dirname "$0")/.."
 unset PALLAS_AXON_POOL_IPS || true
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
-export HYDRAGNN_CI_FAST=1
+if [ "${1:-}" = "--full" ]; then
+  shift
+  unset HYDRAGNN_CI_FAST || true
+  echo "== FULL tier: reference thresholds, full epochs =="
+else
+  export HYDRAGNN_CI_FAST=1
+fi
 
 echo "== fast-tier suite (8-device CPU mesh) =="
 python -m pytest tests/ -x -q --deselect tests/test_multihost.py "$@"

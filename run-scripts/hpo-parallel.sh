@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Parallel HPO study (DeepHyper analog — the reference carves a SLURM node
+# list into per-trial srun launches, hydragnn/utils/hpo/deephyper.py:47-177).
+# Each worker explores a disjoint trial_offset shard of the study and
+# appends JSONL records; the driver process merges them and reports the
+# best config. On a SLURM allocation, export HPO_HOSTS="$(scontrol show
+# hostnames)" to carve one worker per node via ssh (hpo.launch_hpo_workers
+# hosts=); locally the workers share the host's CPU devices.
+#
+#   WORKERS=4 TRIALS=16 run-scripts/hpo-parallel.sh [extra gfm.py args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+WORKERS="${WORKERS:-2}"
+TRIALS="${TRIALS:-4}"
+exec python examples/multidataset_hpo/gfm.py \
+  --workers "$WORKERS" --num_trials "$TRIALS" "$@"

@@ -96,6 +96,42 @@ def pytest_columnar_multishard(tmp_path):
         _assert_graph_equal(graphs[i], ds[i])
 
 
+@pytest.mark.parametrize("mode", ["mmap", "preload", "shmem"])
+def pytest_columnar_string_columns(tmp_path, mode):
+    """Ragged per-sample string columns (the ADIOS SMILES char-packing
+    analog, adiosdataset.py:334-389): write across two shards incl. unicode
+    and empty strings, read back per sample in every mode."""
+    graphs = deterministic_graph_dataset(6, seed=7)
+    smiles = ["CCO", "", "c1ccccc1", "CC(=O)N", "N#N", "Cα→β"]  # incl. unicode
+    w0 = ColumnarWriter(str(tmp_path / "ds"), shard_index=0).add(graphs[:4])
+    w0.add_string("smiles", smiles[:4])
+    w0.save()
+    w1 = ColumnarWriter(str(tmp_path / "ds"), shard_index=1).add(graphs[4:])
+    w1.add_string("smiles", smiles[4:])
+    w1.save()
+    ds = ColumnarDataset(str(tmp_path / "ds"), mode=mode)
+    try:
+        assert ds.string_columns() == ["smiles"]
+        for i in range(6):
+            assert ds.get_string("smiles", i) == smiles[i]
+        assert ds.get_string("smiles", -1) == smiles[-1]
+        # array samples unaffected by the extra column
+        _assert_graph_equal(graphs[2], ds[2])
+        with pytest.raises(KeyError):
+            ds.get_string("names", 0)
+    finally:
+        if mode == "shmem":
+            ds.close(unlink=True)
+
+
+def pytest_columnar_string_count_mismatch(tmp_path):
+    graphs = deterministic_graph_dataset(3, seed=8)
+    w = ColumnarWriter(str(tmp_path / "ds")).add(graphs)
+    w.add_string("smiles", ["only", "two"])
+    with pytest.raises(ValueError):
+        w.save()
+
+
 def pytest_columnar_through_training(tmp_path, monkeypatch):
     """Full train/predict through the columnar format via the public API."""
     monkeypatch.chdir(tmp_path)

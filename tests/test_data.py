@@ -293,6 +293,20 @@ def pytest_packed_loader_single_spec_and_coverage():
     assert real == len(graphs)
 
 
+def pytest_packed_loader_auto_budget_triplets():
+    """A directly constructed pack loader (spec=None) for a triplet model
+    must budget the triplet channel (ADVICE r3: it silently got
+    n_triplets=0 before with_triplets was plumbed into the auto path)."""
+    graphs = deterministic_graph_dataset(24, seed=3)
+    ld = GraphLoader(graphs, 4, pack=True, seed=0, with_triplets=True)
+    assert ld.spec.n_triplets > 0
+    b = next(iter(ld))
+    assert b.trip_kj is not None and b.trip_kj.shape[0] == ld.spec.n_triplets
+    # and the ladder auto path budgets it too
+    ld2 = GraphLoader(graphs, 4, seed=0, with_triplets=True)
+    assert ld2.spec.n_triplets > 0
+
+
 def pytest_packed_loader_sharded_stacking():
     """pack=True with num_shards: each stacked row is its own packed bin
     sharing the single spec; total real graphs are preserved."""

@@ -274,6 +274,20 @@ def pytest_example_multibranch_driver(tmp_path):
     assert "epoch 2:" in out
 
 
+def pytest_example_multidataset_hpo_parallel_workers(tmp_path):
+    """DeepHyper-analog parallel study (VERDICT r3 #8): the gfm example
+    orchestrates 2 worker subprocesses with disjoint trial_offset shards
+    and merges their JSONL records."""
+    out = _run_example(
+        "examples/multidataset_hpo/gfm.py", "--workers", "2",
+        "--num_trials", "2", "--num_per_dataset", "12", "--num_epoch", "1",
+        cwd=str(tmp_path), timeout=900,
+    )
+    assert "parallel study: 2 trials over 2 workers" in out
+    logs = list((tmp_path / "hpo_workers").glob("trials_worker*.jsonl"))
+    assert len(logs) == 2
+
+
 def pytest_example_qm9_hpo_driver(tmp_path):
     """HPO example driver: random search over the qm9-shaped flow."""
     out = _run_example(

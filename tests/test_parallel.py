@@ -243,6 +243,169 @@ def pytest_zero_composes_with_parallel_step():
         assert shard.size * 8 == leaf.size
 
 
+def pytest_zero2_grad_sharding_step():
+    """ZeRO-2 analog (VERDICT r3 #7): gradients constrained to P(data)
+    between the pmean and the optimizer update, composed with ZeRO-1 moment
+    sharding. Asserts (a) the step trains and tracks the stage-1 step's
+    losses (same math, different collective schedule), (b) params stay
+    replicated, moments stay sharded, and (c) the compiled zero2 program
+    does not allocate more than the stage-1 program (memory-delta guard;
+    the win shows as sharded live gradient buffers)."""
+    from hydragnn_tpu.parallel.mesh import zero2_grad_constraint
+
+    mesh = make_mesh()
+    config, loader, _ = _setup(num_shards=8)
+    model = create_model(config)
+    sample = next(iter(loader))
+    one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], sample)
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    def fresh_state():
+        # host round-trip: the donated steps delete their input buffers, and
+        # device_put aliases — a shared `variables` tree would die with the
+        # first state's donation
+        v = jax.tree_util.tree_map(np.asarray, variables)
+        state = replicate_state(TrainState.create(v, tx), mesh)
+        return state.replace(
+            opt_state=shard_optimizer_state(state.opt_state, mesh, min_size=8)
+        )
+
+    # eligibility at the min_size the steps below actually use: at least one
+    # grad-shaped leaf must shard, or the whole test is vacuous
+    data_n = mesh.shape["data"]
+    from hydragnn_tpu.parallel.mesh import _zero_leaf_eligible
+
+    assert any(
+        _zero_leaf_eligible(np.asarray(leaf), data_n, 8)
+        for leaf in jax.tree_util.tree_leaves(variables["params"])
+    ), "no eligible gradient leaf at this model size — grow the model"
+    del zero2_grad_constraint
+
+    step1 = make_parallel_train_step(model, tx, mesh)
+    step2 = make_parallel_train_step(
+        model, tx, mesh, zero2=True, zero2_min_size=8
+    )
+
+    rng = jax.random.PRNGKey(0)
+    s1, s2 = fresh_state(), fresh_state()
+    losses1, losses2 = [], []
+    for epoch in range(3):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            rng, sub = jax.random.split(rng)
+            s1, tot1, _ = step1(s1, batch, sub)
+            s2, tot2, _ = step2(s2, batch, sub)
+        losses1.append(float(tot1))
+        losses2.append(float(tot2))
+    assert losses2[-1] < losses2[0], f"zero2 did not converge: {losses2}"
+    # identical math, collective schedule aside: loss histories track
+    np.testing.assert_allclose(losses1, losses2, rtol=1e-4, atol=1e-5)
+    # params replicated, moments still sharded
+    p_leaf = jax.tree_util.tree_leaves(s2.params)[0]
+    assert len(p_leaf.sharding.device_set) == 8
+    assert any(
+        hasattr(leaf, "sharding") and not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree_util.tree_leaves(s2.opt_state)
+    )
+    # the constraint must actually change the lowered program — a silently
+    # no-op zero2_grad_constraint would otherwise pass every assert above
+    batch = next(iter(loader))
+    l1 = step1.lower(fresh_state(), batch, rng)
+    l2 = step2.lower(fresh_state(), batch, rng)
+    assert l1.as_text() != l2.as_text(), (
+        "zero2=True lowered to the identical program — the gradient "
+        "sharding constraint is a no-op"
+    )
+    # memory-delta guard via XLA's own memory analysis (may be unavailable
+    # on some backends — then the sharding asserts above stand alone)
+    try:
+        m1 = l1.compile().memory_analysis()
+        m2 = l2.compile().memory_analysis()
+        if m1 is not None and m2 is not None:
+            t1 = m1.temp_size_in_bytes
+            t2 = m2.temp_size_in_bytes
+            assert t2 <= t1 * 1.05, (
+                f"zero2 program allocates more temp memory: {t2} > {t1}"
+            )
+    except (AttributeError, NotImplementedError):
+        pass
+
+
+def pytest_zero2_single_host_api_path(tmp_path, monkeypatch):
+    """Optimizer.zero_stage=2 on a single-host multi-device run must take
+    the mesh step (code review r4: it silently downgraded to stage 1 —
+    the plain jit step has no gradient-sharding path). Asserts training
+    runs, moments are sharded, and the loaders emitted stacked batches."""
+    monkeypatch.chdir(tmp_path)
+    from hydragnn_tpu.api import run_training
+
+    raw = deterministic_graph_dataset(48, seed=2)
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "zero2_api",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 48},
+            "node_features": {
+                "name": ["x", "x2", "x3"],
+                "dim": [1, 1, 1],
+                "column_index": [0, 6, 7],
+            },
+            "graph_features": {
+                "name": ["sum_x_x2_x3"], "dim": [1], "column_index": [0],
+            },
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                # hidden 64: moment/grad leaves (64x64 kernels) clear the
+                # default ZeRO min_size=1024, so stage-2 really engages
+                "hidden_dim": 64,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1, "dim_sharedlayers": 64,
+                        "num_headlayers": 2, "dim_headlayers": [64, 64],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 2,
+                "batch_size": 16,
+                "Optimizer": {
+                    "type": "AdamW",
+                    "learning_rate": 0.01,
+                    "zero_stage": 2,
+                },
+            },
+        },
+        "Visualization": {"create_plots": False},
+    }
+    model, state, hist, cfg, loaders, mm = run_training(config)
+    assert all(np.isfinite(v) for v in hist["train"])
+    assert hist["train"][-1] < hist["train"][0]
+    # the loaders took the stacked-batch path (prepare_data gate in sync)
+    assert getattr(loaders[0], "num_shards", 1) == len(jax.devices())
+    # ZeRO-1 moment sharding composed in
+    assert any(
+        hasattr(leaf, "sharding") and not leaf.sharding.is_fully_replicated
+        for leaf in jax.tree_util.tree_leaves(state.opt_state)
+    )
+    p_leaf = jax.tree_util.tree_leaves(state.params)[0]
+    assert p_leaf.sharding.is_fully_replicated
+
+
 def _setup_multibranch(branch_count=2):
     """Two synthetic 'datasets' (dataset_id 0/1) on one 2-branch model."""
     import dataclasses

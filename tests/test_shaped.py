@@ -145,6 +145,52 @@ def pytest_smiles_parser_basics():
     assert g.num_nodes == 21  # aspirin C9H8O4
 
 
+def pytest_smiles_hybridization_columns():
+    """Hybridization one-hot columns [sp, sp2, sp3] (x columns 5-7) match
+    the reference's HSP/HSP2/HSP3 atom features (smiles_utils.py:58-70) on
+    ZINC-style structures; aromaticity is column 3."""
+
+    def hyb(s):
+        g = smiles_to_graph(s)
+        return g.x[:, 5:8], g.z
+
+    # ethane: both carbons sp3, hydrogens unhybridized
+    h, z = hyb("CC")
+    assert (h[z == 6] == [0, 0, 1]).all()
+    assert (h[z == 1] == [0, 0, 0]).all()
+    # ethene: sp2; ethyne: sp
+    h, z = hyb("C=C")
+    assert (h[z == 6] == [0, 1, 0]).all()
+    h, z = hyb("C#C")
+    assert (h[z == 6] == [1, 0, 0]).all()
+    # CO2: central carbon sp (two pi), oxygens sp2
+    h, z = hyb("O=C=O")
+    assert (h[z == 6] == [1, 0, 0]).all()
+    assert (h[z == 8] == [0, 1, 0]).all()
+    # benzene / pyridine: every ring atom sp2 (aromatic override)
+    for s in ("c1ccccc1", "c1ccncc1"):
+        h, z = hyb(s)
+        assert (h[z > 1] == [0, 1, 0]).all()
+    # acetonitrile: methyl sp3, nitrile C and N sp
+    h, z = hyb("CC#N")
+    carbons = h[z == 6]
+    assert (carbons[0] == [0, 0, 1]).all() and (carbons[1] == [1, 0, 0]).all()
+    assert (h[z == 7] == [1, 0, 0]).all()
+    # ether oxygen sp3; amine nitrogen sp3
+    h, z = hyb("COC")
+    assert (h[z == 8] == [0, 0, 1]).all()
+    h, z = hyb("CN(C)C")
+    assert (h[z == 7] == [0, 0, 1]).all()
+    # ZINC-style composite: aspirin — carbonyl C/O sp2, ring sp2, methyl sp3
+    g = smiles_to_graph("CC(=O)Oc1ccccc1C(=O)O")
+    sp2 = g.x[:, 6]
+    arom = g.x[:, 3]
+    assert (sp2[arom == 1] == 1).all()
+    # heavy atoms all carry exactly one hybridization label
+    heavy = g.z > 1
+    assert (g.x[heavy, 5:8].sum(axis=1) == 1).all()
+
+
 def pytest_smiles_parser_errors():
     with pytest.raises(SmilesError):
         parse_smiles("C(C")
@@ -164,6 +210,6 @@ def pytest_smiles_table_dataset_trains_shape():
     graphs = smiles_table_dataset(16)
     assert len(graphs) == 16
     for g in graphs:
-        assert g.x.shape[1] == 5
+        assert g.x.shape[1] == 8  # [Z, deg, charge, arom, nH, sp, sp2, sp3]
         assert g.graph_y.shape == (1,)
         assert np.isfinite(g.graph_y).all()
