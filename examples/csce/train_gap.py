@@ -30,27 +30,17 @@ from hydragnn_tpu.data.smiles import SmilesError, smiles_to_graph
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-_N_NODE_COLS = 8  # [Z, deg, charge, arom, nH, sp, sp2, sp3]
-
-
-def _stale_schema(path):
-    """True when a cached dataset predates the current feature table (e.g.
-    the 5-column pre-hybridization layout) — serve-from-cache would then
-    feed a config that indexes columns the arrays don't have."""
-    meta_path = os.path.join(path, "shard00000", "meta.json")
-    try:
-        with open(meta_path) as f:
-            meta = json.load(f)
-        return meta["fields"]["x"]["suffix"] != [_N_NODE_COLS]
-    except (OSError, KeyError, ValueError):
-        return True
-
-
 def build_dataset(path, num_samples, csv_file=None):
     if os.path.isdir(path):
-        if not _stale_schema(path):
+        # serve the cache only when its feature table matches the current
+        # reader; a confirmed-stale schema (e.g. pre-hybridization 5-column
+        # layout) is rebuilt. Unreadable metadata raises instead of
+        # deleting — the cache may hold real --csv data.
+        from hydragnn_tpu.data.smiles import columnar_schema_current
+
+        if columnar_schema_current(path):
             return
-        print(f"rebuilding {path}: cached schema is stale")
+        print(f"rebuilding {path}: cached feature schema is outdated")
         shutil.rmtree(path)
     smiles = None
     if csv_file:

@@ -121,6 +121,35 @@ def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
     raise ValueError(f"unknown Dataset.format {fmt!r}")
 
 
+def _zero_stage(training: Dict[str, Any]) -> int:
+    """ZeRO stage from the Optimizer block (reference: DeepSpeed ds_config
+    zero stage, run_training.py:136-149). ``use_zero_redundancy`` alone
+    means stage 1."""
+    opt = training.get("Optimizer", {})
+    use_zero = opt.get("use_zero_redundancy", False)
+    return int(opt.get("zero_stage", 1 if use_zero else 0))
+
+
+def _wants_zero2_mesh(training: Dict[str, Any]) -> bool:
+    """Whether a single-host multi-device run must take the mesh step for
+    ZeRO-2 (the gradient constraint lives inside the mesh step). ONE
+    predicate shared by prepare_data's loader gate and run_training's
+    step selection — they must agree or the mesh step sees unstacked
+    batches."""
+    import jax
+
+    if _zero_stage(training) < 2:
+        return False
+    if bool(training.get("branch_parallel", False)):
+        # no silent downgrade: the branch-parallel step has no ZeRO path
+        raise ValueError(
+            "Optimizer.zero_stage >= 2 is not supported together with "
+            "Training.branch_parallel (the branch-parallel step shards "
+            "decoders, not gradients/moments); drop one of the two"
+        )
+    return jax.process_count() == 1 and jax.local_device_count() > 1
+
+
 def prepare_data(
     config: Dict[str, Any], datasets: Optional[Tuple[List[Graph], ...]] = None
 ):
@@ -199,13 +228,8 @@ def prepare_data(
         num_shards = jax.local_device_count()
     # single-host ZeRO-2 runs the mesh step (the gradient-sharding
     # constraint lives there), so its batches must be stacked too —
-    # keep in lockstep with run_training's zero2_mesh predicate
-    if (
-        int(training.get("Optimizer", {}).get("zero_stage", 0)) >= 2
-        and jax.process_count() == 1
-        and jax.local_device_count() > 1
-        and not bool(training.get("branch_parallel", False))
-    ):
+    # _wants_zero2_mesh is the SAME predicate run_training uses
+    if _wants_zero2_mesh(training):
         num_shards = jax.local_device_count()
     if batch_size % num_shards != 0:
         raise ValueError(
@@ -405,23 +429,14 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # tx.update under the outer jit (outside its shard_map), so XLA
     # partitions the update by the moments' sharding and all-gathers the
     # resulting param updates (parallel/dp.py).
-    use_zero = training["Optimizer"].get("use_zero_redundancy", False)
-    # ZeRO stage selection (reference: DeepSpeed ds_config zero stage,
-    # run_training.py:136-149): stage 1 = moment sharding, stage 2 adds
+    # ZeRO stage selection: stage 1 = moment sharding, stage 2 adds
     # gradient sharding over the data axis inside the mesh step
-    # (parallel/dp.py zero2). use_zero_redundancy alone means stage 1.
-    zero_stage = int(training["Optimizer"].get("zero_stage", 1 if use_zero else 0))
-    use_zero = use_zero or zero_stage >= 1
-    # stage >= 2 needs the mesh step (the gradient constraint lives inside
-    # shard_map's caller), so single-host multi-device stage-2 runs take the
-    # mesh path below — this predicate must MATCH prepare_data's loader
-    # num_shards gate, or the mesh step would see unstacked batches
-    zero2_mesh = (
-        zero_stage >= 2
-        and not multihost
-        and not training.get("branch_parallel", False)
-        and jax.local_device_count() > 1
-    )
+    # (parallel/dp.py zero2); see _zero_stage/_wants_zero2_mesh
+    zero_stage = _zero_stage(training)
+    use_zero = zero_stage >= 1
+    # stage >= 2 needs the mesh step — same predicate prepare_data used
+    # for the loader num_shards gate (unstacked batches would break it)
+    zero2_mesh = _wants_zero2_mesh(training) and not multihost
     if (
         use_zero
         and zero_stage < 2

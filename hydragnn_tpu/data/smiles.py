@@ -21,6 +21,7 @@ molecule coordinates so geometric models (SchNet etc.) run on the result.
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Dict, List, Optional, Tuple
 
@@ -237,6 +238,33 @@ def _embed_3d(z: np.ndarray, bonds: List[Tuple[int, int, float]],
             break
         pos += 0.3 * force
     return pos
+
+
+# width of the node-feature table smiles_to_graph emits:
+# [Z, degree, charge, aromatic, n_H, sp, sp2, sp3]
+N_NODE_FEATURE_COLS = 8
+
+
+def columnar_schema_current(path: str) -> bool:
+    """True iff the columnar dataset at ``path`` was written with the
+    CURRENT SMILES feature table (x width ``N_NODE_FEATURE_COLS``).
+
+    For example drivers that cache `build_dataset` output: a dataset from
+    an older table (e.g. the 5-column pre-hybridization layout) must be
+    rebuilt or the config's ``input_node_features`` indexes columns the
+    arrays don't have. Raises (rather than reporting stale) when the
+    metadata cannot be read — a transient read failure must not trigger a
+    delete-and-rebuild of real data.
+    """
+    import json as _json
+
+    meta_path = os.path.join(path, "shard00000", "meta.json")
+    with open(meta_path) as f:  # OSError propagates: do NOT rebuild blindly
+        meta = _json.load(f)
+    try:
+        return meta["fields"]["x"]["suffix"] == [N_NODE_FEATURE_COLS]
+    except KeyError:
+        return False  # a meta without an x field IS a schema mismatch
 
 
 def _hybridization(z: int, aromatic: bool, charge: int,

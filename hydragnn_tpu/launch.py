@@ -1,0 +1,30 @@
+"""``python -m hydragnn_tpu.launch`` — build (once) and exec the native
+multi-host launcher.
+
+The C++ binary (native/launcher.cpp) is the torchrun/setup_ddp analog
+(reference: hydragnn/utils/distributed/distributed.py:52-198): it resolves
+(world_size, rank, coordinator) from scheduler envs or fans out ``--nprocs``
+local ranks, exports the ``HYDRAGNN_COORDINATOR``/``WORLD_SIZE``/``RANK``
+contract that ``hydragnn_tpu.parallel.setup_distributed`` consumes, and
+execs the training command::
+
+    python -m hydragnn_tpu.launch --nprocs 2 -- python train.py config.json
+    srun python -m hydragnn_tpu.launch -- python train.py config.json
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main(argv=None) -> None:
+    from .native.build import build_executable
+
+    binary = build_executable("launcher")
+    args = list(sys.argv[1:] if argv is None else argv)
+    os.execv(binary, [binary] + args)
+
+
+if __name__ == "__main__":
+    main()

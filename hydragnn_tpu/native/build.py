@@ -58,3 +58,30 @@ def build_library(name: str = "ddstore") -> str:
         with open(stamp, "w") as f:
             f.write(digest)
     return out
+
+
+def build_executable(name: str = "launcher") -> str:
+    """Compile ``<name>.cpp`` -> a standalone binary (e.g. the
+    ``hydragnn-launch`` multi-host bootstrap); return its path. Same
+    content-hash staleness rule as ``build_library``."""
+    src = os.path.join(_HERE, f"{name}.cpp")
+    out = os.path.join(
+        _HERE, "hydragnn-launch" if name == "launcher" else f"_{name}"
+    )
+    stamp = out + ".hash"
+    digest = _source_digest(src)
+    with _lock:
+        if os.path.exists(out) and os.path.exists(stamp):
+            with open(stamp) as f:
+                if f.read().strip() == digest:
+                    return out
+        cmd = ["g++", "-O3", "-std=c++17", "-o", out, src]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except FileNotFoundError as e:
+            raise RuntimeError("g++ not available to build native launcher") from e
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(f"native build failed:\n{e.stderr}") from e
+        with open(stamp, "w") as f:
+            f.write(digest)
+    return out

@@ -1,0 +1,225 @@
+// hydragnn-launch — native multi-host bootstrap for the JAX runtime.
+//
+// The reference boots its distributed runtime in Python: setup_ddp reads
+// OMPI/SLURM world envs, discovers the master address from LSB_HOSTS or a
+// SLURM nodelist regex, and calls dist.init_process_group
+// (hydragnn/utils/distributed/distributed.py:52-198). SURVEY §2.3 item 1
+// marks that host-side bootstrap as the piece to implement natively. This
+// is that piece for the TPU stack: it resolves (world_size, rank,
+// coordinator) BEFORE any Python starts, exports the contract
+// hydragnn_tpu.parallel.setup_distributed() consumes
+// (HYDRAGNN_COORDINATOR + WORLD_SIZE/RANK), and execs the training
+// command — so every interpreter boots already knowing its place, with no
+// Python-side scheduler sniffing in the hot path.
+//
+// Two modes:
+//   scheduler mode (default): world info comes from SLURM_*/OMPI_*/
+//     WORLD_SIZE envs (one launcher per scheduler-spawned task, like
+//     `srun hydragnn-launch -- python train.py ...`); the coordinator is
+//     --coordinator/HYDRAGNN_COORDINATOR, or the first host of
+//     SLURM_JOB_NODELIST (bracket ranges expanded, the distributed.py
+//     master-addr discovery), port HYDRAGNN_MASTER_PORT or 12355.
+//   local fan-out (--nprocs N): fork/exec N ranks on this host with a
+//     free loopback port as coordinator (the torchrun analog; also how CI
+//     exercises multi-process rendezvous without a scheduler). Signals
+//     forward to the children; exit code is the first nonzero child rc.
+//
+// Build: hydragnn_tpu.native.build.build_executable("launcher") or
+//   g++ -O3 -std=c++17 -o hydragnn-launch launcher.cpp
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <csignal>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+const char* getenv_or(const char* key, const char* fallback) {
+  const char* v = std::getenv(key);
+  return (v && *v) ? v : fallback;
+}
+
+// Expand the FIRST hostname of a SLURM nodelist: "frontier[0007-0010,0act12]"
+// -> "frontier0007"; "nid001,nid002" -> "nid001"; bare names pass through.
+// Only the first host matters (it hosts the coordinator), so a full
+// expansion is unnecessary.
+std::string first_host(const std::string& nodelist) {
+  std::string prefix, body;
+  size_t lb = nodelist.find('[');
+  size_t comma = nodelist.find(',');
+  if (lb == std::string::npos || (comma != std::string::npos && comma < lb)) {
+    // no bracket in the first item: take up to the first top-level comma
+    return nodelist.substr(0, comma == std::string::npos ? nodelist.size()
+                                                         : comma);
+  }
+  prefix = nodelist.substr(0, lb);
+  size_t rb = nodelist.find(']', lb);
+  body = nodelist.substr(lb + 1, rb == std::string::npos
+                                     ? std::string::npos
+                                     : rb - lb - 1);
+  // first range item, left endpoint, zero padding preserved
+  size_t end = body.find_first_of(",-");
+  return prefix + body.substr(0, end);
+}
+
+// (world_size, rank) from scheduler envs — same precedence as
+// hydragnn_tpu.parallel.mesh._scheduler_host_info.
+bool world_from_env(int* size, int* rank) {
+  struct {
+    const char *size_key, *rank_key;
+  } pairs[] = {
+      {"SLURM_NTASKS", "SLURM_PROCID"},
+      {"OMPI_COMM_WORLD_SIZE", "OMPI_COMM_WORLD_RANK"},
+      {"WORLD_SIZE", "RANK"},
+  };
+  for (auto& p : pairs) {
+    const char* s = std::getenv(p.size_key);
+    if (s && *s) {
+      *size = std::atoi(s);
+      *rank = std::atoi(getenv_or(p.rank_key, "0"));
+      return true;
+    }
+  }
+  return false;
+}
+
+int free_loopback_port() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 12355;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  socklen_t len = sizeof(addr);
+  int port = 12355;
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0 &&
+      getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len) == 0) {
+    port = ntohs(addr.sin_port);
+  }
+  close(fd);  // freed port may race another bind; jax retries rendezvous
+  return port;
+}
+
+std::vector<pid_t> g_children;
+
+void forward_signal(int sig) {
+  for (pid_t pid : g_children) kill(pid, sig);
+}
+
+int run_local_fanout(int nprocs, char** cmd) {
+  int port = free_loopback_port();
+  char coord[64];
+  std::snprintf(coord, sizeof(coord), "127.0.0.1:%d", port);
+  for (int rank = 0; rank < nprocs; ++rank) {
+    pid_t pid = fork();
+    if (pid < 0) {
+      std::perror("hydragnn-launch: fork");
+      forward_signal(SIGTERM);
+      return 1;
+    }
+    if (pid == 0) {
+      char ws[16], rk[16];
+      std::snprintf(ws, sizeof(ws), "%d", nprocs);
+      std::snprintf(rk, sizeof(rk), "%d", rank);
+      setenv("HYDRAGNN_COORDINATOR", coord, 1);
+      setenv("WORLD_SIZE", ws, 1);
+      setenv("RANK", rk, 1);
+      execvp(cmd[0], cmd);
+      std::perror("hydragnn-launch: execvp");
+      _exit(127);
+    }
+    g_children.push_back(pid);
+  }
+  std::signal(SIGINT, forward_signal);
+  std::signal(SIGTERM, forward_signal);
+  int first_fail = 0;
+  for (pid_t pid : g_children) {
+    int status = 0;
+    if (waitpid(pid, &status, 0) < 0) continue;
+    int rc = WIFEXITED(status) ? WEXITSTATUS(status)
+                               : 128 + WTERMSIG(status);
+    if (rc != 0 && first_fail == 0) {
+      first_fail = rc;
+      // one failed rank dooms the rendezvous group: take the rest down
+      // instead of letting them hang in collectives
+      forward_signal(SIGTERM);
+    }
+  }
+  return first_fail;
+}
+
+int run_scheduler_mode(const char* coordinator, char** cmd) {
+  int size = 1, rank = 0;
+  if (!world_from_env(&size, &rank)) {
+    std::fprintf(stderr,
+                 "hydragnn-launch: no scheduler world envs "
+                 "(SLURM_NTASKS/OMPI_COMM_WORLD_SIZE/WORLD_SIZE); "
+                 "running single-process\n");
+  }
+  std::string coord;
+  if (coordinator && *coordinator) {
+    coord = coordinator;
+  } else if (const char* env = std::getenv("HYDRAGNN_COORDINATOR");
+             env && *env) {
+    coord = env;
+  } else if (const char* nodes = std::getenv("SLURM_JOB_NODELIST");
+             nodes && *nodes) {
+    coord = first_host(nodes) + ":" + getenv_or("HYDRAGNN_MASTER_PORT",
+                                                "12355");
+  }
+  if (!coord.empty()) setenv("HYDRAGNN_COORDINATOR", coord.c_str(), 1);
+  char ws[16], rk[16];
+  std::snprintf(ws, sizeof(ws), "%d", size);
+  std::snprintf(rk, sizeof(rk), "%d", rank);
+  setenv("WORLD_SIZE", ws, 1);
+  setenv("RANK", rk, 1);
+  execvp(cmd[0], cmd);
+  std::perror("hydragnn-launch: execvp");
+  return 127;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int nprocs = 0;
+  const char* coordinator = nullptr;
+  int i = 1;
+  for (; i < argc; ++i) {
+    if (std::strcmp(argv[i], "--") == 0) {
+      ++i;
+      break;
+    }
+    if (std::strcmp(argv[i], "--nprocs") == 0 && i + 1 < argc) {
+      nprocs = std::atoi(argv[++i]);
+    } else if (std::strcmp(argv[i], "--coordinator") == 0 && i + 1 < argc) {
+      coordinator = argv[++i];
+    } else if (std::strcmp(argv[i], "--help") == 0) {
+      std::fprintf(
+          stderr,
+          "usage: hydragnn-launch [--nprocs N] [--coordinator HOST:PORT] "
+          "-- cmd args...\n"
+          "  --nprocs N   fork N local ranks with a loopback coordinator\n"
+          "  (default)    scheduler mode: world from SLURM/OMPI/WORLD_SIZE "
+          "envs, coordinator from --coordinator/HYDRAGNN_COORDINATOR/"
+          "SLURM_JOB_NODELIST\n");
+      return 2;
+    } else {
+      break;  // first non-flag token starts the command
+    }
+  }
+  if (i >= argc) {
+    std::fprintf(stderr, "hydragnn-launch: no command given (see --help)\n");
+    return 2;
+  }
+  char** cmd = argv + i;
+  if (nprocs > 0) return run_local_fanout(nprocs, cmd);
+  return run_scheduler_mode(coordinator, cmd);
+}

@@ -16,15 +16,16 @@ cd "$(dirname "$0")/.."
 unset PALLAS_AXON_POOL_IPS || true
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+TIER="fast-tier"
 if [ "${1:-}" = "--full" ]; then
   shift
   unset HYDRAGNN_CI_FAST || true
-  echo "== FULL tier: reference thresholds, full epochs =="
+  TIER="FULL-tier (reference thresholds, full epochs)"
 else
   export HYDRAGNN_CI_FAST=1
 fi
 
-echo "== fast-tier suite (8-device CPU mesh) =="
+echo "== $TIER suite (8-device CPU mesh) =="
 python -m pytest tests/ -x -q --deselect tests/test_multihost.py "$@"
 
 echo "== 2-process distributed tier =="

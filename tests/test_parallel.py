@@ -332,6 +332,19 @@ def pytest_zero2_grad_sharding_step():
         pass
 
 
+def pytest_zero2_branch_parallel_rejected():
+    """zero_stage>=2 with branch_parallel must error, not silently
+    downgrade (the branch-parallel step has no ZeRO path)."""
+    import pytest as _pytest
+
+    from hydragnn_tpu.api import _wants_zero2_mesh
+
+    with _pytest.raises(ValueError, match="branch_parallel"):
+        _wants_zero2_mesh(
+            {"branch_parallel": True, "Optimizer": {"zero_stage": 2}}
+        )
+
+
 def pytest_zero2_single_host_api_path(tmp_path, monkeypatch):
     """Optimizer.zero_stage=2 on a single-host multi-device run must take
     the mesh step (code review r4: it silently downgraded to stage 1 —
