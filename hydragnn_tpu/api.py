@@ -508,8 +508,18 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 state = state.replace(
                     opt_state=shard_optimizer_state(state.opt_state, mesh)
                 )
+            if zero_stage >= 3:
+                # ZeRO-3/FSDP: params stored sharded between steps, full
+                # copies transient inside each step (parallel/mesh.py
+                # shard_params_zero3)
+                from .parallel import shard_params_zero3
+
+                state = state.replace(
+                    params=shard_params_zero3(state.params, mesh)
+                )
             _pstep = make_parallel_train_step(
-                model, tx, mesh, cge, mp, zero2=zero_stage >= 2
+                model, tx, mesh, cge, mp,
+                zero2=zero_stage >= 2, zero3=zero_stage >= 3,
             )
             _peval = make_parallel_eval_step(model, mesh, cge, mp)
         step_fn = lambda s, b, r: _pstep(s, promote_batch(b, mesh), r)

@@ -52,6 +52,7 @@ def make_parallel_train_step(
     mixed_precision: bool = False,
     zero2: bool = False,
     zero2_min_size: int = 1024,
+    zero3: bool = False,
 ):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh.
 
@@ -59,7 +60,10 @@ def make_parallel_train_step(
     gradient reduction and the optimizer update (ZeRO-2 analog — see
     mesh.zero2_grad_constraint); compose with ``shard_optimizer_state`` on
     the state (same ``min_size``) for the full stage-2 memory profile
-    (sharded grads + moments, replicated params)."""
+    (sharded grads + moments, replicated params). ``zero3=True`` (with
+    ``shard_params_zero3`` applied to the state) additionally keeps the
+    UPDATED params sharded ``P(data)`` at step output — the FSDP profile:
+    full params exist only transiently inside the step."""
     cfg = model.cfg
 
     def per_device_loss(params, batch_stats, batch, rng):
@@ -132,7 +136,15 @@ def make_parallel_train_step(
             grads = zero2_grad_constraint(grads, mesh, min_size=zero2_min_size)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        if zero2:
+        if zero3:
+            # FSDP output contract: updated params leave the step sharded,
+            # so the gathered full copies are transient step-local buffers
+            from .mesh import zero3_param_constraint
+
+            params = zero3_param_constraint(
+                params, mesh, min_size=zero2_min_size
+            )
+        elif zero2:
             # pin the post-update params back to replicated: the sharded
             # updates make XLA all-gather here (the ZeRO-2 param exchange)
             # instead of falling back to full-grad replication upstream

@@ -132,6 +132,44 @@ def shard_optimizer_state(state, mesh: Mesh, min_size: int = 1024):
     return jax.tree_util.tree_map(place, state)
 
 
+def shard_params_zero3(params, mesh: Mesh, min_size: int = 1024):
+    """ZeRO-3/FSDP analog: store large PARAMETER leaves sharded ``P(data)``
+    between steps (reference capability: DeepSpeed ZeRO stage 3, accepted
+    by run_training.py:136-149).
+
+    The mesh step's shard_map consumes params at spec ``P()`` — XLA
+    inserts the transient all-gather at the program boundary (the FSDP
+    gather-at-use), and the step's output constraint re-shards the
+    updated params, so full parameters exist only inside one step's
+    lifetime. Same eligibility predicate as the stage-1/2 placements so
+    param, gradient, and moment slices all line up."""
+    data_n = mesh.shape[DATA_AXIS]
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+    rep = replicated(mesh)
+
+    def place(x):
+        if _zero_leaf_eligible(x, data_n, min_size):
+            return jax.device_put(x, sharded)
+        return jax.device_put(x, rep)
+
+    return jax.tree_util.tree_map(place, params)
+
+
+def zero3_param_constraint(params, mesh: Mesh, min_size: int = 1024):
+    """In-jit counterpart of ``shard_params_zero3``: pin updated parameter
+    leaves back to ``P(data)`` at the end of the step so XLA frees the
+    gathered full copies instead of keeping params replicated."""
+    data_n = mesh.shape[DATA_AXIS]
+    sharded = NamedSharding(mesh, P(DATA_AXIS))
+
+    def place(x):
+        if _zero_leaf_eligible(x, data_n, min_size):
+            return jax.lax.with_sharding_constraint(x, sharded)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+    return jax.tree_util.tree_map(place, params)
+
+
 def zero2_grad_constraint(grads, mesh: Mesh, min_size: int = 1024):
     """ZeRO-2 analog: constrain large gradient leaves to ``P(data)`` sharding
     inside the jitted step (reference capability: DeepSpeed ZeRO stage 2,
