@@ -271,6 +271,7 @@ class HydraModel(nn.Module):
                 cfg.activation,
                 final_activation=True,
                 mirror_init=True,
+                recovery_slope=0.1,
             )
         heads = []
         for ihead, (t, d) in enumerate(zip(cfg.output_type, cfg.output_dim)):
@@ -282,6 +283,7 @@ class HydraModel(nn.Module):
                         tuple(gh.dim_headlayers) + (out_d,),
                         cfg.activation,
                         mirror_init=True,
+                        recovery_slope=0.1,
                     )
                 )
             elif t == "node":
@@ -408,7 +410,8 @@ class MLPNode(nn.Module):
     def __call__(self, x, batch: GraphBatch):
         feats = tuple(self.hidden_dims) + (self.output_dim,)
         if self.nn_type == "mlp":
-            return MLP(feats, self.activation, mirror_init=True)(x)
+            return MLP(feats, self.activation, mirror_init=True,
+                       recovery_slope=0.1)(x)
         # mlp_per_node: a separate MLP per node position within each graph
         assert self.num_nodes > 0, "mlp_per_node requires fixed graph size"
         node_pos = _node_position_in_graph(batch)
@@ -418,7 +421,7 @@ class MLPNode(nn.Module):
             out_axes=0,
             variable_axes={"params": 0},
             split_rngs={"params": True},
-        )(feats, self.activation, mirror_init=True)
+        )(feats, self.activation, mirror_init=True, recovery_slope=0.1)
         # evaluate all per-node MLPs on gathered inputs ordered by node pos
         onehot = jax.nn.one_hot(node_pos % self.num_nodes, self.num_nodes, axis=0)
         xs = jnp.einsum("pn,nf->pnf", onehot, x)

@@ -71,10 +71,26 @@ class MLP(nn.Module):
     # decoder-position MLPs (nonnegative inputs) use the mirrored init so no
     # rng draw can produce a fully ReLU-dead layer; see mirrored_lecun_normal
     mirror_init: bool = False
+    # recovery slope for narrow decoder MLPs: with plain ReLU a dead unit
+    # has exactly zero gradient forever, and a 4-10 unit decoder measurably
+    # dies DURING training at some seeds (alive at init, killed by early
+    # updates + weight decay; the run then sits at the constant-prediction
+    # floor while the encoder still carries 0.9-correlated features).
+    # Call sites pass 0.1: it keeps every unit recoverable within an
+    # early-stopping patience window (0.01 measured too slow — a
+    # soft-dead layer's 100x attenuation left gradients under the
+    # recovery rate). Applied only when the configured activation is
+    # relu, to every activation this MLP applies (including the
+    # final_activation=True one of shared decoder stacks — those feed
+    # further head layers, so slightly-negative features are benign).
+    recovery_slope: float = 0.0
 
     @nn.compact
     def __call__(self, x):
         act = get_activation(self.activation)
+        if self.recovery_slope and self.activation.lower() == "relu":
+            slope = self.recovery_slope
+            act = lambda v: nn.leaky_relu(v, negative_slope=slope)
         for i, f in enumerate(self.features):
             last = i == len(self.features) - 1
             if self.mirror_init and (not last or self.final_activation):

@@ -386,9 +386,43 @@ def train_validate_test(
 
     rng = jax.random.PRNGKey(seed)
     hist: Dict[str, List[float]] = {"train": [], "val": [], "test": [], "lr": []}
+    # Early stopping / best-val checkpointing RETURN THE BEST STATE, not
+    # whatever the run degraded to during the patience window (the whole
+    # point of patience; e.g. a tiny decoder can ReLU-die epochs after its
+    # best epoch and the final state would evaluate at the constant-
+    # prediction floor). The copy is host-materialized so step donation
+    # can't invalidate it. SINGLE-PROCESS ONLY: the copy materializes
+    # sharded leaves (a collective on multi-host) but the improvement
+    # decision uses va_loss, which is weighted by each host's LOCAL
+    # real-graph count on ragged tails — hosts could disagree at a
+    # near-tie and deadlock in the gather. Multi-host runs keep the
+    # final state; their best-val weights live in the BestCheckpoint
+    # file (Training.Checkpoint).
+    return_best = (
+        training.get(
+            "return_best", bool(stopper is not None or checkpointer is not None)
+        )
+        and do_valtest
+        and jax.process_count() == 1
+    )
+    best_val = float("inf")
+    best_state = None
+    # Training.warmup_epochs: linear LR ramp over the first W epochs. Tiny
+    # ReLU decoders can be killed outright by the first full-LR updates
+    # (alive at init, dead by epoch 2 — the constant-prediction floor);
+    # ramping bounds the early step sizes without changing the recipe's
+    # steady state. The plateau scheduler only engages after the ramp.
+    warmup_epochs = int(training.get("warmup_epochs", 0))
+    base_lr = float(state.learning_rate)
     try:
         for epoch in range(num_epoch):
             t0 = time.time()
+            if warmup_epochs and epoch < warmup_epochs:
+                # ramp ends AT base_lr on the last warmup epoch; the
+                # plateau scheduler only engages afterwards
+                state = state.with_learning_rate(
+                    base_lr * (epoch + 1) / warmup_epochs
+                )
             profiler.epoch_begin(epoch)
             train_loader.set_epoch(epoch)
             with tr.timer("train"):
@@ -408,9 +442,10 @@ def train_validate_test(
             hist["test"].append(te_loss)
             profiler.epoch_end(epoch)
 
-            new_lr = scheduler.step(va_loss, state.learning_rate)
-            if new_lr != state.learning_rate:
-                state = state.with_learning_rate(new_lr)
+            if epoch >= warmup_epochs:
+                new_lr = scheduler.step(va_loss, state.learning_rate)
+                if new_lr != state.learning_rate:
+                    state = state.with_learning_rate(new_lr)
             hist["lr"].append(state.learning_rate)
 
             if log_fn is not None:
@@ -424,6 +459,11 @@ def train_validate_test(
                     f"test {te_loss:.5f} lr {state.learning_rate:.2e} ({time.time()-t0:.1f}s)"
                 )
 
+            if return_best and va_loss < best_val:
+                best_val = va_loss
+                from ..parallel.mesh import materialize_replicated
+
+                best_state = materialize_replicated(state)
             if checkpointer is not None:
                 checkpointer(state, va_loss, epoch)
             if stopper is not None and stopper(va_loss):
@@ -444,6 +484,8 @@ def train_validate_test(
     finally:
         profiler.close()
         preemption.uninstall()
+    if best_state is not None:
+        state = best_state
     return state, hist
 
 

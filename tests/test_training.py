@@ -22,7 +22,7 @@ from hydragnn_tpu.api import run_prediction, run_training
 _FAST = os.getenv("HYDRAGNN_CI_FAST") == "1"
 
 
-def make_config(mpnn_type, heads="single", num_epoch=40, num_configs=150, **arch_over):
+def make_config(mpnn_type, heads="single", num_epoch=100, num_configs=150, **arch_over):
     if _FAST:
         num_epoch = max(num_epoch // 2, 10)
         num_configs = min(num_configs, 100)
@@ -107,7 +107,26 @@ def make_config(mpnn_type, heads="single", num_epoch=40, num_configs=150, **arch
                 "num_epoch": num_epoch,
                 "perc_train": 0.7,
                 "loss_function_type": "mse",
-                "batch_size": 16,
+                # the reference CI's own training recipe (tests/inputs/
+                # ci.json Training: batch 32, lr 0.02, 100 epochs, early
+                # stopping) — measured necessary for seed robustness at
+                # full tier: at batch 16 x 40 epochs, GIN seed 0 collapsed
+                # to the conv-free minimum (decoder ALIVE at init thanks
+                # to mirrored init, then ground to zero by noisy early
+                # updates + AdamW decay on an under-learning path).
+                # patience raised 10 -> 25: the reference's patience 10
+                # cuts seed-dependent slow starts short (GIN seed 0:
+                # RMSE 0.2495 at patience 10 vs 0.2274 at 25; seed 2 kept
+                # improving to epoch 111). Early stopping returns the
+                # best-val state (train/loop.py return_best), and the
+                # decoder recovery slope (models/layers.py) removes the
+                # permanent-death mode entirely: measured GIN seeds 0-2 =
+                # 0.109/0.196/0.198, EGNN = 0.099/0.092/0.096 under this
+                # recipe (both previously hit the 0.2813 constant floor
+                # at seed 0).
+                "batch_size": 32,
+                "EarlyStopping": True,
+                "patience": 25,
                 "seed": training_seed,
                 "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
             },
@@ -178,8 +197,11 @@ def pytest_train_singlehead(mpnn_type, tmp_path, monkeypatch):
 
 @pytest.mark.parametrize("mpnn_type", ["SchNet", "EGNN", "PAINN"])
 def pytest_train_equivariant(mpnn_type, tmp_path, monkeypatch):
-    """Equivariant-mode variants (reference: tests/test_graphs.py:262-266)."""
-    cfg = make_config(mpnn_type, num_epoch=40, equivariance=True)
+    """Equivariant-mode variants (reference: tests/test_graphs.py:262-266).
+
+    Full recipe epochs (early stopping bounds runtime): the old 40-epoch
+    cap predated the batch-32 recipe and cut slope-recovery short."""
+    cfg = make_config(mpnn_type, equivariance=True)
     _check_thresholds(cfg, tmp_path, monkeypatch)
 
 
@@ -195,7 +217,6 @@ def pytest_train_gps_attention(mpnn_type, attn_type, tmp_path, monkeypatch):
     tests/test_graphs.py:235-249 runs GPS across edge models)."""
     cfg = make_config(
         mpnn_type,
-        num_epoch=30,
         global_attn_engine="GPS",
         global_attn_type=attn_type,
         global_attn_heads=8,
@@ -340,10 +361,10 @@ def pytest_train_vector_output(mpnn_type, tmp_path, monkeypatch):
     """Vector (multi-dim) node outputs with edge attributes across the
     reference's seven vector-capable models (tests/test_graphs.py:268-285,
     ci_vectoroutput.json: 2-dim node vector heads)."""
-    # reference-parity task shape: the reference's vector CI trains 80
-    # epochs with node head dims [40, 10] (ci_vectoroutput.json Training/
-    # output_heads.node)
-    cfg = make_config(mpnn_type, num_epoch=80)
+    # reference-parity task shape: node head dims [40, 10] per
+    # ci_vectoroutput.json; epochs follow the full recipe (100-cap + early
+    # stopping — the reference's vector config trains 80)
+    cfg = make_config(mpnn_type)
     # regroup the 3 scalar node columns as scalar x + 2-vector [x2, x3]
     cfg["Dataset"]["node_features"] = {
         "name": ["x", "x2x3_vec"],
