@@ -106,6 +106,10 @@ class ColumnarWriter:
     def save(self) -> str:
         os.makedirs(self.shard_dir, exist_ok=True)
         meta: Dict[str, Any] = {"num_samples": self._n, "fields": {}, "attrs": {}}
+        # Merge string columns into a LOCAL map so save() stays idempotent:
+        # mutating self._fields here would make a second save() see its own
+        # "strings/..." columns and raise (or double-encode after add_string).
+        merged: Dict[str, list] = dict(self._fields)
         for name, vals in self._strings.items():
             if len(vals) != self._n:
                 raise ValueError(
@@ -113,12 +117,12 @@ class ColumnarWriter:
                     f"{self._n} samples"
                 )
             key = f"strings/{name}"
-            if key in self._fields:
+            if key in merged:
                 raise ValueError(f"duplicate column {key!r}")
-            self._fields[key] = [
+            merged[key] = [
                 np.frombuffer(v.encode("utf-8"), np.uint8) for v in vals
             ]
-        for k, arrs in self._fields.items():
+        for k, arrs in merged.items():
             a0 = arrs[0]
             suffix = list(a0.shape[1:])
             dtype = np.dtype(a0.dtype)

@@ -213,21 +213,27 @@ def launch_hpo_workers(
     """Async multi-worker HPO orchestration (the DeepHyper analog: the
     reference carves a SLURM node list into per-trial srun launch commands,
     deephyper.py:47-177; here each worker is a subprocess — optionally
-    ssh-prefixed onto a carved host — exploring a disjoint ``trial_offset``
-    shard of the study and appending JSONL records the parent merges).
+    ssh-prefixed onto a carved host — drawing an INDEPENDENT sampler stream
+    and appending JSONL records the parent merges).
 
     ``argv_template`` tokens may contain ``{worker}``, ``{num_trials}``,
     ``{trial_offset}``, ``{results}`` placeholders. Trials are split as
-    evenly as possible; worker ``i`` gets ``trial_offset=trial_offset+i``
-    (a distinct sampler stream per worker, and ``trial_offset`` lets
-    independent parallel studies on different machines shard disjointly,
-    same as the sequential convention). ``timeout`` bounds the WHOLE study;
-    on timeout or a failed worker every remaining subprocess is terminated.
-    ``hosts`` round-robins workers over ssh (tokens are shell-quoted for
-    the remote side; ``workdir`` must live on a filesystem shared with the
-    hosts — on clusters without one, point it at the shared scratch the
-    scheduler provides, as the reference's per-node DeepHyper launches do).
-    Returns the merged ``(best_config, trials)``.
+    evenly as possible; worker ``i`` gets ``trial_offset=trial_offset+i``,
+    which seeds its sampler as ``seed + offset`` (run_hpo). That makes the
+    streams independent, NOT disjoint: in a small categorical space two
+    workers can draw the same config (the study simply spends a duplicate
+    trial, as the reference's parallel DeepHyper evaluator also can).
+    ``env`` entries are applied to each worker: exported into the local
+    subprocess environment (merged over ``os.environ``), and in ``hosts``
+    mode additionally prefixed onto the remote command as ``env K=V ...``
+    so they reach the remote process, not just the local ssh client.
+    ``timeout`` bounds the WHOLE study; on timeout or a failed worker every
+    remaining subprocess is terminated. ``hosts`` round-robins workers over
+    ssh (tokens are shell-quoted for the remote side; ``workdir`` must live
+    on a filesystem shared with the hosts — on clusters without one, point
+    it at the shared scratch the scheduler provides, as the reference's
+    per-node DeepHyper launches do). Returns the merged
+    ``(best_config, trials)``.
     """
     import time as _time
 
@@ -259,9 +265,13 @@ def launch_hpo_workers(
             ]
             if hosts:
                 # ssh concatenates the remote argv into one shell line —
-                # quote each token or paths with spaces/metachars re-split
+                # quote each token or paths with spaces/metachars re-split.
+                # env entries must ride the REMOTE command (Popen(env=...)
+                # would only configure the local ssh client).
                 import shlex
 
+                if env:
+                    argv = ["env"] + [f"{k}={v}" for k, v in env.items()] + argv
                 argv = ["ssh", hosts[i % len(hosts)]] + [
                     shlex.quote(t) for t in argv
                 ]
@@ -272,7 +282,7 @@ def launch_hpo_workers(
                     i,
                     subprocess.Popen(
                         argv, stdout=log, stderr=subprocess.STDOUT,
-                        env=dict(env) if env is not None else None,
+                        env={**os.environ, **env} if env is not None else None,
                     ),
                     res,
                 )

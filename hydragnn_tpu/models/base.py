@@ -115,6 +115,14 @@ class ModelConfig:
     # scatter (ops/segment.py segment_sum; loader sort_edges=True)
     sorted_aggregation: bool = False
     max_in_degree: int = 0
+    # --- decoder seed-robustness knobs (Architecture.decoder_mirror_init /
+    # Architecture.decoder_recovery_slope). Defaults are the seed-robust
+    # behavior (mirrored (w,-w) decoder init + leaky-ReLU(0.1) decoder hidden
+    # activations); set mirror_init=False, recovery_slope=0.0 for exact
+    # parity with the reference's plain-ReLU MLP decoders (Base.py:372-392,
+    # 692-752). See layers.MLP and docs/MIGRATION.md.
+    decoder_mirror_init: bool = True
+    decoder_recovery_slope: float = 0.1
 
     @property
     def num_heads(self) -> int:
@@ -270,8 +278,8 @@ class HydraModel(nn.Module):
                 (gh.dim_sharedlayers,) * gh.num_sharedlayers,
                 cfg.activation,
                 final_activation=True,
-                mirror_init=True,
-                recovery_slope=0.1,
+                mirror_init=cfg.decoder_mirror_init,
+                recovery_slope=cfg.decoder_recovery_slope,
             )
         heads = []
         for ihead, (t, d) in enumerate(zip(cfg.output_type, cfg.output_dim)):
@@ -282,8 +290,8 @@ class HydraModel(nn.Module):
                     _branch_bank(MLP, B, in_axes=(0,))(
                         tuple(gh.dim_headlayers) + (out_d,),
                         cfg.activation,
-                        mirror_init=True,
-                        recovery_slope=0.1,
+                        mirror_init=cfg.decoder_mirror_init,
+                        recovery_slope=cfg.decoder_recovery_slope,
                     )
                 )
             elif t == "node":
@@ -296,6 +304,8 @@ class HydraModel(nn.Module):
                             nn_type=nh.nn_type,
                             num_nodes=cfg.num_nodes or 0,
                             activation=cfg.activation,
+                            mirror_init=cfg.decoder_mirror_init,
+                            recovery_slope=cfg.decoder_recovery_slope,
                         )
                     )
                 elif nh.nn_type == "conv":
@@ -405,13 +415,15 @@ class MLPNode(nn.Module):
     nn_type: str
     num_nodes: int
     activation: str = "relu"
+    mirror_init: bool = True
+    recovery_slope: float = 0.1
 
     @nn.compact
     def __call__(self, x, batch: GraphBatch):
         feats = tuple(self.hidden_dims) + (self.output_dim,)
         if self.nn_type == "mlp":
-            return MLP(feats, self.activation, mirror_init=True,
-                       recovery_slope=0.1)(x)
+            return MLP(feats, self.activation, mirror_init=self.mirror_init,
+                       recovery_slope=self.recovery_slope)(x)
         # mlp_per_node: a separate MLP per node position within each graph
         assert self.num_nodes > 0, "mlp_per_node requires fixed graph size"
         node_pos = _node_position_in_graph(batch)
@@ -421,7 +433,8 @@ class MLPNode(nn.Module):
             out_axes=0,
             variable_axes={"params": 0},
             split_rngs={"params": True},
-        )(feats, self.activation, mirror_init=True, recovery_slope=0.1)
+        )(feats, self.activation, mirror_init=self.mirror_init,
+          recovery_slope=self.recovery_slope)
         # evaluate all per-node MLPs on gathered inputs ordered by node pos
         onehot = jax.nn.one_hot(node_pos % self.num_nodes, self.num_nodes, axis=0)
         xs = jnp.einsum("pn,nf->pnf", onehot, x)

@@ -132,6 +132,16 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
         sorted_aggregation=bool(arch.get("use_sorted_aggregation", False)),
         max_in_degree=int(arch.get("max_in_degree") or 0),
+        decoder_mirror_init=bool(
+            True if arch.get("decoder_mirror_init") is None
+            else arch["decoder_mirror_init"]
+        ),
+        # `or 0.1` would turn an intentional 0.0 into 0.1; only null/absent
+        # falls back to the default
+        decoder_recovery_slope=float(
+            0.1 if arch.get("decoder_recovery_slope") is None
+            else arch["decoder_recovery_slope"]
+        ),
         initial_bias=arch.get("initial_bias"),
         periodic_boundary_conditions=bool(arch.get("periodic_boundary_conditions", False)),
         max_neighbours=arch.get("max_neighbours"),

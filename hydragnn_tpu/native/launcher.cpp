@@ -33,6 +33,7 @@
 #include <sys/wait.h>
 #include <unistd.h>
 
+#include <cerrno>
 #include <csignal>
 #include <cstdio>
 #include <cstdlib>
@@ -111,7 +112,11 @@ int free_loopback_port() {
 std::vector<pid_t> g_children;
 
 void forward_signal(int sig) {
-  for (pid_t pid : g_children) kill(pid, sig);
+  // reaped entries are set to -1; skip them (a recycled PID could belong to
+  // an unrelated process, and kill(0/-1, ...) would signal the whole group)
+  for (pid_t pid : g_children) {
+    if (pid > 0) kill(pid, sig);
+  }
 }
 
 int run_local_fanout(int nprocs, char** cmd) {
@@ -140,14 +145,32 @@ int run_local_fanout(int nprocs, char** cmd) {
   }
   std::signal(SIGINT, forward_signal);
   std::signal(SIGTERM, forward_signal);
+  // Reap in COMPLETION order, not rank order: if rank k>0 crashes while
+  // rank 0 hangs in a collective waiting for it, a rank-ordered
+  // waitpid(pid_0) would block forever and never fire the group SIGTERM.
   int first_fail = 0;
-  for (pid_t pid : g_children) {
+  size_t reaped = 0;
+  while (reaped < g_children.size()) {
     int status = 0;
-    if (waitpid(pid, &status, 0) < 0) continue;
+    pid_t pid = waitpid(-1, &status, 0);
+    if (pid < 0) {
+      if (errno == EINTR) continue;
+      break;  // ECHILD: no children left to reap
+    }
+    int rank = -1;
+    for (size_t k = 0; k < g_children.size(); ++k) {
+      if (g_children[k] == pid) { rank = static_cast<int>(k); break; }
+    }
+    if (rank < 0) continue;  // not one of ours (shouldn't happen)
+    g_children[rank] = -1;  // dead: never signal this (recyclable) PID again
+    ++reaped;
     int rc = WIFEXITED(status) ? WEXITSTATUS(status)
                                : 128 + WTERMSIG(status);
     if (rc != 0 && first_fail == 0) {
       first_fail = rc;
+      std::fprintf(stderr,
+                   "hydragnn-launch: rank %d exited rc=%d; "
+                   "terminating remaining ranks\n", rank, rc);
       // one failed rank dooms the rendezvous group: take the rest down
       // instead of letting them hang in collectives
       forward_signal(SIGTERM);

@@ -8,6 +8,7 @@ import socket
 import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 
@@ -327,6 +328,39 @@ def pytest_native_launcher_fanout(tmp_path):
     )
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
     assert "LAUNCH_OK 0" in out.stdout and "LAUNCH_OK 1" in out.stdout
+
+
+def pytest_native_launcher_crash_takes_group_down(tmp_path):
+    """A crashing NON-first rank must take the whole fan-out down even while
+    rank 0 hangs: the launcher reaps in completion order (waitpid(-1)) and
+    SIGTERMs the group on the first nonzero exit. A rank-ordered reap would
+    block on rank 0 forever — the deadlock this test pins (launcher.cpp
+    run_local_fanout)."""
+    from hydragnn_tpu.native.build import build_executable
+
+    binary = build_executable("launcher")
+    child = tmp_path / "crashy.py"
+    child.write_text(
+        textwrap.dedent(
+            """
+            import os, sys, time
+            if os.environ["RANK"] == "1":
+                sys.exit(7)  # crash fast
+            time.sleep(600)  # rank 0 "hangs in a collective"
+            """
+        )
+    )
+    t0 = time.monotonic()
+    out = subprocess.run(
+        [binary, "--nprocs", "2", "--", sys.executable, str(child)],
+        capture_output=True, text=True, timeout=60,
+    )
+    elapsed = time.monotonic() - t0
+    # rc propagates the first failing rank; the hung rank 0 was SIGTERMed
+    # long before its 600 s sleep
+    assert out.returncode == 7, (out.returncode, out.stderr[-2000:])
+    assert elapsed < 30, f"launcher blocked {elapsed:.0f}s on the hung rank"
+    assert "rank 1 exited rc=7" in out.stderr
 
 
 def pytest_native_launcher_scheduler_mode(tmp_path):
