@@ -1,0 +1,68 @@
+"""Off-TPU rehearsal of bench.py's salvage ladder (VERDICT r4 #1).
+
+The axon pool has wedged for three consecutive rounds, so the salvage
+ladder — contact -> synthetic-PNA -> production, each stage banked the
+moment it completes, watcher thread turning a wedge into "best banked
+number + exit 2" — has never been exercised against a live device. This
+test rehearses the exact wedge path on CPU: bench.py runs the real
+ladder through stage (b), then `BENCH_WEDGE_AFTER=synthetic_pna` blocks
+the main thread the way a wedged PJRT recv does. The watcher thread must
+fire, print a NONZERO salvage JSON carrying the banked stage-(b)
+measurement, and exit 2 — so the one shot at a live pool runs a proven
+path (the reference has no analog; its benches assume healthy NCCL).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def pytest_salvage_ladder_banks_stage_b_on_wedge(tmp_path):
+    salvage = tmp_path / "salvage.jsonl"
+    env = {**os.environ}
+    # CPU-side jax subprocess: scrub the axon plugin env (playbook rule —
+    # a stray PALLAS_AXON_POOL_IPS would make this a TPU client)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = _REPO
+    env.update(
+        JAX_PLATFORMS="cpu",
+        BENCH_WEDGE_AFTER="synthetic_pna",
+        BENCH_TRIALS="1",
+        BENCH_SALVAGE_PATH=str(salvage),
+        JAX_COMPILATION_CACHE_DIR=str(tmp_path / "xla_cache"),
+    )
+    out = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=str(tmp_path),
+    )
+    # exit 2 = the watcher fired (a wedge must never look like a clean rc=0
+    # measurement), but the JSON line must carry the banked stage-(b) number
+    assert out.returncode == 2, (out.returncode, out.stderr[-3000:])
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert lines, out.stdout[-2000:]
+    rec = json.loads(lines[-1])
+    assert rec["value"] > 0, rec
+    assert "synthetic" in rec["metric"], rec["metric"]
+    assert rec["unit"] == "graphs/sec/chip"
+    assert rec["vs_baseline"] > 0, rec
+    assert "error" in rec and "wedge" in rec["error"], rec
+    assert rec["stages"]["synthetic_pna"]["graphs_per_sec"] > 0, rec
+    assert rec["stages"]["contact"]["ok"] is True, rec
+
+    # the exit came from the INJECTED wedge, not a coincidental stall: the
+    # hook banks a marker stage (which would also expose a BENCH_WEDGE_AFTER
+    # leaked into a live run)
+    assert rec["stages"]["wedge_rehearsal"] == {"after": "synthetic_pna"}, rec
+
+    # the salvage file banked each stage AS IT COMPLETED (a later wedge or
+    # kill -9 keeps them even without the watcher's final JSON)
+    recs = [json.loads(l) for l in salvage.read_text().splitlines()]
+    stages = [r["stage"] for r in recs]
+    assert stages == ["contact", "synthetic_pna", "wedge_rehearsal"], stages
+    assert recs[1]["graphs_per_sec"] == rec["stages"]["synthetic_pna"][
+        "graphs_per_sec"
+    ]
