@@ -148,20 +148,16 @@ def _flops_of(step, *args) -> float:
         return 0.0
 
 
-def _production_workload(mixed_precision=None, sorted_aggregation=None):
-    """SC25-shaped EGNN on the OC20-shaped dataset, via the real pipeline."""
+def _oc20_workload(arch, batch_size, num_configs, mixed_precision,
+                   pack_batches=False):
+    """Shared bench-config scaffold: OC20-shaped dataset + energy/forces
+    heads + the bench Training block around a caller-supplied Architecture.
+    One builder so the EGNN production cell and the MACE/DimeNet cells
+    cannot drift on the non-Architecture knobs."""
     from hydragnn_tpu.api import prepare_data
     from hydragnn_tpu.data.pipeline import split_dataset
     from hydragnn_tpu.data.synthetic import oc20_shaped_dataset
 
-    if mixed_precision is None:
-        mixed_precision = os.getenv("BENCH_MP", "1") == "1"
-    if sorted_aggregation is None:
-        sorted_aggregation = os.getenv("BENCH_SORTED", "0") == "1"
-    batch_size = int(os.getenv("BENCH_BATCH_SIZE", "32"))
-    hidden = int(os.getenv("BENCH_HIDDEN", "866"))
-    head_dim = int(os.getenv("BENCH_HEAD_DIM", "889"))
-    num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
     graphs = oc20_shaped_dataset(num_configs)
     tr, va, te = split_dataset(graphs, 0.9, seed=0)
     config = {
@@ -175,30 +171,7 @@ def _production_workload(mixed_precision=None, sorted_aggregation=None):
             "graph_features": {"name": ["energy"], "dim": [1]},
         },
         "NeuralNetwork": {
-            "Architecture": {
-                "mpnn_type": "EGNN",
-                "equivariance": True,
-                "radius": 5.0,
-                "max_neighbours": 20,
-                "hidden_dim": hidden,
-                "num_conv_layers": 4,
-                # Pallas sorted-segment aggregation A/B (BENCH_SORTED=1)
-                "use_sorted_aggregation": sorted_aggregation,
-                "task_weights": [1.0, 100.0],
-                "output_heads": {
-                    "graph": {
-                        "num_sharedlayers": 2,
-                        "dim_sharedlayers": 50,
-                        "num_headlayers": 3,
-                        "dim_headlayers": [head_dim, head_dim, head_dim],
-                    },
-                    "node": {
-                        "num_headlayers": 3,
-                        "dim_headlayers": [head_dim, head_dim, head_dim],
-                        "type": "mlp",
-                    },
-                },
-            },
+            "Architecture": arch,
             "Variables_of_interest": {
                 "input_node_features": [0, 1],
                 "output_names": ["energy", "forces"],
@@ -215,7 +188,7 @@ def _production_workload(mixed_precision=None, sorted_aggregation=None):
                 "num_pad_buckets": int(os.getenv("BENCH_PAD_BUCKETS", "6")),
                 # BENCH_PACK=1: packed batching — ONE spec (one compile,
                 # the dominant cost through the tunnel) at ~95% fill
-                "pack_batches": os.getenv("BENCH_PACK", "0") == "1",
+                "pack_batches": pack_batches,
                 # bf16 compute vs f32 master weights (BENCH_MP=0 for f32)
                 "mixed_precision": mixed_precision,
                 "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
@@ -226,8 +199,111 @@ def _production_workload(mixed_precision=None, sorted_aggregation=None):
     return config, train_loader
 
 
+def _production_workload(mixed_precision=None, sorted_aggregation=None):
+    """SC25-shaped EGNN on the OC20-shaped dataset, via the real pipeline."""
+    if mixed_precision is None:
+        mixed_precision = os.getenv("BENCH_MP", "1") == "1"
+    if sorted_aggregation is None:
+        sorted_aggregation = os.getenv("BENCH_SORTED", "0") == "1"
+    batch_size = int(os.getenv("BENCH_BATCH_SIZE", "32"))
+    hidden = int(os.getenv("BENCH_HIDDEN", "866"))
+    head_dim = int(os.getenv("BENCH_HEAD_DIM", "889"))
+    num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
+    arch = {
+        "mpnn_type": "EGNN",
+        "equivariance": True,
+        "radius": 5.0,
+        "max_neighbours": 20,
+        "hidden_dim": hidden,
+        "num_conv_layers": 4,
+        # Pallas sorted-segment aggregation A/B (BENCH_SORTED=1)
+        "use_sorted_aggregation": sorted_aggregation,
+        "task_weights": [1.0, 100.0],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 2,
+                "dim_sharedlayers": 50,
+                "num_headlayers": 3,
+                "dim_headlayers": [head_dim, head_dim, head_dim],
+            },
+            "node": {
+                "num_headlayers": 3,
+                "dim_headlayers": [head_dim, head_dim, head_dim],
+                "type": "mlp",
+            },
+        },
+    }
+    return _oc20_workload(
+        arch, batch_size, num_configs, mixed_precision,
+        pack_batches=os.getenv("BENCH_PACK", "0") == "1",
+    )
+
+
+def _model_cell_workload(model_name: str, mixed_precision=None):
+    """MACE / DimeNet A/B cells (VERDICT r4 #3): the two riskiest TPU
+    mappings in the zoo — recursive Clebsch-Gordan contractions and the
+    padded triplet channel — at SC25-class shapes on the same OC20-shaped
+    data + heads as the production EGNN cell, so their graphs/sec/chip and
+    MFU land in logs/ab_matrix.jsonl next to it. Reference counterparts are
+    the heaviest stacks in its zoo (MACEStack.py:546, DIMEStack.py:305)."""
+    if mixed_precision is None:
+        mixed_precision = os.getenv("BENCH_MP", "1") == "1"
+    per_model = {
+        # hidden 256, lmax 2 (VERDICT's floor); correlation 3 = the paper's
+        # production 4-body order
+        "MACE": {
+            "mpnn_type": "MACE",
+            "hidden_dim": int(os.getenv("BENCH_MACE_HIDDEN", "256")),
+            "num_conv_layers": 2,
+            "num_radial": 8,
+            "max_ell": 2,
+            "node_max_ell": 2,
+            "correlation": 3,
+            "radial_type": "bessel",
+            "envelope_exponent": 5,
+        },
+        # DimeNet++ block sizes at production scale; the triplet channel is
+        # budgeted by the loader's pad spec (data/pipeline.py with_triplets)
+        "DimeNet": {
+            "mpnn_type": "DimeNet",
+            "hidden_dim": int(os.getenv("BENCH_DIMENET_HIDDEN", "128")),
+            "num_conv_layers": 2,
+            "num_radial": 6,
+            "num_spherical": 7,
+            "basis_emb_size": 8,
+            "int_emb_size": 64,
+            "out_emb_size": 256,
+            "num_before_skip": 1,
+            "num_after_skip": 2,
+            "envelope_exponent": 5,
+        },
+    }
+    arch = dict(per_model[model_name])
+    arch.update(
+        radius=5.0,
+        max_neighbours=20,
+        task_weights=[1.0, 100.0],
+        output_heads={
+            "graph": {
+                "num_sharedlayers": 2,
+                "dim_sharedlayers": 50,
+                "num_headlayers": 2,
+                "dim_headlayers": [256, 256],
+            },
+            "node": {
+                "num_headlayers": 2,
+                "dim_headlayers": [256, 256],
+                "type": "mlp",
+            },
+        },
+    )
+    batch_size = int(os.getenv("BENCH_CELL_BATCH_SIZE", "16"))
+    num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
+    return _oc20_workload(arch, batch_size, num_configs, mixed_precision)
+
+
 def _bench_production(mixed_precision=None, sorted_aggregation=None,
-                      profile=None, env_overrides=None):
+                      profile=None, env_overrides=None, workload=None):
     import jax
     import numpy as np
 
@@ -241,9 +317,12 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
         saved[k] = os.environ.get(k)
         os.environ[k] = v
     try:
-        config, loader = _production_workload(
-            mixed_precision, sorted_aggregation
-        )
+        if workload is None:
+            config, loader = _production_workload(
+                mixed_precision, sorted_aggregation
+            )
+        else:
+            config, loader = _model_cell_workload(workload, mixed_precision)
     finally:
         for k, v in saved.items():
             if v is None:
@@ -449,6 +528,10 @@ def main_ab():
         {"mp": True, "sorted": False, "env": {"BENCH_PACK": "1"}, "tag": "pack"},
         {"mp": True, "sorted": False, "env": {"BENCH_BATCH_SIZE": "64"},
          "tag": "bs64"},
+        # the two riskiest TPU mappings get their own banked cells
+        # (VERDICT r4 #3); last so a mid-matrix wedge keeps the EGNN matrix
+        {"mp": True, "sorted": False, "model": "MACE", "tag": "mace"},
+        {"mp": True, "sorted": False, "model": "DimeNet", "tag": "dimenet"},
     ]
     n_done = 0
     for cell in cells:
@@ -459,8 +542,10 @@ def main_ab():
                 sorted_aggregation=sorted_agg,
                 # profile only the production default cell (mp on, sorted off)
                 profile=(mp and not sorted_agg and "env" not in cell
+                         and "model" not in cell
                          and os.getenv("BENCH_PROFILE", "0") == "1"),
                 env_overrides=cell.get("env"),
+                workload=cell.get("model"),
             )
         except Exception as e:
             # a failing cell (e.g. an OOM at batch 64) must not sink the
@@ -500,8 +585,10 @@ def main_ab():
         print(line, flush=True)
         with open(out_path, "a") as fh:
             fh.write(line + "\n")
-        if mp and not sorted_agg and "env" not in cell:
+        if mp and not sorted_agg and "env" not in cell and "model" not in cell:
             # the production default cell doubles as the ladder's stage (c)
+            # ("model" cells excluded: MACE/DimeNet must not overwrite the
+            # EGNN production number the salvage JSON reports)
             _record_stage(
                 "production",
                 {

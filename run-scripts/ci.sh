@@ -34,4 +34,7 @@ python -m pytest tests/test_multihost.py -x -q
 echo "== multichip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
+echo "== multiproc dryrun (2 procs x 4 devices, DCN+ICI composition) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip_multiproc(2, 4)"
+
 echo "CI OK"

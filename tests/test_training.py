@@ -550,3 +550,55 @@ def pytest_train_pack_batches_dimenet(tmp_path, monkeypatch):
     assert hist["train"][-1] < hist["train"][0]
     tl = loaders[0]
     assert len(tl.ladder.specs) == 1 and tl.spec.n_triplets > 0
+
+
+def pytest_mirrored_init_no_dead_decoder_layer_200_seeds():
+    """Property test of the mirrored (w,-w) decoder init's claimed guarantee
+    (VERDICT r4 #6): at NO seed can a decoder hidden layer be ReLU-dead at
+    init. The hazard: decoder inputs are post-ReLU encoder features, so a
+    zero-bias unit is dead on the whole dataset iff w.x < 0 for every
+    sample; with the matrix's 4-10 unit decoders and highly correlated
+    (near-rank-1) encoder features, EVERY unit drawing dead is seed-visible
+    (the round-3 seed-0 collapse). Mirrored pairs make one of (w, -w)
+    active for any input with w.x != 0 — per SAMPLE, not just per dataset.
+
+    200 seeds x widths {4, 8, 10} on adversarial near-rank-1 nonnegative
+    inputs: every sample must keep an active unit under mirrored init,
+    while plain LeCun init at width 4 must show >= 1 fully dead layer over
+    the same 200 seeds (P[no dead draw] ~ 0.9375^200 ~ 2e-6) — proving the
+    test can detect the failure it guards against.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.models.layers import mirrored_lecun_normal
+
+    rng = np.random.default_rng(0)
+    fan_in = 8
+    # dominant nonnegative direction + tiny noise: the correlated encoder
+    # regime where independent units all die together
+    base = np.abs(rng.normal(size=(1, fan_in))).astype(np.float32)
+    noise = 0.001 * np.abs(rng.normal(size=(16, fan_in))).astype(np.float32)
+    x = jnp.asarray(np.linspace(0.5, 2.0, 16, dtype=np.float32)[:, None]
+                    * base + noise)
+
+    mirrored = mirrored_lecun_normal()
+    plain = jax.nn.initializers.lecun_normal()
+    plain_dead = 0
+    for seed in range(200):
+        key = jax.random.PRNGKey(seed)
+        for width in (4, 8, 10):
+            k = mirrored(key, (fan_in, width))
+            acts = jax.nn.relu(x @ k)
+            alive_per_sample = (acts > 0).any(axis=1)
+            assert bool(alive_per_sample.all()), (
+                f"mirrored init drew a dead decoder layer: seed {seed}, "
+                f"width {width}"
+            )
+        kp = plain(key, (fan_in, 4))
+        if not bool((jax.nn.relu(x @ kp) > 0).any()):
+            plain_dead += 1
+    assert plain_dead > 0, (
+        "plain LeCun init never drew a dead width-4 layer in 200 seeds — "
+        "the adversarial input no longer exercises the hazard"
+    )
