@@ -270,11 +270,35 @@ def md17_shaped_dataset(
             template[placed] = cand
             placed += 1
     graphs: List[Graph] = []
-    for _ in range(number_configurations):
+    # Boltzmann-style acceptance (round 5): thermal sampling never visits
+    # the LJ repulsive wall, but isotropic jitter does — measured on the
+    # unfiltered generator, 17% of draws contained a near-contact pair with
+    # per-atom |F| > 10 (up to ~250, vs a 0.59 mean |component|). Those
+    # samples dominate any force objective: across a recipe sweep NO model
+    # family learned forces (corr ~0.02). Rejecting draws whose max
+    # per-atom |force| exceeds ``force_cap`` keeps ~3/4 of draws and
+    # restores the near-equilibrium force distribution real MD17
+    # trajectories have (a Boltzmann ensemble suppresses the wall
+    # exponentially). Deterministic: same rng stream, draws until accepted.
+    force_cap = 5.0
+    attempts = 0
+    max_attempts = 100 * number_configurations
+    while len(graphs) < number_configurations:
+        attempts += 1
+        if attempts > max_attempts:
+            # a jitter large enough to put ~every draw inside the LJ wall
+            # must fail loudly, not spin forever
+            raise ValueError(
+                f"md17_shaped_dataset: acceptance rate "
+                f"{len(graphs)}/{attempts} too low for jitter={jitter} "
+                f"(force cap {force_cap}); reduce jitter"
+            )
         pos = template + rng.normal(0.0, jitter, (n, 3))
         senders, receivers = radius_graph(pos, radius, max_neighbours)
         senders, receivers = _symmetrize_edges(senders, receivers)
         energy, forces = _lj_targets(pos, senders, receivers, 0.2, 1.1)
+        if float(np.abs(forces).max()) > force_cap:
+            continue
         graphs.append(
             Graph(
                 x=z[:, None].astype(np.float32),
