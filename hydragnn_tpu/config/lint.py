@@ -1,0 +1,281 @@
+"""Config migration lint: audit a (reference-style) JSON config against this
+framework's config surface.
+
+The JSON surface is intentionally the reference's (SURVEY §2 row 2;
+config completion in config/config.py), so most reference configs run
+unchanged. This tool makes the remainder explicit instead of silent: for
+every key it reports whether it is HANDLED here, NOT-APPLICABLE by design
+on TPU (with the equivalent to use instead), a LEGACY reference key with a
+direct replacement, or UNKNOWN (likely a typo — unknown keys are otherwise
+ignored by config completion, which is how the reference behaves too).
+
+Usage:
+    python -m hydragnn_tpu.config.lint path/to/config.json
+    >>> from hydragnn_tpu.config.lint import lint_config
+    >>> findings = lint_config(json.load(open("config.json")))
+
+Reference key census: union of /root/reference/examples/*/*.json and
+tests/inputs/*.json key paths (see docs/MIGRATION.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List
+
+# sub-dicts whose members are schema'd elsewhere (heads/optimizer/features)
+# or are free-form — lint stops descending at these paths
+_OPAQUE = {
+    "NeuralNetwork.Architecture.output_heads",
+    "NeuralNetwork.Training.Optimizer",
+    "NeuralNetwork.Training.Checkpoint",
+    "Dataset.node_features",
+    "Dataset.graph_features",
+    "Dataset.path",
+    "Dataset.synthetic",
+    "Dataset.lennard_jones",
+}
+
+# exact key paths this framework consumes (config/config.py completion,
+# models/create.py, api.py, train/loop.py, docs/CONFIG.md)
+_HANDLED = {
+    "Verbosity.level",
+    "Dataset.name",
+    "Dataset.format",
+    "Dataset.path",
+    "Dataset.node_features",
+    "Dataset.graph_features",
+    "Dataset.compositional_stratified_splitting",
+    "Dataset.rotational_invariance",
+    "Dataset.normalize",
+    "Dataset.synthetic",
+    "Dataset.lennard_jones",
+    "NeuralNetwork.Profile",
+    "NeuralNetwork.Profile.enable",
+    "NeuralNetwork.Profile.target_epoch",
+    "NeuralNetwork.Architecture.mpnn_type",
+    "NeuralNetwork.Architecture.activation_function",
+    "NeuralNetwork.Architecture.equivariance",
+    "NeuralNetwork.Architecture.radius",
+    "NeuralNetwork.Architecture.max_neighbours",
+    "NeuralNetwork.Architecture.periodic_boundary_conditions",
+    "NeuralNetwork.Architecture.hidden_dim",
+    "NeuralNetwork.Architecture.num_conv_layers",
+    "NeuralNetwork.Architecture.output_heads",
+    "NeuralNetwork.Architecture.task_weights",
+    "NeuralNetwork.Architecture.output_dim",
+    "NeuralNetwork.Architecture.output_type",
+    "NeuralNetwork.Architecture.input_dim",
+    "NeuralNetwork.Architecture.edge_dim",
+    "NeuralNetwork.Architecture.edge_features",
+    "NeuralNetwork.Architecture.num_nodes",
+    "NeuralNetwork.Architecture.pna_deg",
+    "NeuralNetwork.Architecture.num_gaussians",
+    "NeuralNetwork.Architecture.num_filters",
+    "NeuralNetwork.Architecture.num_radial",
+    "NeuralNetwork.Architecture.num_spherical",
+    "NeuralNetwork.Architecture.envelope_exponent",
+    "NeuralNetwork.Architecture.radial_type",
+    "NeuralNetwork.Architecture.distance_transform",
+    "NeuralNetwork.Architecture.basis_emb_size",
+    "NeuralNetwork.Architecture.int_emb_size",
+    "NeuralNetwork.Architecture.out_emb_size",
+    "NeuralNetwork.Architecture.num_before_skip",
+    "NeuralNetwork.Architecture.num_after_skip",
+    "NeuralNetwork.Architecture.max_ell",
+    "NeuralNetwork.Architecture.node_max_ell",
+    "NeuralNetwork.Architecture.correlation",
+    "NeuralNetwork.Architecture.avg_num_neighbors",
+    "NeuralNetwork.Architecture.global_attn_engine",
+    "NeuralNetwork.Architecture.global_attn_type",
+    "NeuralNetwork.Architecture.global_attn_heads",
+    "NeuralNetwork.Architecture.pe_dim",
+    "NeuralNetwork.Architecture.max_nodes_per_graph",
+    "NeuralNetwork.Architecture.freeze_conv_layers",
+    "NeuralNetwork.Architecture.initial_bias",
+    "NeuralNetwork.Architecture.use_sorted_aggregation",
+    "NeuralNetwork.Architecture.max_in_degree",
+    "NeuralNetwork.Architecture.decoder_mirror_init",
+    "NeuralNetwork.Architecture.decoder_recovery_slope",
+    "NeuralNetwork.Variables_of_interest.input_node_features",
+    "NeuralNetwork.Variables_of_interest.output_names",
+    "NeuralNetwork.Variables_of_interest.output_index",
+    "NeuralNetwork.Variables_of_interest.output_dim",
+    "NeuralNetwork.Variables_of_interest.type",
+    "NeuralNetwork.Variables_of_interest.denormalize_output",
+    "NeuralNetwork.Variables_of_interest.graph_feature_names",
+    "NeuralNetwork.Variables_of_interest.graph_feature_dims",
+    "NeuralNetwork.Variables_of_interest.node_feature_names",
+    "NeuralNetwork.Variables_of_interest.node_feature_dims",
+    "NeuralNetwork.Training.num_epoch",
+    "NeuralNetwork.Training.batch_size",
+    "NeuralNetwork.Training.perc_train",
+    "NeuralNetwork.Training.loss_function_type",
+    "NeuralNetwork.Training.EarlyStopping",
+    "NeuralNetwork.Training.patience",
+    "NeuralNetwork.Training.seed",
+    "NeuralNetwork.Training.continue",
+    "NeuralNetwork.Training.startfrom",
+    "NeuralNetwork.Training.Checkpoint",
+    "NeuralNetwork.Training.checkpoint_warmup",
+    "NeuralNetwork.Training.compute_grad_energy",
+    "NeuralNetwork.Training.conv_checkpointing",
+    "NeuralNetwork.Training.Optimizer",
+    "NeuralNetwork.Training.mixed_precision",
+    "NeuralNetwork.Training.pack_batches",
+    "NeuralNetwork.Training.num_pad_buckets",
+    "NeuralNetwork.Training.size_bucketed_batching",
+    "NeuralNetwork.Training.branch_parallel",
+    "NeuralNetwork.Training.warmup_epochs",
+    "NeuralNetwork.Training.walltime_minutes",
+    "Visualization.create_plots",
+}
+
+# reference keys that are intentionally NOT consumed here, with the
+# TPU-native answer a migrating user needs
+_NOT_APPLICABLE = {
+    "NeuralNetwork.Architecture.SyncBatchNorm": (
+        "no DDP process groups to sync: batch-norm statistics are computed "
+        "over the (masked) global batch inside the jitted step "
+        "(models/layers.py MaskedBatchNorm); multi-device runs reduce via "
+        "the mesh, so the torch SyncBatchNorm wrapper has no analog to "
+        "enable"
+    ),
+}
+
+# a couple of reference tests/inputs configs predate the NeuralNetwork
+# nesting and put Architecture at the top level — one uniform rename
+_LEGACY_TOPLEVEL_ARCH = (
+    "legacy top-level 'Architecture' section (pre-NeuralNetwork layout, "
+    "reference tests/inputs/ci_periodic.json) — nest the keys under "
+    "NeuralNetwork.Architecture ('periodic' becomes "
+    "'periodic_boundary_conditions'; 'predicted_value_option' is "
+    "superseded by Variables_of_interest.output_index/type)"
+)
+
+# legacy/renamed reference keys -> what to use here
+_LEGACY = {
+    "NeuralNetwork.Training.early_stopping": (
+        "use 'EarlyStopping' (capitalized, the reference's current key)"
+    ),
+    "NeuralNetwork.Training.epoch_start": (
+        "resume is 'Training.continue: 1' (+ optional 'startfrom'); the "
+        "epoch counter restores from the checkpoint"
+    ),
+    "NeuralNetwork.Architecture.predicted_value_option": (
+        "superseded by Variables_of_interest.output_index/type (the "
+        "reference itself migrated off this key)"
+    ),
+    "Visualization.plot_init_solution": (
+        "visualizer plot families are selected by the postprocess API "
+        "(postprocess/visualizer.py); 'create_plots' gates them all"
+    ),
+    "Visualization.plot_hist_solution": (
+        "visualizer plot families are selected by the postprocess API "
+        "(postprocess/visualizer.py); 'create_plots' gates them all"
+    ),
+}
+
+# top-level Dataset/Architecture synonyms appearing in some reference
+# example configs at non-standard paths
+_TOPLEVEL_SECTIONS = ("Verbosity", "Dataset", "NeuralNetwork", "Visualization")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    status: str  # handled | not-applicable | legacy | unknown
+    path: str
+    message: str = ""
+
+
+def _walk(d: Dict[str, Any], prefix: str = "") -> List[str]:
+    out = []
+    for k, v in d.items():
+        p = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
+        out.append(p)
+        if isinstance(v, dict) and p not in _OPAQUE:
+            out.extend(_walk(v, p))
+    return out
+
+
+def lint_config(config: Dict[str, Any]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _walk(config):
+        if path in _NOT_APPLICABLE:
+            findings.append(Finding("not-applicable", path, _NOT_APPLICABLE[path]))
+        elif path == "Architecture" or path.startswith("Architecture."):
+            findings.append(Finding("legacy", path, _LEGACY_TOPLEVEL_ARCH))
+        elif path in _LEGACY:
+            findings.append(Finding("legacy", path, _LEGACY[path]))
+        elif path in _HANDLED or path in _TOPLEVEL_SECTIONS:
+            findings.append(Finding("handled", path))
+        elif any(path.startswith(op + ".") for op in _OPAQUE):
+            continue  # schema'd elsewhere
+        elif path in (
+            "NeuralNetwork.Architecture",
+            "NeuralNetwork.Variables_of_interest",
+            "NeuralNetwork.Training",
+            "NeuralNetwork.Profile",
+        ):
+            findings.append(Finding("handled", path))
+        else:
+            findings.append(
+                Finding(
+                    "unknown",
+                    path,
+                    "not consumed by this framework (config completion "
+                    "ignores unknown keys, matching the reference's "
+                    "behavior) — check for a typo or see docs/CONFIG.md",
+                )
+            )
+    return findings
+
+
+def format_report(findings: List[Finding]) -> str:
+    order = {"unknown": 0, "legacy": 1, "not-applicable": 2, "handled": 3}
+    lines = []
+    counts: Dict[str, int] = {}
+    for f in sorted(findings, key=lambda f: (order[f.status], f.path)):
+        counts[f.status] = counts.get(f.status, 0) + 1
+        if f.status == "handled":
+            continue
+        lines.append(f"[{f.status}] {f.path}: {f.message}")
+    lines.append(
+        "summary: "
+        + ", ".join(f"{counts.get(s, 0)} {s}" for s in order)
+    )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import sys
+
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m hydragnn_tpu.config.lint config.json")
+        return 2
+    # exit codes: 0 = clean, 1 = unknown keys found, 2 = could not lint —
+    # migration scripts branch on 1 vs 2
+    try:
+        with open(argv[0]) as fh:
+            config = json.load(fh)
+    except OSError as e:
+        print(f"hydragnn_tpu.config.lint: cannot read {argv[0]}: {e}")
+        return 2
+    except json.JSONDecodeError as e:
+        print(f"hydragnn_tpu.config.lint: {argv[0]} is not valid JSON: {e}")
+        return 2
+    if not isinstance(config, dict):
+        print(
+            f"hydragnn_tpu.config.lint: {argv[0]} is a JSON "
+            f"{type(config).__name__}, expected an object"
+        )
+        return 2
+    findings = lint_config(config)
+    print(format_report(findings))
+    return 1 if any(f.status == "unknown" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
