@@ -191,6 +191,20 @@ def update_config(
     # ---- Pallas sorted-segment aggregation: static in-degree bound over
     # EVERY split (eval batches must satisfy the cap too; the kernel gives
     # unspecified sums for real segments past it — ops/pallas_segment.py)
+    #
+    # r5 default: ON when jitting for TPU — the first live A/B matrix
+    # measured the Pallas MXU route +16.5% over XLA's scatter at the SC25
+    # production shape (883.1 vs 757.9 graphs/sec/chip, mp on;
+    # logs/ab_matrix.jsonl r5) with loss agreement to 3 decimals and an
+    # exact interpret==dense dryrun check. Non-TPU backends keep the
+    # default off: the Pallas route never activates there
+    # (ops/segment.py:_pallas_route_enabled) and leaving the edge order
+    # unsorted keeps CPU batches byte-stable with earlier rounds.
+    # Explicit true/false in the config always wins.
+    if "use_sorted_aggregation" not in arch or arch["use_sorted_aggregation"] is None:
+        import jax
+
+        arch["use_sorted_aggregation"] = jax.default_backend() == "tpu"
     if arch.get("use_sorted_aggregation"):
         top = 1
         for g in (*trainset, *valset, *testset):
@@ -205,7 +219,6 @@ def update_config(
                 f"max in-degree {top}; remove the key to auto-measure"
             )
         arch["max_in_degree"] = int(supplied or top)
-    arch.setdefault("use_sorted_aggregation", False)
     arch.setdefault("max_in_degree", 0)
 
     # CGCNN keeps hidden dim = input dim without global attention

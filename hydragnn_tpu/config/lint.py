@@ -190,6 +190,8 @@ class Finding:
 
 
 def _walk(d: Dict[str, Any], prefix: str = "") -> List[str]:
+    # never descends into _OPAQUE subtrees, so no yielded path has an
+    # opaque entry as a proper prefix (their children are schema'd elsewhere)
     out = []
     for k, v in d.items():
         p = f"{prefix}{k}" if not prefix else f"{prefix}.{k}"
@@ -210,8 +212,6 @@ def lint_config(config: Dict[str, Any]) -> List[Finding]:
             findings.append(Finding("legacy", path, _LEGACY[path]))
         elif path in _HANDLED or path in _TOPLEVEL_SECTIONS:
             findings.append(Finding("handled", path))
-        elif any(path.startswith(op + ".") for op in _OPAQUE):
-            continue  # schema'd elsewhere
         elif path in (
             "NeuralNetwork.Architecture",
             "NeuralNetwork.Variables_of_interest",

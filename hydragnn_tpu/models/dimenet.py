@@ -54,6 +54,11 @@ class DimeNetConv(nn.Module):
         dist = length[:, 0]
         rbf = bessel_basis_enveloped(dist, self.radius, self.num_radial,
                                      self.envelope_exponent)
+        # zero padding-edge rows at the source: their eps-clamped lengths
+        # produce a ~5e6 envelope spike (and the sbf recurrence below
+        # amplifies to ~1e38) that downstream masks hide from the loss but
+        # not from XLA's fused backward — see ops/sbf.py spherical_basis
+        rbf = jnp.where(batch.edge_mask[:, None], rbf, 0.0)
 
         # angle at j between edges ji and ki = kj + ji (DIMEStack.py:179-186:
         # vectors added separately for PBC correctness)
@@ -70,7 +75,8 @@ class DimeNetConv(nn.Module):
 
         sbf = spherical_basis(dist, angle, batch.trip_kj, self.radius,
                               self.num_spherical, self.num_radial,
-                              self.envelope_exponent)
+                              self.envelope_exponent,
+                              edge_mask=batch.edge_mask)
 
         # ---- node lin + embedding block (HydraEmbeddingBlock,
         # DIMEStack.py:260-305)

@@ -110,13 +110,29 @@ def spherical_basis(
     num_spherical: int,
     num_radial: int,
     envelope_exponent: int = 5,
+    edge_mask: jnp.ndarray = None,
 ) -> jnp.ndarray:
     """[T, num_spherical * num_radial] directional basis a_SBF(d_kj, angle_kji).
 
     ``dist`` is per-edge [E]; the radial part is evaluated per edge, enveloped,
     then gathered to triplets via ``idx_kj`` and modulated by Y_l0(angle)
     (same contraction as PyG SphericalBasisLayer.forward).
+
+    ``edge_mask`` marks the real edges. Padding edges carry an eps-clamped
+    near-zero length (ops/radial.py edge_vectors), and the upward j_l
+    recurrence at x ~ 1e-6 amplifies rounding error by ~(2l+1)/x per level —
+    to ~1e38 garbage by l=6, one fused op away from inf. Padding triplets
+    gather exactly those rows (data/graph.py compute_triplets_np pads with
+    the last edge slot): eagerly the downstream masks keep that garbage out
+    of the loss, but under jit XLA's fusion of the select/multiply patterns
+    produces 0*inf = NaN in the backward (measured: eager grads finite,
+    jitted grads 53 NaN leaves; first observed as the r5 live-TPU DimeNet
+    mixed-precision cell training to NaN, logs/ab_matrix.jsonl). With the
+    mask, padding rows are evaluated at a safe mid-range distance and zeroed
+    — no huge intermediate ever exists, in forward or backward.
     """
+    if edge_mask is not None:
+        dist = jnp.where(edge_mask, dist, 0.5 * r_max)
     d = dist / r_max
     zeros = jnp.asarray(spherical_bessel_zeros(num_spherical, num_radial))  # [L, N]
     norms = jnp.asarray(_sbf_normalizers(num_spherical, num_radial))  # [L, N]
@@ -127,6 +143,8 @@ def spherical_basis(
     rad = jl_all[:, l_idx, :, l_idx]  # [L, E, N] (advanced indexing moves axis)
     rad = jnp.moveaxis(rad, 0, 1) * norms[None, :, :]  # [E, L, N]
     rad = rad * dimenet_envelope(d, envelope_exponent)[:, None, None]
+    if edge_mask is not None:
+        rad = jnp.where(edge_mask[:, None, None], rad, 0.0)
     # angular part per triplet
     y_l0 = legendre_cos(num_spherical - 1, angle)  # [T, L]
     scale = jnp.sqrt((2.0 * jnp.arange(num_spherical) + 1.0) / (4.0 * math.pi))

@@ -1,0 +1,45 @@
+"""Drive the r5 TPU auto-default path end to end on the real chip.
+
+With `use_sorted_aggregation` unset, config completion on a TPU backend now
+defaults it on (config/config.py, from the r5 live A/B: +16.5%), measures
+`max_in_degree`, the loader sorts edges, and the jitted step runs the real
+(non-interpret) Pallas sorted-segment kernel. This script proves that whole
+default path trains a model to a falling, finite loss on hardware.
+"""
+
+import numpy as np
+
+import hydragnn_tpu
+
+cfg = {
+    "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                "graph_features": {"dim": [1]}},
+    "NeuralNetwork": {
+        "Architecture": {
+            "mpnn_type": "PNA", "radius": 2.0, "max_neighbours": 100,
+            "hidden_dim": 16, "num_conv_layers": 2, "task_weights": [1.0],
+            "output_heads": {"graph": {"num_sharedlayers": 1,
+                                       "dim_sharedlayers": 16,
+                                       "num_headlayers": 2,
+                                       "dim_headlayers": [16, 16]}},
+        },
+        "Variables_of_interest": {
+            "input_node_features": [0],
+            "output_names": ["sum_x_x2_x3"], "output_index": [0],
+            "type": ["graph"], "denormalize_output": False,
+        },
+        "Training": {"num_epoch": 4, "batch_size": 8,
+                     "Optimizer": {"type": "AdamW", "learning_rate": 0.01}},
+    },
+}
+
+model, state, hist, cfg_out, *_ = hydragnn_tpu.run_training(cfg)
+arch = cfg_out["NeuralNetwork"]["Architecture"]
+print("AUTO sorted:", arch["use_sorted_aggregation"],
+      "max_in_degree:", arch["max_in_degree"])
+print("loss history:", [round(float(x), 4) for x in hist["train"]])
+assert arch["use_sorted_aggregation"] is True
+assert arch["max_in_degree"] > 0
+assert np.isfinite(hist["train"]).all()
+assert hist["train"][-1] < hist["train"][0]
+print("DEFAULT-PATH DRIVE OK")

@@ -1,0 +1,105 @@
+"""Round-5 follow-up cells, run once after the first live A/B matrix.
+
+One PJRT client (the single-client discipline of bench.main_ab), three
+targeted cells the matrix didn't cover, appended to logs/ab_matrix.jsonl:
+
+- dimenet_f32: the matrix's DimeNet cell trained to NaN under
+  mixed_precision on the real chip (logs/ab_matrix.jsonl, r5) while the
+  CPU full-tier matrix is green — rerun at f32 to isolate the failure to
+  bf16 numerics vs a TPU lowering bug.
+- egnn_sorted_pack: sorted aggregation (+16.5% measured) composed with
+  packed batching (throughput-parity, one jit spec) — the candidate
+  shipping default for the SC25 production shape.
+- mace_sorted: the MACE cell at 2.05% MFU is aggregation-light, but the
+  sorted kernel's win on EGNN makes the cheap A/B worth banking.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import bench  # noqa: E402  (sets the XLA cache env before jax import)
+
+CELLS = [
+    {"tag": "dimenet_f32", "kw": {"workload": "DimeNet", "mixed_precision": False}},
+    {
+        "tag": "egnn_sorted_pack",
+        "kw": {
+            "mixed_precision": True,
+            "sorted_aggregation": True,
+            "env_overrides": {"BENCH_PACK": "1"},
+        },
+    },
+    {"tag": "mace_sorted", "kw": {"workload": "MACE", "mixed_precision": True},
+     "arch_env": {"BENCH_CELL_SORTED": "1"}},
+    # after the ops/sbf.py padding-row fix: the matrix's NaN DimeNet bf16
+    # cell, re-banked with sane numerics
+    {"tag": "dimenet_bf16_fixed",
+     "kw": {"workload": "DimeNet", "mixed_precision": True}},
+]
+
+
+def main():
+    # argv selects cells by tag (default: all)
+    chosen = set(sys.argv[1:])
+    cells = [c for c in CELLS if not chosen or c["tag"] in chosen]
+    deadline = {"t": time.monotonic() + 300.0}
+
+    def _watch():
+        while time.monotonic() < deadline["t"]:
+            time.sleep(1.0)
+        print(json.dumps({"error": "wedge guard fired"}), flush=True)
+        os._exit(2)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jnp.ones((8, 8)).sum())
+    deadline["t"] = time.monotonic() + float(os.getenv("BENCH_GUARD_SECS", "3600"))
+    out_path = os.path.join("logs", "ab_matrix.jsonl")
+    for cell in cells:
+        saved = {}
+        for k, v in cell.get("arch_env", {}).items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            prod = bench._bench_production(**cell["kw"])
+            line = json.dumps(
+                {
+                    "metric": "OC20-S2EF-shaped A/B cell",
+                    "value": round(prod["graphs_per_sec"], 2),
+                    "unit": "graphs/sec/chip",
+                    "mfu": round(prod["mfu"], 4),
+                    "flops_per_graph": round(prod["flops_per_graph"]),
+                    "train_loss": round(prod["loss"], 5),
+                    "variant": cell["tag"],
+                }
+            )
+        except Exception as e:  # noqa: BLE001 — a failing cell is data
+            line = json.dumps(
+                {
+                    "metric": "OC20-S2EF-shaped A/B cell",
+                    "value": 0.0,
+                    "variant": cell["tag"],
+                    "error": f"{type(e).__name__}: {e}"[:500],
+                }
+            )
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        print(line, flush=True)
+        with open(out_path, "a") as fh:
+            fh.write(line + "\n")
+    deadline["t"] = float("inf")
+
+
+if __name__ == "__main__":
+    main()

@@ -188,3 +188,72 @@ def pytest_mixed_precision_checkpoint_resume(tmp_path, monkeypatch):
     for leaf in jax.tree_util.tree_leaves(state2.params):
         if jnp.issubdtype(leaf.dtype, jnp.floating):
             assert leaf.dtype == jnp.float32
+
+
+def pytest_dimenet_bf16_jitted_grads_finite():
+    """Regression: the r5 live-TPU A/B matrix trained the DimeNet cell to
+    NaN under mixed precision (logs/ab_matrix.jsonl r5) while eager grads
+    were finite. Padding edges carry eps-clamped ~1e-6 lengths; the upward
+    spherical-Bessel recurrence amplifies rounding error to ~1e38 on those
+    rows, padding triplets gather them (compute_triplets_np pads with the
+    last edge slot), and XLA's fused backward turns the masked-inf pattern
+    into 0*inf = NaN — only under jit. spherical_basis(edge_mask=...) now
+    evaluates padding rows at a safe mid-range distance and zeroes them, so
+    the garbage never exists. This test jits the exact failing construct on
+    a triplet-padded batch and asserts every gradient leaf is finite."""
+    raw = deterministic_graph_dataset(32, seed=97)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["t"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.8, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "DimeNet",
+                "hidden_dim": 16,
+                "num_conv_layers": 1,
+                "num_radial": 6,
+                "num_spherical": 7,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [16, 16],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["t"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": 16,
+                "num_epoch": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]}, "graph_features": {"dim": [1]}},
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(tr, 16, seed=0, drop_last=True, with_triplets=True)
+    model = create_model(config)
+    batch = next(iter(loader))
+    # the trigger requires padding: both padding edges and padding triplets
+    assert not bool(np.asarray(batch.edge_mask).all())
+    assert not bool(np.asarray(batch.trip_mask).all())
+    variables = init_model(model, batch, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+    step = make_train_step(model, tx, mixed_precision=True)
+    rng = jax.random.PRNGKey(0)
+    for i in range(3):
+        state, tot, _ = step(state, batch, jax.random.fold_in(rng, i))
+        assert np.isfinite(float(tot)), f"loss non-finite at step {i}"
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state.params):
+        assert bool(jnp.isfinite(leaf).all()), (
+            f"non-finite params after bf16 steps: {jax.tree_util.keystr(path)}"
+        )

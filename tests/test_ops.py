@@ -190,3 +190,51 @@ def pytest_hoisted_pair_dense_equals_post_concat():
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
     )
+
+
+def pytest_spherical_basis_edge_mask_kills_padding_garbage():
+    """The r5 live-TPU DimeNet mixed-precision cell trained to NaN
+    (logs/ab_matrix.jsonl r5): padding edges carry eps-clamped ~1e-6
+    lengths, the upward j_l recurrence amplifies rounding error by
+    ~(2l+1)/x per level into ~1e38 garbage on those rows, padding
+    triplets gather exactly those rows (compute_triplets_np pads with the
+    last edge slot), and XLA's fused backward turns the masked-inf
+    pattern into 0*inf = NaN — under jit only, so eager checks missed it.
+
+    Contract of the fix: with ``edge_mask``, spherical_basis evaluates
+    padding rows at a safe mid-range distance and zeroes them — padded
+    output rows are exactly 0, every row is physically bounded, and the
+    jitted gradient w.r.t. distances is finite with zero cotangent on
+    padding rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.sbf import spherical_basis
+
+    r_max = 5.0
+    # last edge is padding with the eps-clamped near-zero length
+    dist = jnp.asarray(np.array([1.1, 1.9, 2.7, 3.4, 4.9, 1e-6], np.float32))
+    mask = jnp.asarray(np.array([1, 1, 1, 1, 1, 0], bool))
+    angle = jnp.asarray(np.linspace(0.1, 3.0, 4, dtype=np.float32))
+    # two real triplets + two padding triplets gathering the padding edge
+    idx_kj = jnp.asarray(np.array([0, 2, 5, 5], np.int32))
+
+    def f(d):
+        return spherical_basis(d, angle, idx_kj, r_max, 7, 6, 5,
+                               edge_mask=mask)
+
+    for dt in (jnp.float32, jnp.bfloat16):
+        sbf = jax.jit(f)(dist.astype(dt))
+        sbf = np.asarray(sbf, np.float32)
+        # padding-triplet rows are exactly zero — the garbage never exists
+        np.testing.assert_array_equal(sbf[2:], 0.0)
+        # real rows are finite and physically bounded (basis x envelope)
+        assert np.isfinite(sbf).all()
+        assert np.abs(sbf[:2]).max() < 1e4, np.abs(sbf).max()
+        # jitted backward: finite everywhere, zero on the padding edge
+        g = jax.jit(jax.grad(lambda d: jnp.sum(f(d).astype(jnp.float32))))(
+            dist.astype(dt)
+        )
+        g = np.asarray(g, np.float32)
+        assert np.isfinite(g).all(), g
+        assert g[5] == 0.0, g
