@@ -18,6 +18,34 @@ import numpy as np
 from ..data.graph import Graph
 from ..data.pipeline import VariablesOfInterest
 
+
+def _jit_target_is_tpu() -> bool:
+    """Whether jitted steps will target a TPU — WITHOUT initializing the
+    backend. Config completion may run before the multi-host rendezvous
+    (jax.distributed.initialize must precede the first backend touch, or
+    setup_distributed silently degrades to single-host — parallel/mesh.py),
+    so ``jax.default_backend()`` may only be consulted if the backend
+    already exists."""
+    plats = os.environ.get("JAX_PLATFORMS", "").lower()
+    if plats:
+        # explicit platform list: jax uses the first entry ("axon" is the
+        # tunneled-TPU plugin platform used by this image's test rig)
+        return plats.split(",")[0].strip() in ("tpu", "axon")
+    try:
+        import jax._src.xla_bridge as xb
+
+        if getattr(xb, "_backends", None):
+            import jax
+
+            return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - private-API drift tolerance
+        pass
+    # backend uninitialized and no explicit platform: jax will pick a TPU
+    # runtime iff one is importable (highest platform priority)
+    import importlib.util
+
+    return importlib.util.find_spec("libtpu") is not None
+
 # Architecture keys defaulted to None when absent
 # (reference: config_utils.py:98-156 one-by-one ifs).
 _ARCH_NONE_DEFAULTS = (
@@ -202,9 +230,7 @@ def update_config(
     # unsorted keeps CPU batches byte-stable with earlier rounds.
     # Explicit true/false in the config always wins.
     if "use_sorted_aggregation" not in arch or arch["use_sorted_aggregation"] is None:
-        import jax
-
-        arch["use_sorted_aggregation"] = jax.default_backend() == "tpu"
+        arch["use_sorted_aggregation"] = _jit_target_is_tpu()
     if arch.get("use_sorted_aggregation"):
         top = 1
         for g in (*trainset, *valset, *testset):

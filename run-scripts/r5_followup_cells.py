@@ -34,8 +34,9 @@ CELLS = [
             "env_overrides": {"BENCH_PACK": "1"},
         },
     },
-    {"tag": "mace_sorted", "kw": {"workload": "MACE", "mixed_precision": True},
-     "arch_env": {"BENCH_CELL_SORTED": "1"}},
+    {"tag": "mace_sorted",
+     "kw": {"workload": "MACE", "mixed_precision": True,
+            "env_overrides": {"BENCH_CELL_SORTED": "1"}}},
     # after the ops/sbf.py padding-row fix: the matrix's NaN DimeNet bf16
     # cell, re-banked with sane numerics
     {"tag": "dimenet_bf16_fixed",
@@ -61,12 +62,9 @@ def main():
 
     jax.block_until_ready(jnp.ones((8, 8)).sum())
     deadline["t"] = time.monotonic() + float(os.getenv("BENCH_GUARD_SECS", "3600"))
+    os.makedirs("logs", exist_ok=True)
     out_path = os.path.join("logs", "ab_matrix.jsonl")
     for cell in cells:
-        saved = {}
-        for k, v in cell.get("arch_env", {}).items():
-            saved[k] = os.environ.get(k)
-            os.environ[k] = v
         try:
             prod = bench._bench_production(**cell["kw"])
             line = json.dumps(
@@ -89,12 +87,6 @@ def main():
                     "error": f"{type(e).__name__}: {e}"[:500],
                 }
             )
-        finally:
-            for k, v in saved.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
         print(line, flush=True)
         with open(out_path, "a") as fh:
             fh.write(line + "\n")
