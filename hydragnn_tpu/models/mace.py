@@ -47,6 +47,20 @@ from .layers import MLP, get_activation
 NUM_ELEMENTS = 118
 
 
+def _concat_by_l(by_l, leading, c, dtype):
+    """Concatenate per-l partial-sum lists into one [..., (L+1)^2] irreps
+    array (l blocks in increasing-l order = the irrep_slice layout). The
+    scatter-free alternative to a .at[irrep_slice(l)].add per path, which
+    lowers to a chain of unfused full-array dynamic-update-slices."""
+    return jnp.concatenate(
+        [
+            sum(blocks) if blocks else jnp.zeros((*leading, c, 2 * l + 1), dtype)
+            for l, blocks in enumerate(by_l)
+        ],
+        axis=-1,
+    )
+
+
 class EquivariantLinear(nn.Module):
     """Per-l channel mixing [N, C_in, (Lin+1)^2] -> [N, C_out, (Lout+1)^2].
 
@@ -121,13 +135,16 @@ class MACEInteraction(nn.Module):
         )(edge_in).reshape(-1, len(paths), c)
 
         hs = h_up[batch.senders]  # [E, C, (lin+1)^2]
-        msg = jnp.zeros((sh.shape[0], c, sh_dim(self.max_ell)), h.dtype)
+        # per output-l partial sums, concatenated once (_concat_by_l): +50%
+        # measured on the MACE cell vs the scatter chain (393.0 vs 261.8
+        # graphs/sec/chip, logs/ab_matrix.jsonl r5 mace_dense2)
+        by_l3 = [[] for _ in range(self.max_ell + 1)]
         for p, (l1, l2, l3) in enumerate(paths):
             contrib = couple(
                 hs[:, :, irrep_slice(l1)], sh[:, None, irrep_slice(l2)], l1, l2, l3
             )
-            contrib = contrib * tp_w[:, p, :, None]
-            msg = msg.at[:, :, irrep_slice(l3)].add(contrib)
+            by_l3[l3].append(contrib * tp_w[:, p, :, None])
+        msg = _concat_by_l(by_l3, (sh.shape[0],), c, h.dtype)
 
         msg = msg * batch.edge_mask.astype(h.dtype)[:, None, None]
         # channel x irrep axes flattened so the 2-D sorted-segment kernel
@@ -159,15 +176,17 @@ class SymmetricProduct(nn.Module):
         c = self.features
         n = a.shape[0]
         lmax_a = int(math.isqrt(a.shape[-1])) - 1
-        out = jnp.zeros((n, c, sh_dim(self.lmax_out)), a.dtype)
+        # same scatter-free per-l accumulate + single concat pattern as the
+        # interaction's message build (_concat_by_l)
+        out_by_l = [[] for _ in range(self.lmax_out + 1)]
         b = a
         lmax_b = lmax_a
         for k in range(1, self.correlation + 1):
             if k > 1:
                 new_lmax = min(self.lmax_keep, lmax_b + lmax_a)
-                nb = jnp.zeros((n, c, sh_dim(new_lmax)), a.dtype)
+                nb_by_l = [[] for _ in range(new_lmax + 1)]
                 for l1, l2, l3 in tp_paths(lmax_b, lmax_a, new_lmax):
-                    nb = nb.at[:, :, irrep_slice(l3)].add(
+                    nb_by_l[l3].append(
                         couple(
                             b[:, :, irrep_slice(l1)],
                             a[:, :, irrep_slice(l2)],
@@ -176,7 +195,8 @@ class SymmetricProduct(nn.Module):
                             l3,
                         )
                     )
-                b, lmax_b = nb, new_lmax
+                b = _concat_by_l(nb_by_l, (n,), c, a.dtype)
+                lmax_b = new_lmax
             for l in range(min(self.lmax_out, lmax_b) + 1):
                 w = self.param(
                     f"w{k}_{l}",
@@ -185,10 +205,8 @@ class SymmetricProduct(nn.Module):
                     a.dtype,
                 )
                 wn = node_attrs @ w  # [N, C] element-dependent mixing
-                out = out.at[:, :, irrep_slice(l)].add(
-                    wn[:, :, None] * b[:, :, irrep_slice(l)]
-                )
-        return out
+                out_by_l[l].append(wn[:, :, None] * b[:, :, irrep_slice(l)])
+        return _concat_by_l(out_by_l, (n,), c, a.dtype)
 
 
 class MACEConv(nn.Module):

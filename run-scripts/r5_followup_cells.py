@@ -1,17 +1,19 @@
-"""Round-5 follow-up cells, run once after the first live A/B matrix.
+"""Round-5 targeted bench cells beyond the BENCH_AB matrix.
 
-One PJRT client (the single-client discipline of bench.main_ab), three
-targeted cells the matrix didn't cover, appended to logs/ab_matrix.jsonl:
+One PJRT client per run (the single-client discipline of bench.main_ab);
+each selected cell appends one JSON line to logs/ab_matrix.jsonl.
 
-- dimenet_f32: the matrix's DimeNet cell trained to NaN under
-  mixed_precision on the real chip (logs/ab_matrix.jsonl, r5) while the
-  CPU full-tier matrix is green — rerun at f32 to isolate the failure to
-  bf16 numerics vs a TPU lowering bug.
-- egnn_sorted_pack: sorted aggregation (+16.5% measured) composed with
-  packed batching (throughput-parity, one jit spec) — the candidate
-  shipping default for the SC25 production shape.
-- mace_sorted: the MACE cell at 2.05% MFU is aggregation-light, but the
-  sorted kernel's win on EGNN makes the cheap A/B worth banking.
+USAGE: pass the cell tags to run as argv — `python r5_followup_cells.py
+mace_dense2 mace_sorted2`. Running with NO tags runs EVERY cell,
+including ones already banked, appending duplicate rows with drifted
+numbers — select tags explicitly unless rebuilding the whole record.
+
+Cells (see CELLS below): the DimeNet NaN isolation pair (dimenet_f32 /
+dimenet_bf16_fixed around the ops/sbf.py fix), the composed
+sorted+pack production recipe (egnn_sorted_pack — became the shipping
+headline), the MACE sorted A/B, and the post-refactor MACE re-bench
+set (mace_dense2 / mace_sorted2 / mace_profile / mace_bs32 — measured
+the scatter-free CG build at +50%).
 """
 
 import json
@@ -41,6 +43,20 @@ CELLS = [
     # cell, re-banked with sane numerics
     {"tag": "dimenet_bf16_fixed",
      "kw": {"workload": "DimeNet", "mixed_precision": True}},
+    # after the scatter-free CG message build (models/mace.py r5): re-bank
+    # both MACE cells against the 261.8 / 269.4 pre-refactor numbers
+    {"tag": "mace_dense2", "kw": {"workload": "MACE", "mixed_precision": True}},
+    {"tag": "mace_sorted2",
+     "kw": {"workload": "MACE", "mixed_precision": True,
+            "env_overrides": {"BENCH_CELL_SORTED": "1"}}},
+    # device trace of the MACE cell (logs/bench_profile) for the MFU work
+    {"tag": "mace_profile",
+     "kw": {"workload": "MACE", "mixed_precision": True, "profile": True}},
+    # batch-scaling probe: the MACE cell runs batch 16 by default — if the
+    # chip is underfed rather than compute-bound, batch 32 shows it
+    {"tag": "mace_bs32",
+     "kw": {"workload": "MACE", "mixed_precision": True,
+            "env_overrides": {"BENCH_CELL_BATCH_SIZE": "32"}}},
 ]
 
 
@@ -65,6 +81,11 @@ def main():
     os.makedirs("logs", exist_ok=True)
     out_path = os.path.join("logs", "ab_matrix.jsonl")
     for cell in cells:
+        # per-cell guard: a slow-tunnel day must cost at most one cell,
+        # not silently drop every cell after the budget is spent
+        deadline["t"] = time.monotonic() + float(
+            os.getenv("BENCH_GUARD_SECS", "3600")
+        )
         try:
             prod = bench._bench_production(**cell["kw"])
             line = json.dumps(
