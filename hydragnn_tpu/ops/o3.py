@@ -263,3 +263,48 @@ def couple(
     """Channelwise CG coupling: a[..., 2l1+1] x b[..., 2l2+1] -> [..., 2l3+1]."""
     cg = jnp.asarray(real_cg(l1, l2, l3), a.dtype)
     return jnp.einsum("...a,...b,abc->...c", a, b, cg)
+
+
+@lru_cache(maxsize=None)
+def combined_cg(
+    lmax1: int, lmax2: int, lmax_out: int
+) -> Tuple[np.ndarray, Tuple[Tuple[int, int, int], ...], Tuple[int, ...]]:
+    """Block CG tensor for a FUSED tensor product: ``G[d1, d2, Q]`` with one
+    (2*l3+1)-wide output block per coupling path of ``tp_paths(lmax1, lmax2,
+    lmax_out)``, plus the path list and per-path block offsets.
+
+    Contracting once with G computes every ``couple(a_l1, b_l2, l3)`` of the
+    per-path chain in a single dense einsum (one or two dot_generals instead
+    of ~len(paths) tiny bandwidth-bound kernels); callers slice the Q axis
+    by offset to apply per-path weights. Zeros fill the blocks a path does
+    not touch, so the dense contraction is algebraically identical to the
+    path loop."""
+    paths = tp_paths(lmax1, lmax2, lmax_out)
+    d1, d2 = sh_dim(lmax1), sh_dim(lmax2)
+    q_tot = sum(2 * l3 + 1 for _, _, l3 in paths)
+    G = np.zeros((d1, d2, q_tot), np.float32)
+    offsets = []
+    q = 0
+    for l1, l2, l3 in paths:
+        G[irrep_slice(l1), irrep_slice(l2), q : q + 2 * l3 + 1] = real_cg(
+            l1, l2, l3
+        )
+        offsets.append(q)
+        q += 2 * l3 + 1
+    return G, tuple(paths), tuple(offsets)
+
+
+@lru_cache(maxsize=None)
+def summed_cg(lmax1: int, lmax2: int, lmax_out: int) -> np.ndarray:
+    """``G[d1, d2, d_out]`` with every coupling path ACCUMULATED into its
+    ``irrep_slice(l3)`` output block — the fused form of an unweighted
+    path-sum tensor product (SymmetricProduct's recursion, where no
+    per-path weights exist): ``einsum('...m,...n,mnk->...k', a, b, G)``
+    equals the full couple-and-add chain exactly."""
+    d1, d2 = sh_dim(lmax1), sh_dim(lmax2)
+    G = np.zeros((d1, d2, sh_dim(lmax_out)), np.float32)
+    for l1, l2, l3 in tp_paths(lmax1, lmax2, lmax_out):
+        G[irrep_slice(l1), irrep_slice(l2), irrep_slice(l3)] += real_cg(
+            l1, l2, l3
+        )
+    return G

@@ -44,19 +44,37 @@ CELLS = [
     {"tag": "dimenet_bf16_fixed",
      "kw": {"workload": "DimeNet", "mixed_precision": True}},
     # after the scatter-free CG message build (models/mace.py r5): re-bank
-    # both MACE cells against the 261.8 / 269.4 pre-refactor numbers
-    {"tag": "mace_dense2", "kw": {"workload": "MACE", "mixed_precision": True}},
+    # both MACE cells against the 261.8 / 269.4 pre-refactor numbers.
+    # trace_env pins the per-path loop: the fused dense-CG path later
+    # became the TPU default, and these are its BASELINE rows
+    {"tag": "mace_dense2", "kw": {"workload": "MACE", "mixed_precision": True},
+     "trace_env": {"HYDRAGNN_MACE_DENSE_CG": "0"}},
     {"tag": "mace_sorted2",
      "kw": {"workload": "MACE", "mixed_precision": True,
-            "env_overrides": {"BENCH_CELL_SORTED": "1"}}},
+            "env_overrides": {"BENCH_CELL_SORTED": "1"}},
+     "trace_env": {"HYDRAGNN_MACE_DENSE_CG": "0"}},
     # device trace of the MACE cell (logs/bench_profile) for the MFU work
     {"tag": "mace_profile",
-     "kw": {"workload": "MACE", "mixed_precision": True, "profile": True}},
+     "kw": {"workload": "MACE", "mixed_precision": True, "profile": True},
+     "trace_env": {"HYDRAGNN_MACE_DENSE_CG": "0"}},
     # batch-scaling probe: the MACE cell runs batch 16 by default — if the
     # chip is underfed rather than compute-bound, batch 32 shows it
     {"tag": "mace_bs32",
      "kw": {"workload": "MACE", "mixed_precision": True,
-            "env_overrides": {"BENCH_CELL_BATCH_SIZE": "32"}}},
+            "env_overrides": {"BENCH_CELL_BATCH_SIZE": "32"},
+            },
+     "trace_env": {"HYDRAGNN_MACE_DENSE_CG": "0"}},
+    # fused-CG compute path A/B (models/mace.py _dense_cg_enabled):
+    # HYDRAGNN_MACE_DENSE_CG is read at TRACE time, inside
+    # _bench_production's jit — env_overrides only wraps workload
+    # construction, so these cells use trace_env (held for the whole call)
+    {"tag": "mace_dcg",
+     "kw": {"workload": "MACE", "mixed_precision": True},
+     "trace_env": {"HYDRAGNN_MACE_DENSE_CG": "1"}},
+    {"tag": "mace_dcg_sorted",
+     "kw": {"workload": "MACE", "mixed_precision": True,
+            "env_overrides": {"BENCH_CELL_SORTED": "1"}},
+     "trace_env": {"HYDRAGNN_MACE_DENSE_CG": "1"}},
 ]
 
 
@@ -86,6 +104,12 @@ def main():
         deadline["t"] = time.monotonic() + float(
             os.getenv("BENCH_GUARD_SECS", "3600")
         )
+        # trace_env: flags read at trace time inside the jitted step (not
+        # at workload construction, which is all env_overrides covers) —
+        # held for the whole cell, restored after
+        tenv = cell.get("trace_env", {})
+        saved = {k: os.environ.get(k) for k in tenv}
+        os.environ.update(tenv)
         try:
             prod = bench._bench_production(**cell["kw"])
             line = json.dumps(
@@ -108,6 +132,12 @@ def main():
                     "error": f"{type(e).__name__}: {e}"[:500],
                 }
             )
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         print(line, flush=True)
         with open(out_path, "a") as fh:
             fh.write(line + "\n")

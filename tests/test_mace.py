@@ -149,3 +149,33 @@ def pytest_mace_high_ell_forward_and_invariance():
         np.testing.assert_allclose(
             np.asarray(rot[k]), base[k], rtol=2e-3, atol=2e-3
         )
+
+
+def pytest_mace_dense_cg_path_matches_loop(monkeypatch):
+    """The fused-CG compute path (HYDRAGNN_MACE_DENSE_CG=1, ops/o3.py
+    combined_cg/summed_cg) is a pure compute-path choice: same parameters,
+    same outputs as the per-path couple() loops, to float tolerance. Covers
+    both fused sites — the interaction message build (per-path weighted,
+    combined_cg Q-axis) and the symmetric-product recursion (unweighted
+    path sum, summed_cg) — at correlation 3 so the recursion runs twice."""
+    import jax
+
+    model, variables, batch = _mace_setup(correlation=3, max_ell=2)
+
+    def fwd():
+        return model.apply(
+            variables, batch, train=False, mutable=["batch_stats"]
+        )[0]
+
+    # pin the loop path explicitly: with the var unset the TPU default is
+    # the dense path, and the comparison would be dense-vs-dense
+    monkeypatch.setenv("HYDRAGNN_MACE_DENSE_CG", "0")
+    out_loop = fwd()
+    monkeypatch.setenv("HYDRAGNN_MACE_DENSE_CG", "1")
+    out_dense = jax.jit(lambda: fwd())()
+    assert out_loop.keys() == out_dense.keys()
+    for k in out_loop:
+        np.testing.assert_allclose(
+            np.asarray(out_loop[k]), np.asarray(out_dense[k]),
+            rtol=1e-5, atol=1e-5,
+        )
