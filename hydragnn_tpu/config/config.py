@@ -229,8 +229,26 @@ def update_config(
     # (ops/segment.py:_pallas_route_enabled) and leaving the edge order
     # unsorted keeps CPU batches byte-stable with earlier rounds.
     # Explicit true/false in the config always wins.
+    #
+    # Grad-energy configs stay on the dense XLA route: forces are -dE/dpos
+    # inside the loss, so training differentiates the aggregation TWICE,
+    # and the Pallas kernel supports first-order (custom-VJP) AD only —
+    # pallas_call has no JVP rule, so grad-of-grad raises
+    # NotImplementedError (found by examples/md17 on the live chip right
+    # after the r5 default flip; regression-tested in test_sorted_agg.py).
     if "use_sorted_aggregation" not in arch or arch["use_sorted_aggregation"] is None:
-        arch["use_sorted_aggregation"] = _jit_target_is_tpu()
+        arch["use_sorted_aggregation"] = (
+            _jit_target_is_tpu() and not training["compute_grad_energy"]
+        )
+    if arch.get("use_sorted_aggregation") and training["compute_grad_energy"]:
+        raise ValueError(
+            "use_sorted_aggregation cannot be combined with "
+            "Training.compute_grad_energy: the energy-force objective takes "
+            "second-order gradients through the aggregation, and the Pallas "
+            "sorted-segment kernel supports first-order differentiation "
+            "only. Remove the explicit use_sorted_aggregation:true (the TPU "
+            "auto-default already stays dense for grad-energy configs)."
+        )
     if arch.get("use_sorted_aggregation"):
         top = 1
         for g in (*trainset, *valset, *testset):

@@ -185,3 +185,40 @@ def pytest_kernel_on_real_batch_layout():
     np.testing.assert_allclose(
         np.asarray(out)[real], np.asarray(ref)[real], rtol=2e-5, atol=2e-5
     )
+
+
+def pytest_sorted_agg_refused_for_grad_energy(monkeypatch):
+    """Regression: the r5 TPU auto-default briefly enabled the Pallas route
+    for EVERY config, and examples/md17 (forces = -dE/dpos) crashed on the
+    chip with pallas_call's missing-JVP NotImplementedError — the kernel is
+    first-order (custom-VJP) only, and grad-energy training differentiates
+    the aggregation twice. Config completion must (a) keep the TPU
+    auto-default dense for grad-energy configs and (b) reject an explicit
+    use_sorted_aggregation+grad-energy combination loudly."""
+    tr, va, te = _graphs()
+    cfg = _config(None)
+    nn = cfg["NeuralNetwork"]
+    nn["Training"]["compute_grad_energy"] = True
+    nn["Variables_of_interest"]["output_dim"] = [1]
+    nn["Variables_of_interest"]["type"] = ["node"]
+
+    # (a) auto-default: even when jitting for TPU (env-probed, no backend
+    # touch), grad-energy keeps the dense differentiable-twice route
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu")
+    import copy
+
+    nn["Architecture"].pop("use_sorted_aggregation", None)
+    done = update_config(copy.deepcopy(cfg), tr, va, te)
+    assert done["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] is False
+
+    # sanity: a non-grad-energy config on the same fake TPU env does flip on
+    plain = _config(None)
+    plain["NeuralNetwork"]["Architecture"].pop("use_sorted_aggregation", None)
+    done_plain = update_config(copy.deepcopy(plain), tr, va, te)
+    assert done_plain["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] is True
+
+    # (b) explicit combination fails with a clear message
+    bad = copy.deepcopy(cfg)
+    bad["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] = True
+    with pytest.raises(ValueError, match="second-order"):
+        update_config(bad, tr, va, te)
