@@ -230,7 +230,11 @@ def _production_workload(mixed_precision=None, sorted_aggregation=None):
     num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
     arch = {
         "mpnn_type": "EGNN",
-        "equivariance": True,
+        # BENCH_EQUIV=0: equivariance off — isolates the fused edge kernel
+        # at FULL layer coverage (equivariant layers keep the materialized
+        # path because edge_feat also feeds the coordinate gate; see
+        # models/egnn.py and docs/PERFORMANCE.md)
+        "equivariance": os.getenv("BENCH_EQUIV", "1") == "1",
         "radius": 5.0,
         "max_neighbours": 20,
         "hidden_dim": hidden,
@@ -252,6 +256,13 @@ def _production_workload(mixed_precision=None, sorted_aggregation=None):
             },
         },
     }
+    # BENCH_FUSED=0/1: fused gather->dense->segment-sum edge kernel A/B
+    # (ops/pallas_fused_edge.py). Unset -> config completion's default
+    # (auto-on with sorted aggregation), which is what the headline must
+    # measure; explicit env pins a cell for the A/B matrix.
+    fused_env = os.getenv("BENCH_FUSED")
+    if fused_env is not None:
+        arch["use_fused_edge_kernel"] = fused_env == "1"
     # packed batching default ON for the headline (see _default_pack;
     # examples/open_catalyst_2020 ships the same recipe)
     return _oc20_workload(
@@ -421,6 +432,7 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
     gps = graphs_done / best_dt
     peak = _peak_flops(jax.devices()[0].device_kind)
     mfu = (flops_done / best_dt) / peak
+    arch_done = config["NeuralNetwork"]["Architecture"]
     return {
         "graphs_per_sec": gps,
         "mfu": mfu,
@@ -428,6 +440,17 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
         "device": jax.devices()[0].device_kind,
         "peak_flops_assumed": peak,
         "loss": float(tot),
+        # the route that can actually engage, not the raw flag: the fused
+        # path needs sorted receivers + a degree bound AND an EGNN stack
+        # (models/egnn.py is the only consumer — a MACE/DimeNet cell with
+        # the auto-following flag set must bank fused_edge=false)
+        "fused_edge": bool(
+            arch_done.get("mpnn_type") == "EGNN"
+            and arch_done.get("use_fused_edge_kernel", False)
+            and arch_done.get("use_sorted_aggregation", False)
+            and int(arch_done.get("max_in_degree") or 0) > 0
+        ),
+        "equivariance": bool(arch_done.get("equivariance", False)),
     }
 
 
@@ -548,11 +571,27 @@ def main_ab():
     # bucket-ladder loader; the pack variant isolates packing itself
     # (the headline default is pack ON — see _model_cell_workload note)
     cells = [
-        {"mp": True, "sorted": False, "env": {"BENCH_PACK": "0"}},
-        {"mp": True, "sorted": True, "env": {"BENCH_PACK": "0"}},
-        {"mp": False, "sorted": False, "env": {"BENCH_PACK": "0"}},
-        {"mp": False, "sorted": True, "env": {"BENCH_PACK": "0"}},
+        # base mp x sorted matrix: BENCH_FUSED=0 pins the r5 semantics so
+        # the historical comparison stays apples-to-apples (config
+        # completion would otherwise auto-on the fused kernel with sorted)
+        {"mp": True, "sorted": False, "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0"}},
+        {"mp": True, "sorted": True, "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0"}},
+        {"mp": False, "sorted": False, "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0"}},
+        {"mp": False, "sorted": True, "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0"}},
+        # fused edge-kernel A/B (the r6 tentpole): fused vs unfused on the
+        # sorted route, production (equivariant) shape — only the last conv
+        # layer fuses there — and equivariance-off, where every layer fuses
+        # (the kernel's full-coverage number; see docs/PERFORMANCE.md)
+        {"mp": True, "sorted": True,
+         "env": {"BENCH_PACK": "0", "BENCH_FUSED": "1"}, "tag": "fused"},
+        {"mp": True, "sorted": True,
+         "env": {"BENCH_PACK": "0", "BENCH_FUSED": "1", "BENCH_EQUIV": "0"},
+         "tag": "noneq_fused"},
+        {"mp": True, "sorted": True,
+         "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0", "BENCH_EQUIV": "0"},
+         "tag": "noneq_unfused"},
         {"mp": True, "sorted": False, "env": {"BENCH_PACK": "1"}, "tag": "pack"},
+        # production recipe cell: defaults (fused auto-on via completion)
         {"mp": True, "sorted": True, "env": {"BENCH_PACK": "1"},
          "tag": "sorted_pack"},
         {"mp": True, "sorted": False,
@@ -566,9 +605,15 @@ def main_ab():
     for cell in cells:
         mp, sorted_agg = cell["mp"], cell["sorted"]
         # model cells route sorted aggregation via BENCH_CELL_SORTED inside
-        # _model_cell_workload — the banked record must say what actually ran
+        # _model_cell_workload — the banked record must say what actually
+        # ran, and a cell's own env_overrides take precedence over the
+        # outer-process environment (ADVICE r5 #2: _bench_production applies
+        # env_overrides around the workload build, so a future model cell
+        # setting BENCH_CELL_SORTED via env would otherwise bank wrong)
         if "model" in cell:
-            sorted_agg = os.getenv("BENCH_CELL_SORTED", "0") == "1"
+            sorted_agg = cell.get("env", {}).get(
+                "BENCH_CELL_SORTED", os.environ.get("BENCH_CELL_SORTED", "0")
+            ) == "1"
         try:
             prod = _bench_production(
                 mixed_precision=mp,
@@ -610,6 +655,8 @@ def main_ab():
                 "train_loss": round(prod["loss"], 5),
                 "mixed_precision": mp,
                 "sorted_aggregation": sorted_agg,
+                "fused_edge": prod["fused_edge"],
+                "equivariance": prod["equivariance"],
                 **({"variant": cell["tag"]} if "tag" in cell else {}),
                 "vs_baseline": round(syn / RECORDED_BASELINE, 3),
                 "synthetic_pna_graphs_per_sec": round(syn, 2),

@@ -11,6 +11,7 @@ from __future__ import annotations
 import copy
 import json
 import os
+import sys
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -19,9 +20,14 @@ from ..data.graph import Graph
 from ..data.pipeline import VariablesOfInterest
 
 
-def _jit_target_is_tpu() -> bool:
-    """Whether jitted steps will target a TPU — WITHOUT initializing the
-    backend. Config completion may run before the multi-host rendezvous
+def _jit_target_inference() -> tuple:
+    """(is_tpu, source): whether jitted steps will target a TPU — WITHOUT
+    initializing the backend — plus WHICH heuristic decided, so the
+    decision can be logged when it flips a default (ADVICE r5 #1: the
+    libtpu fallback can guess TPU before backend init; if runtime init
+    later fails and jax lands on CPU, the log line is what makes the
+    persisted ``use_sorted_aggregation: true`` diagnosable). Config
+    completion may run before the multi-host rendezvous
     (jax.distributed.initialize must precede the first backend touch, or
     setup_distributed silently degrades to single-host — parallel/mesh.py),
     so ``jax.default_backend()`` may only be consulted if the backend
@@ -30,21 +36,27 @@ def _jit_target_is_tpu() -> bool:
     if plats:
         # explicit platform list: jax uses the first entry ("axon" is the
         # tunneled-TPU plugin platform used by this image's test rig)
-        return plats.split(",")[0].strip() in ("tpu", "axon")
+        first = plats.split(",")[0].strip()
+        return first in ("tpu", "axon"), f"JAX_PLATFORMS={plats!r}"
     try:
         import jax._src.xla_bridge as xb
 
         if getattr(xb, "_backends", None):
             import jax
 
-            return jax.default_backend() == "tpu"
+            backend = jax.default_backend()
+            return backend == "tpu", f"initialized backend {backend!r}"
     except Exception:  # pragma: no cover - private-API drift tolerance
         pass
     # backend uninitialized and no explicit platform: jax will pick a TPU
     # runtime iff one is importable (highest platform priority)
     import importlib.util
 
-    return importlib.util.find_spec("libtpu") is not None
+    has_libtpu = importlib.util.find_spec("libtpu") is not None
+    return has_libtpu, (
+        "libtpu importable (backend uninitialized)" if has_libtpu
+        else "no libtpu, backend uninitialized"
+    )
 
 # Architecture keys defaulted to None when absent
 # (reference: config_utils.py:98-156 one-by-one ifs).
@@ -230,25 +242,26 @@ def update_config(
     # unsorted keeps CPU batches byte-stable with earlier rounds.
     # Explicit true/false in the config always wins.
     #
-    # Grad-energy configs stay on the dense XLA route: forces are -dE/dpos
-    # inside the loss, so training differentiates the aggregation TWICE,
-    # and the Pallas kernel supports first-order (custom-VJP) AD only —
-    # pallas_call has no JVP rule, so grad-of-grad raises
-    # NotImplementedError (found by examples/md17 on the live chip right
-    # after the r5 default flip; regression-tested in test_sorted_agg.py).
+    # Grad-energy configs are INCLUDED since r6: the kernels differentiate
+    # through a custom-JVP whose tangent rule is plain jnp
+    # (ops/pallas_segment.py, ops/pallas_fused_edge.py), so the
+    # energy-force objective's grad-of-grad composes; the r5 first-order
+    # custom-VJP guard (which raised here) is gone. fused==dense on the
+    # energy+force loss is asserted by tests/test_fused_edge.py and the
+    # multichip dryrun (__graft_entry__._dryrun_sorted_agg).
     if "use_sorted_aggregation" not in arch or arch["use_sorted_aggregation"] is None:
-        arch["use_sorted_aggregation"] = (
-            _jit_target_is_tpu() and not training["compute_grad_energy"]
-        )
-    if arch.get("use_sorted_aggregation") and training["compute_grad_energy"]:
-        raise ValueError(
-            "use_sorted_aggregation cannot be combined with "
-            "Training.compute_grad_energy: the energy-force objective takes "
-            "second-order gradients through the aggregation, and the Pallas "
-            "sorted-segment kernel supports first-order differentiation "
-            "only. Remove the explicit use_sorted_aggregation:true (the TPU "
-            "auto-default already stays dense for grad-energy configs)."
-        )
+        on, source = _jit_target_inference()
+        arch["use_sorted_aggregation"] = on
+        if on:
+            # the libtpu heuristic can decide before backend init; print the
+            # inference source so a later CPU fallback is diagnosable from
+            # the log even though the persisted config says sorted=true
+            # (ADVICE r5 #1). stderr: never mixes into stdout protocols.
+            print(
+                "[hydragnn_tpu.config] use_sorted_aggregation auto-enabled: "
+                f"jit target inferred as TPU from {source}",
+                file=sys.stderr,
+            )
     if arch.get("use_sorted_aggregation"):
         top = 1
         for g in (*trainset, *valset, *testset):
@@ -264,6 +277,29 @@ def update_config(
             )
         arch["max_in_degree"] = int(supplied or top)
     arch.setdefault("max_in_degree", 0)
+
+    # ---- fused edge hot path (gather -> edge dense -> segment sum in one
+    # VMEM-resident Pallas kernel, ops/pallas_fused_edge.py): auto-on
+    # wherever sorted aggregation is on — it shares the sorted-receivers +
+    # max_in_degree contract and falls back to the identical dense
+    # computation off-TPU (ops/segment.py routing), so the flag is safe to
+    # carry on any backend. Consumed today by the EGNN stack's
+    # single-consumer edge messages (models/egnn.py); explicit true/false
+    # wins for A/B (bench.py BENCH_FUSED).
+    if ("use_fused_edge_kernel" not in arch
+            or arch["use_fused_edge_kernel"] is None):
+        arch["use_fused_edge_kernel"] = bool(arch["use_sorted_aggregation"])
+    elif arch["use_fused_edge_kernel"] and not arch["use_sorted_aggregation"]:
+        # without receiver-sorted batches + the degree bound the fused path
+        # can never engage (models/egnn.py) — a silent no-op here would let
+        # an A/B "measure" the fused kernel against itself; fail loudly,
+        # mirroring the stale-max_in_degree treatment above
+        raise ValueError(
+            "use_fused_edge_kernel requires use_sorted_aggregation: the "
+            "fused edge kernel rides the sorted-receivers + max_in_degree "
+            "contract. Enable use_sorted_aggregation (or drop the explicit "
+            "use_fused_edge_kernel, which then follows it automatically)."
+        )
 
     # CGCNN keeps hidden dim = input dim without global attention
     # (reference: config_utils.py:80-87)

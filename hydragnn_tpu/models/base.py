@@ -115,6 +115,14 @@ class ModelConfig:
     # scatter (ops/segment.py segment_sum; loader sort_edges=True)
     sorted_aggregation: bool = False
     max_in_degree: int = 0
+    # fused gather->edge-dense->segment-sum Pallas kernel for the edge hot
+    # path (Architecture.use_fused_edge_kernel; auto-on with sorted
+    # aggregation in config completion). Consumed by convs whose per-edge
+    # messages have a single consumer — today the EGNN stack's
+    # non-equivariant layers (models/egnn.py); multi-aggregator convs
+    # (PNA family) and gated two-projection convs (CGCNN) materialize
+    # messages for other consumers, so the flag is inert there.
+    fused_edge_kernel: bool = False
     # --- decoder seed-robustness knobs (Architecture.decoder_mirror_init /
     # Architecture.decoder_recovery_slope). Defaults are the seed-robust
     # behavior (mirrored (w,-w) decoder init + leaky-ReLU(0.1) decoder hidden

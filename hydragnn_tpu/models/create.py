@@ -132,6 +132,7 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
         sorted_aggregation=bool(arch.get("use_sorted_aggregation", False)),
         max_in_degree=int(arch.get("max_in_degree") or 0),
+        fused_edge_kernel=bool(arch.get("use_fused_edge_kernel", False)),
         decoder_mirror_init=bool(
             True if arch.get("decoder_mirror_init") is None
             else arch["decoder_mirror_init"]

@@ -19,7 +19,7 @@ from flax import linen as nn
 from ..ops.radial import edge_vectors
 from ..ops.segment import segment_mean, segment_sum
 from .base import register_conv
-from .layers import MLP, hoisted_pair_dense
+from .layers import MLP, fused_pair_dense_sum, hoisted_pair_dense
 
 
 def coordinate_displacement(unit, gate_feat, batch, hidden_dim, tanh=False,
@@ -52,6 +52,13 @@ class EGCL(nn.Module):
     # Pallas sorted-segment aggregation (cfg.sorted_aggregation)
     sorted_agg: bool = False
     max_in_degree: int = 0
+    # fully fused edge hot path (cfg.fused_edge_kernel): gather -> edge
+    # dense -> segment sum in one VMEM-resident Pallas kernel
+    # (layers.fused_pair_dense_sum). Applies only when the per-edge
+    # messages have a SINGLE consumer — the aggregation. Equivariant
+    # layers feed edge_feat to the coordinate gate too, so they keep the
+    # materialized path (see the ceiling analysis in docs/PERFORMANCE.md).
+    fused_edge: bool = False
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -63,30 +70,49 @@ class EGCL(nn.Module):
         # normalize=True with eps=1.0 (reference E_GCL norm_diff, operations.py)
         unit = vec / (length + 1.0)
 
-        # matmul-before-gather first edge-MLP layer (layers.hoisted_pair_dense;
-        # reference computes the same layer post-concat, EGCLStack.py:238-247)
         terms = [("edge_lin_len", length)]
         if self.edge_dim and batch.edge_attr is not None:
             terms.append(("edge_lin_attr", batch.edge_attr))
-        pre = hoisted_pair_dense(
-            self.hidden_dim, inv, batch, "edge_lin_recv", "edge_lin_send", terms
-        )
-        act = nn.relu
-        edge_feat = act(nn.Dense(self.hidden_dim, name="edge_lin2")(act(pre)))
 
-        if self.equivariant:
-            delta = coordinate_displacement(
-                unit, edge_feat, batch, self.hidden_dim, tanh=self.tanh,
-                sorted_agg=self.sorted_agg, max_in_degree=self.max_in_degree,
+        if (self.fused_edge and self.sorted_agg and self.max_in_degree > 0
+                and not self.equivariant):
+            # one fused op for the whole edge path — per-edge messages never
+            # touch HBM; identical function and parameter tree to the
+            # unfused spelling below (asserted by tests/test_fused_edge.py)
+            agg = fused_pair_dense_sum(
+                self.hidden_dim, inv, batch, "edge_lin_recv",
+                "edge_lin_send", "edge_lin2", terms,
+                max_in_degree=self.max_in_degree,
             )
-            if self.tanh:
-                rng_scale = self.param("coords_range", nn.initializers.ones, (1,))
-                delta = delta * rng_scale * 3.0
-            pos = pos + delta
+        else:
+            # matmul-before-gather first edge-MLP layer
+            # (layers.hoisted_pair_dense; reference computes the same layer
+            # post-concat, EGCLStack.py:238-247)
+            pre = hoisted_pair_dense(
+                self.hidden_dim, inv, batch, "edge_lin_recv",
+                "edge_lin_send", terms
+            )
+            act = nn.relu
+            edge_feat = act(
+                nn.Dense(self.hidden_dim, name="edge_lin2")(act(pre))
+            )
 
-        agg = segment_sum(edge_feat, batch.receivers, batch.num_nodes,
-                          batch.edge_mask, sorted_ids=self.sorted_agg,
-                          max_degree=self.max_in_degree)
+            if self.equivariant:
+                delta = coordinate_displacement(
+                    unit, edge_feat, batch, self.hidden_dim, tanh=self.tanh,
+                    sorted_agg=self.sorted_agg,
+                    max_in_degree=self.max_in_degree,
+                )
+                if self.tanh:
+                    rng_scale = self.param(
+                        "coords_range", nn.initializers.ones, (1,)
+                    )
+                    delta = delta * rng_scale * 3.0
+                pos = pos + delta
+
+            agg = segment_sum(edge_feat, batch.receivers, batch.num_nodes,
+                              batch.edge_mask, sorted_ids=self.sorted_agg,
+                              max_degree=self.max_in_degree)
         out = MLP((self.hidden_dim, self.output_dim), "relu")(
             jnp.concatenate([inv, agg], axis=-1)
         )
@@ -102,4 +128,5 @@ def make_egnn(cfg, in_dim, out_dim, last_layer):
         equivariant=cfg.equivariance and not last_layer,
         sorted_agg=cfg.sorted_aggregation,
         max_in_degree=cfg.max_in_degree,
+        fused_edge=cfg.fused_edge_kernel,
     )

@@ -4,8 +4,11 @@ from __future__ import annotations
 
 from typing import Callable, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 from flax import linen as nn
+
+from ..ops.segment import fused_edge_message_sum as _fused_edge_message_sum
 
 
 def mirrored_lecun_normal():
@@ -172,9 +175,75 @@ def hoisted_pair_dense(dim, inv, batch, name_recv, name_send, edge_terms=()):
 
     ``edge_terms`` is an iterable of (name, [E, d] array) extra edge-aligned
     operands, each getting its own bias-free projection.
+
+    When the downstream consumer is relu -> Dense -> relu -> segment_sum and
+    nothing else reads the per-edge messages, prefer
+    ``fused_pair_dense_sum`` below: same parameters, but the whole chain
+    runs in one VMEM-resident Pallas kernel on TPU.
     """
     out = nn.Dense(dim, name=name_recv)(inv)[batch.receivers]
     out = out + nn.Dense(dim, use_bias=False, name=name_send)(inv)[batch.senders]
     for name, arr in edge_terms:
         out = out + nn.Dense(dim, use_bias=False, name=name)(arr)
     return out
+
+
+class _FusedEdgeDense(nn.Module):
+    """Params of the second edge-dense layer (``kernel``/``bias``, named
+    and initialized exactly like ``nn.Dense`` so the fused and unfused
+    routes share one checkpoint format) + the fused Pallas/dense call.
+
+    ``jax.checkpoint`` wraps the op so the plain-jnp tangent rule's
+    residuals (pre-activation, relu masks — [E, C] arrays) are recomputed
+    in the backward instead of materialized in the forward: the training
+    forward stays VMEM-resident, which is the point of the fusion.
+    """
+
+    features: int
+    max_in_degree: int
+
+    @nn.compact
+    def __call__(self, node_recv, edge_in, receivers, num_segments):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(),
+            (edge_in.shape[-1], self.features),
+        )
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        dtype = jnp.result_type(node_recv, edge_in, kernel, bias)
+        max_degree = self.max_in_degree
+
+        def call(nr, ei, w, b):
+            return _fused_edge_message_sum(
+                nr.astype(dtype), ei.astype(dtype), w.astype(dtype),
+                b.astype(dtype), receivers, num_segments, max_degree,
+            )
+
+        return jax.checkpoint(call)(node_recv, edge_in, kernel, bias)
+
+
+def fused_pair_dense_sum(dim, inv, batch, name_recv, name_send, name_out,
+                         edge_terms=(), max_in_degree: int = 0):
+    """Fused counterpart of the whole EGNN-style edge hot path:
+
+        hoisted_pair_dense -> relu -> Dense(name_out) -> relu -> segment_sum
+
+    in ONE op (ops/segment.py fused_edge_message_sum; the Pallas kernel on
+    TPU keeps per-edge messages VMEM-resident). Same parameter tree as the
+    unfused spelling — ``name_recv``/``name_send``/``edge_terms`` denses
+    here, ``kernel``/``bias`` under ``name_out`` — so checkpoints and
+    A/B inits are interchangeable between routes.
+
+    The receiver projection stays NODE-sized ([N, C], gathered in-kernel by
+    the receiver-sorted one-hot); the sender projection and the edge-local
+    terms collapse into the single edge-aligned operand the kernel streams.
+    Requires receiver-sorted batches and a static in-degree bound, like
+    ``segment_sum(sorted_ids=True)``; padding edges land on the dummy node,
+    whose garbage row every consumer already masks (data/graph.py).
+    """
+    node_recv = nn.Dense(dim, name=name_recv)(inv)
+    edge_in = nn.Dense(dim, use_bias=False, name=name_send)(inv)[batch.senders]
+    for name, arr in edge_terms:
+        edge_in = edge_in + nn.Dense(dim, use_bias=False, name=name)(arr)
+    return _FusedEdgeDense(dim, max_in_degree, name=name_out)(
+        node_recv, edge_in, batch.receivers, batch.num_nodes
+    )

@@ -82,6 +82,13 @@ class PNAConv(nn.Module):
         )
         msg = hoisted_pair_dense(f_in, inv, batch, "pre_recv", "pre_send", terms)
 
+        # NOT fused into the gather->dense->segment-sum Pallas kernel
+        # (cfg.fused_edge_kernel, layers.fused_pair_dense_sum): PNA's
+        # messages are multiply-consumed — max/min/std need the full [E, C]
+        # message array in HBM regardless, so fusing the sum component
+        # would add kernel FLOPs without removing any memory traffic. The
+        # mean's underlying segment sums still ride the sorted Pallas
+        # route (pna_aggregate -> ops/segment.py).
         scaled = pna_aggregate(msg, batch, self.deg_hist,
                                self.sorted_agg, self.max_in_degree)
         # post-MLP, post_layers=1, then final linear projection

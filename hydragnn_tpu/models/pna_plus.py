@@ -45,7 +45,11 @@ class PNAPlusConv(nn.Module):
         msg = hoisted_pair_dense(
             f_in, inv, batch, "pre_recv", "pre_send", [("pre_edge", e)]
         )
-        # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276)
+        # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276).
+        # Like PNA, this path does NOT use the fused edge kernel
+        # (cfg.fused_edge_kernel): the gated message feeds four aggregators
+        # (mean/min/max/std), so [E, C] must exist in HBM anyway and fusion
+        # removes no traffic — see models/pna.py for the decision record.
         msg = msg * nn.Dense(f_in, use_bias=False)(rbf)
 
         scaled = pna_aggregate(msg, batch, self.deg_hist,

@@ -1,4 +1,5 @@
 from .segment import (
+    fused_edge_message_sum,
     masked_global_mean_pool,
     masked_global_sum_pool,
     segment_count,
@@ -11,6 +12,7 @@ from .segment import (
 )
 
 __all__ = [
+    "fused_edge_message_sum",
     "masked_global_mean_pool",
     "masked_global_sum_pool",
     "segment_count",

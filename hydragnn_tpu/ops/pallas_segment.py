@@ -20,7 +20,16 @@ the MXU as a block-diagonal one-hot matmul:
   [Eb, Cb] MXU contraction instead of a scatter.
 
 The backward pass of a segment sum is a gather, which XLA already does
-well, so the custom VJP uses ``dout[recv]`` directly.
+well. Differentiation is a ``jax.custom_jvp`` whose tangent rule is the
+PLAIN ``jax.ops.segment_sum`` of the tangent (a segment sum is linear):
+reverse mode transposes that jnp tangent into the ``dout[recv]`` gather —
+identical backward cost to the r5 custom-VJP — and, because no Pallas call
+ever appears on a tangent path, the op composes under ``jax.grad`` to ANY
+order. That second-order capability is what lets energy-force training
+(forces = -dE/dpos inside the loss, differentiated again by the training
+grad) use this kernel; the r5 custom_vjp was first-order only and raised
+pallas_call's missing-JVP NotImplementedError on exactly that workload
+(the since-dropped grad-energy guard in config/config.py).
 """
 
 from __future__ import annotations
@@ -63,7 +72,7 @@ def _pad_to(x, multiple, axis):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7)
+    jax.custom_jvp, nondiff_argnums=(2, 3, 4, 5, 6, 7)
 )
 def sorted_segment_sum(
     messages,
@@ -79,7 +88,10 @@ def sorted_segment_sum(
 
     ``segment_ids`` MUST be ascending (sorted receivers), and any segment
     holding more than ``max_degree`` edges gets an UNSPECIFIED value (its
-    trailing edges fall outside the K streamed windows). Real nodes of this
+    trailing edges fall outside the K streamed windows) — and can starve
+    LATER segments inside the same ``block_rows`` row block, whose edges
+    get pushed past those windows (subsequent row blocks are unaffected:
+    each gets its own ``estart``). Real nodes of this
     framework's batches satisfy the cap (data/neighbors.py caps in-degree;
     ``GraphLoader(sort_edges=True)`` sorts receivers; the loader validates
     real in-degrees against the bound) — but the final *padding* node
@@ -161,15 +173,21 @@ def _forward(
     return out[:num_segments, :c].astype(dtype)
 
 
-def _fwd(messages, segment_ids, *static):
-    return _forward(messages, segment_ids, *static), segment_ids
-
-
-def _bwd(num_segments, max_degree, block_rows, block_edges, block_cols,
-         interpret, segment_ids, g):
-    # d/d msg of a segment sum is a gather of the cotangent (XLA-fast);
-    # integer ids get no gradient
-    return g[segment_ids], None
-
-
-sorted_segment_sum.defvjp(_fwd, _bwd)
+@sorted_segment_sum.defjvp
+def _jvp(num_segments, max_degree, block_rows, block_edges, block_cols,
+         interpret, primals, tangents):
+    messages, segment_ids = primals
+    t_msg, _ = tangents  # integer ids get a float0 tangent — no gradient
+    out = sorted_segment_sum(
+        messages, segment_ids, num_segments, max_degree, block_rows,
+        block_edges, block_cols, interpret,
+    )
+    # tangent in PLAIN jnp (a segment sum is linear in the messages): its
+    # transpose is the ``dout[recv]`` gather — the same XLA-fast backward
+    # as the r5 custom_vjp — and it is differentiable to any order, so
+    # grad-of-grad (energy-force training) composes instead of hitting
+    # pallas_call's missing JVP rule.
+    t_out = jax.ops.segment_sum(
+        t_msg, segment_ids, num_segments=num_segments
+    ).astype(out.dtype)
+    return out, t_out

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import functools
 import math
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -110,7 +110,7 @@ def spherical_basis(
     num_spherical: int,
     num_radial: int,
     envelope_exponent: int = 5,
-    edge_mask: jnp.ndarray = None,
+    edge_mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """[T, num_spherical * num_radial] directional basis a_SBF(d_kj, angle_kji).
 

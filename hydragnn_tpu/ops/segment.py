@@ -93,6 +93,44 @@ def segment_sum(
     return jax.ops.segment_sum(msg, segment_ids, num_segments=num_segments)
 
 
+def fused_edge_message_sum(
+    node_recv,
+    edge_in,
+    weights,
+    bias,
+    segment_ids,
+    num_segments,
+    max_degree: int,
+):
+    """Fused gather -> edge dense -> segment sum of the edge hot path:
+
+        segment_sum(relu(relu(node_recv[ids] + edge_in) @ weights + bias))
+
+    Routing mirrors ``segment_sum``: receiver-sorted ids + a static
+    in-degree bound on a TPU jit target go through the VMEM-resident Pallas
+    kernel (ops/pallas_fused_edge.py) — per-edge messages never touch HBM;
+    ``HYDRAGNN_PALLAS_SEGMENT=1`` forces the route off-TPU in interpret
+    mode (the CPU-mesh dryrun / CI smoke); any other backend falls back to
+    the dense plain-jnp reference, which is the same function. Both routes
+    differentiate to arbitrary order (the kernel's tangent rule is plain
+    jnp), so energy-force training composes.
+    """
+    if os.getenv("HYDRAGNN_DEBUG_SORTED") == "1":
+        _debug_check_sorted(segment_ids)
+    if max_degree and _pallas_route_enabled():
+        from .pallas_fused_edge import fused_edge_message_sum as _pallas_fused
+
+        return _pallas_fused(
+            node_recv, edge_in, weights, bias, segment_ids, num_segments,
+            max_degree, interpret=jax.default_backend() != "tpu",
+        )
+    from .pallas_fused_edge import reference_edge_message_sum
+
+    return reference_edge_message_sum(
+        node_recv, edge_in, weights, bias, segment_ids, num_segments
+    )
+
+
 def segment_count(segment_ids, num_segments, mask=None):
     ones = jnp.ones(segment_ids.shape[:1], jnp.float32)
     if mask is not None:

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from .mesh import compat_shard_map as shard_map
 
 from ..models.base import HydraModel
 from ..train.loss import compute_loss

@@ -1,0 +1,384 @@
+"""Fused gather -> edge-dense -> sorted-segment-sum kernel (interpret mode on
+CPU) vs the dense ``segment_sum`` + explicit-matmul reference: forward,
+grad, and grad-of-grad (force-style loss), f32/bf16, ragged tails, empty
+segments, degree spill, routing fallbacks, and model-level fused==unfused
+(ops/pallas_fused_edge.py, ops/segment.py, models/layers.py, models/egnn.py).
+"""
+
+import copy
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.pallas_fused_edge import (
+    fused_edge_message_sum,
+    reference_edge_message_sum,
+)
+from test_pallas_segment import _sorted_capped_receivers
+
+
+def _operands(rng, e, n, ci, co, dtype=np.float32):
+    nr = jnp.asarray(rng.normal(size=(n, ci)).astype(dtype))
+    ei = jnp.asarray(rng.normal(size=(e, ci)).astype(dtype))
+    w = jnp.asarray(rng.normal(size=(ci, co)).astype(dtype) / np.sqrt(ci))
+    b = jnp.asarray(rng.normal(size=(co,)).astype(dtype))
+    return nr, ei, w, b
+
+
+@pytest.mark.parametrize(
+    "e,n,ci,co,max_degree",
+    [
+        (300, 50, 7, 13, 16),     # odd widths, small
+        (1000, 128, 64, 64, 20),  # production-ish ratios
+        (37, 400, 3, 5, 4),       # tiny ragged edge tail, many empty rows
+        (512, 64, 130, 70, 16),   # >1 lane block in, odd out
+    ],
+)
+def pytest_forward_matches_dense(e, n, ci, co, max_degree):
+    rng = np.random.default_rng(e + n)
+    recv = _sorted_capped_receivers(rng, e, n, max_degree)
+    nr, ei, w, b = _operands(rng, e, n, ci, co)
+    out = fused_edge_message_sum(
+        nr, ei, w, b, jnp.asarray(recv), n, max_degree, interpret=True
+    )
+    ref = reference_edge_message_sum(nr, ei, w, b, jnp.asarray(recv), n)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def pytest_bf16_streams_with_f32_accumulation():
+    rng = np.random.default_rng(11)
+    recv = _sorted_capped_receivers(rng, 400, 64, 16)
+    nr, ei, w, b = _operands(rng, 400, 64, 32, 32)
+    cast = lambda x: x.astype(jnp.bfloat16)
+    out = fused_edge_message_sum(
+        cast(nr), cast(ei), cast(w), cast(b), jnp.asarray(recv), 64, 16,
+        interpret=True,
+    )
+    assert out.dtype == jnp.bfloat16
+    ref = reference_edge_message_sum(nr, ei, w, b, jnp.asarray(recv), 64)
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref), rtol=4e-2, atol=4e-2
+    )
+
+
+def pytest_empty_and_trailing_segments():
+    """Segments with no edges (incl. a trailing run) come out zero — bias
+    and the relu do not leak into edge-less rows."""
+    rng = np.random.default_rng(2)
+    recv = np.array([2, 2, 5], np.int32)
+    nr, ei, w, b = _operands(rng, 3, 64, 4, 6)
+    out = fused_edge_message_sum(
+        nr, ei, w, b, jnp.asarray(recv), 64, 8, interpret=True
+    )
+    ref = reference_edge_message_sum(nr, ei, w, b, jnp.asarray(recv), 64)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    mask = np.ones(64, bool)
+    mask[[2, 5]] = False
+    assert np.abs(np.asarray(out)[mask]).max() == 0.0
+
+
+def pytest_degree_spill_in_final_segment_is_contained():
+    """Over-cap blast radius, pinned to the layout the framework actually
+    produces: a segment holding more than max_degree edges has an
+    UNSPECIFIED value and can also starve LATER rows inside its own
+    row block (their edges fall past the K streamed windows) — which is
+    exactly why data/graph.py routes every padding edge to the FINAL
+    dummy node: with the over-cap segment last, every preceding segment
+    stays exact. Assert that contract, with a spill far larger than one
+    edge window so the test would catch a coverage regression."""
+    rng = np.random.default_rng(3)
+    n, max_degree = 40, 4
+    # every node gets max_degree-1 edges; the LAST node (the dummy-node
+    # position) additionally gets ~3 edge windows' worth of spill
+    recv = np.concatenate([
+        np.repeat(np.arange(n, dtype=np.int32), max_degree - 1),
+        np.full(1500, n - 1, np.int32),
+    ])
+    recv = np.sort(recv).astype(np.int32)
+    e = recv.shape[0]
+    nr, ei, w, b = _operands(rng, e, n, 9, 11)
+    out = np.asarray(fused_edge_message_sum(
+        nr, ei, w, b, jnp.asarray(recv), n, max_degree, interpret=True
+    ))
+    ref = np.asarray(reference_edge_message_sum(
+        nr, ei, w, b, jnp.asarray(recv), n
+    ))
+    np.testing.assert_allclose(out[: n - 1], ref[: n - 1],
+                               rtol=2e-5, atol=2e-5)
+
+
+def pytest_gradients_match_dense():
+    """First-order grads w.r.t. every differentiable operand: the custom-JVP
+    tangent rule transposes to the gather + two-matmul VJP."""
+    rng = np.random.default_rng(5)
+    n, e, ci, co, max_degree = 48, 220, 12, 10, 12
+    recv = _sorted_capped_receivers(rng, e, n, max_degree)
+    nr, ei, w, b = _operands(rng, e, n, ci, co)
+    probe = jnp.asarray(rng.normal(size=(n, co)).astype(np.float32))
+
+    def loss(nr, ei, w, b, agg):
+        return jnp.sum(probe * jnp.tanh(agg(nr, ei, w, b)))
+
+    fp = lambda *a: fused_edge_message_sum(
+        *a, jnp.asarray(recv), n, max_degree, interpret=True
+    )
+    fd = lambda *a: reference_edge_message_sum(*a, jnp.asarray(recv), n)
+    gp = jax.grad(loss, argnums=(0, 1, 2, 3))(nr, ei, w, b, fp)
+    gd = jax.grad(loss, argnums=(0, 1, 2, 3))(nr, ei, w, b, fd)
+    for a, c in zip(gp, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c),
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 2e-5), (jnp.bfloat16, 5e-2)])
+def pytest_grad_of_grad_force_style(dtype, tol):
+    """Force-style second order: energy built through the fused op, forces
+    = -dE/dpos via an inner jax.grad, outer training grad w.r.t. weights
+    and positions — the exact composition the r5 custom_vjp kernel raised
+    NotImplementedError on."""
+    rng = np.random.default_rng(7)
+    n, e, ci, max_degree = 32, 150, 8, 10
+    recv = _sorted_capped_receivers(rng, e, n, max_degree)
+    send = rng.integers(0, n, e).astype(np.int32)
+    pos = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32)).astype(dtype)
+    proj = jnp.asarray(
+        rng.normal(size=(3, ci)).astype(np.float32)
+    ).astype(dtype)
+    w = jnp.asarray(
+        (rng.normal(size=(ci, ci)) / np.sqrt(ci)).astype(np.float32)
+    ).astype(dtype)
+    b = jnp.zeros((ci,), dtype)
+
+    def energy(pos, w, agg):
+        nr = pos @ proj
+        ei = (pos[send] - pos[recv]) @ proj
+        return jnp.sum(agg(nr, ei, w, b) ** 2)
+
+    def force_loss(w, pos, agg):
+        f = -jax.grad(energy, argnums=0)(pos, w, agg)
+        return jnp.sum(f ** 2) + energy(pos, w, agg)
+
+    fp = lambda *a: fused_edge_message_sum(
+        *a, jnp.asarray(recv), n, max_degree, interpret=True
+    )
+    fd = lambda *a: reference_edge_message_sum(*a, jnp.asarray(recv), n)
+    for argnums in (0, 1):  # d(force loss)/dW and /dpos — both second order
+        gp = jax.grad(force_loss, argnums=argnums)(w, pos, fp)
+        gd = jax.grad(force_loss, argnums=argnums)(w, pos, fd)
+        scale = max(float(jnp.abs(gd.astype(jnp.float32)).max()), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(gp, np.float32) / scale,
+            np.asarray(gd, np.float32) / scale, rtol=tol, atol=tol,
+        )
+
+
+def pytest_routing_fallback_and_force(monkeypatch):
+    """ops/segment.py routing: =0 forces the dense reference (bit-identical),
+    =1 forces the Pallas kernel in interpret mode off-TPU."""
+    from hydragnn_tpu.ops.segment import fused_edge_message_sum as routed
+
+    rng = np.random.default_rng(9)
+    n, e, max_degree = 30, 90, 8
+    recv = _sorted_capped_receivers(rng, e, n, max_degree)
+    nr, ei, w, b = _operands(rng, e, n, 6, 6)
+    ref = reference_edge_message_sum(nr, ei, w, b, jnp.asarray(recv), n)
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_SEGMENT", "0")
+    out_dense = routed(nr, ei, w, b, jnp.asarray(recv), n, max_degree)
+    np.testing.assert_array_equal(np.asarray(out_dense), np.asarray(ref))
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_SEGMENT", "1")
+    out_kernel = routed(nr, ei, w, b, jnp.asarray(recv), n, max_degree)
+    np.testing.assert_allclose(np.asarray(out_kernel), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# model level: the fused EGCL route is the same function and the same
+# parameter tree as the unfused spelling
+# ---------------------------------------------------------------------------
+
+
+def _egnn_config(equivariance=False, grad_energy=False):
+    arch = {
+        "mpnn_type": "EGNN",
+        "equivariance": equivariance,
+        "radius": 5.0,
+        "max_neighbours": 10,
+        "hidden_dim": 16,
+        "num_conv_layers": 2,
+        "use_sorted_aggregation": True,
+        "task_weights": [1.0],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 1,
+                "dim_sharedlayers": 16,
+                "num_headlayers": 2,
+                "dim_headlayers": [16, 16],
+            }
+        },
+    }
+    voi = {
+        "input_node_features": [0],
+        "output_names": ["energy"],
+        "output_index": [0],
+        "type": ["graph"],
+    }
+    training = {
+        "batch_size": 8,
+        "num_epoch": 1,
+        "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+    }
+    if grad_energy:
+        arch["output_heads"] = {
+            "node": {"num_headlayers": 2, "dim_headlayers": [16, 16],
+                     "type": "mlp"},
+        }
+        voi.update(output_names=["graph_energy"], type=["node"],
+                   output_dim=[1])
+        training["compute_grad_energy"] = True
+    return {
+        "NeuralNetwork": {
+            "Architecture": arch,
+            "Variables_of_interest": voi,
+            "Training": training,
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 3]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+
+
+def _shaped_graphs():
+    from hydragnn_tpu.data import oc20_shaped_dataset, split_dataset
+
+    graphs = oc20_shaped_dataset(24, mean_atoms=20, min_atoms=10,
+                                 max_atoms=40, max_neighbours=10)
+    out = []
+    for g in graphs:
+        out.append(dataclasses.replace(
+            g, x=np.asarray(g.z, np.float32)[:, None], graph_y=None
+        ))
+    return split_dataset(out, 0.8, seed=0)
+
+
+def pytest_fused_flag_completion():
+    from hydragnn_tpu.config import update_config
+
+    tr, va, te = _shaped_graphs()
+    done = update_config(copy.deepcopy(_egnn_config()), tr, va, te)
+    arch = done["NeuralNetwork"]["Architecture"]
+    assert arch["use_fused_edge_kernel"] is True  # follows sorted-agg
+
+    off = copy.deepcopy(_egnn_config())
+    off["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] = False
+    done_off = update_config(off, tr, va, te)
+    assert done_off["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] is False
+
+    explicit = copy.deepcopy(_egnn_config())
+    explicit["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] = False
+    done_ex = update_config(explicit, tr, va, te)
+    assert done_ex["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] is False
+
+    # explicit fused WITHOUT sorted can never engage — must fail loudly,
+    # not silently A/B the unfused route against itself
+    bad = copy.deepcopy(_egnn_config())
+    bad["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] = False
+    bad["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] = True
+    with pytest.raises(ValueError, match="use_sorted_aggregation"):
+        update_config(bad, tr, va, te)
+
+
+@pytest.mark.parametrize("route_env", ["0", "1"])
+def pytest_egcl_fused_equals_unfused(monkeypatch, route_env):
+    """One training step on a real sorted batch: identical init param trees,
+    loss agreement between the fused module and the unfused spelling, on
+    BOTH the dense fallback (env 0) and the interpret kernel (env 1)."""
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data import GraphLoader
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_SEGMENT", route_env)
+    tr, va, te = _shaped_graphs()
+    config = update_config(copy.deepcopy(_egnn_config()), tr, va, te)
+    loader = GraphLoader(tr, 8, seed=0, drop_last=True, sort_edges=True)
+    batch = next(iter(loader))
+    losses, params0, sig0 = {}, None, None
+    for fused in (True, False):
+        c = copy.deepcopy(config)
+        c["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] = fused
+        model = create_model(c)
+        variables = init_model(model, batch, seed=0)
+        sig = tuple(sorted(
+            str(p) for p, _ in jax.tree_util.tree_leaves_with_path(variables)
+        ))
+        if sig0 is None:
+            params0, sig0 = variables, sig
+        else:
+            assert sig == sig0, "fused/unfused parameter trees differ"
+        tx = make_optimizer(c["NeuralNetwork"]["Training"]["Optimizer"])
+        state = TrainState.create(
+            jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params0),
+            tx,
+        )
+        step = make_train_step(model, tx)
+        _, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+        losses[fused] = float(tot)
+    assert np.isfinite(losses[True]) and np.isfinite(losses[False])
+    assert abs(losses[True] - losses[False]) <= 1e-5 * max(
+        1.0, abs(losses[False])
+    ), losses
+
+
+def pytest_energy_force_step_fused_equals_dense(monkeypatch):
+    """The previously-guarded combination — use_sorted_aggregation (and the
+    fused kernel) WITH Training.compute_grad_energy — runs and agrees with
+    the dense route on the energy+force loss. This is the CPU tier-1 analog
+    of the multichip dryrun's energy-force leg (__graft_entry__)."""
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data import GraphLoader, lennard_jones_dataset
+    from hydragnn_tpu.data.pipeline import split_dataset
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    graphs = lennard_jones_dataset(24)
+    tr, va, te = split_dataset(graphs, 0.75, seed=0)
+    config = _egnn_config(grad_energy=True)
+    config["NeuralNetwork"]["Architecture"].update(radius=2.5,
+                                                   max_neighbours=32)
+    config["Dataset"] = {"node_features": {"name": ["type"], "dim": [1]}}
+    config = update_config(config, tr, va, te)
+    arch = config["NeuralNetwork"]["Architecture"]
+    # the r5 grad-energy guard is gone: sorted + grad-energy completes, and
+    # the fused flag follows
+    assert arch["use_sorted_aggregation"] is True
+    assert arch["use_fused_edge_kernel"] is True
+    model = create_model(config)
+    loader = GraphLoader(tr, 8, seed=0, drop_last=True, sort_edges=True,
+                         max_in_degree=arch["max_in_degree"])
+    batch = next(iter(loader))
+    variables = init_model(model, batch, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    losses = {}
+    for flag in ("1", "0"):
+        monkeypatch.setenv("HYDRAGNN_PALLAS_SEGMENT", flag)
+        state = TrainState.create(
+            jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                   variables), tx,
+        )
+        step = make_train_step(model, tx, compute_grad_energy=True)
+        _, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+        assert np.isfinite(float(tot))
+        losses[flag] = float(tot)
+    assert abs(losses["1"] - losses["0"]) <= 1e-4 * max(
+        1.0, abs(losses["0"])
+    ), losses

@@ -187,14 +187,16 @@ def pytest_kernel_on_real_batch_layout():
     )
 
 
-def pytest_sorted_agg_refused_for_grad_energy(monkeypatch):
-    """Regression: the r5 TPU auto-default briefly enabled the Pallas route
-    for EVERY config, and examples/md17 (forces = -dE/dpos) crashed on the
-    chip with pallas_call's missing-JVP NotImplementedError — the kernel is
-    first-order (custom-VJP) only, and grad-energy training differentiates
-    the aggregation twice. Config completion must (a) keep the TPU
-    auto-default dense for grad-energy configs and (b) reject an explicit
-    use_sorted_aggregation+grad-energy combination loudly."""
+def pytest_sorted_agg_allowed_for_grad_energy(monkeypatch):
+    """r6 inversion of the r5 guard: the sorted kernels now differentiate
+    through a custom-JVP with plain-jnp tangents (ops/pallas_segment.py,
+    ops/pallas_fused_edge.py), so grad-of-grad composes and energy-force
+    configs get the sorted route. Config completion must (a) auto-enable
+    sorted aggregation for grad-energy configs when jitting for TPU — the
+    r5 completion kept them dense — and (b) accept the explicit
+    combination it used to reject, with the fused flag following. The
+    loss-level fused==dense proof for the energy+force objective lives in
+    tests/test_fused_edge.py and the multichip dryrun."""
     tr, va, te = _graphs()
     cfg = _config(None)
     nn = cfg["NeuralNetwork"]
@@ -202,23 +204,20 @@ def pytest_sorted_agg_refused_for_grad_energy(monkeypatch):
     nn["Variables_of_interest"]["output_dim"] = [1]
     nn["Variables_of_interest"]["type"] = ["node"]
 
-    # (a) auto-default: even when jitting for TPU (env-probed, no backend
-    # touch), grad-energy keeps the dense differentiable-twice route
+    # (a) auto-default: when jitting for TPU (env-probed, no backend
+    # touch), grad-energy configs now flip sorted ON like everything else
     monkeypatch.setenv("JAX_PLATFORMS", "tpu")
     import copy
 
     nn["Architecture"].pop("use_sorted_aggregation", None)
     done = update_config(copy.deepcopy(cfg), tr, va, te)
-    assert done["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] is False
+    arch = done["NeuralNetwork"]["Architecture"]
+    assert arch["use_sorted_aggregation"] is True
+    assert arch["use_fused_edge_kernel"] is True
+    assert arch["max_in_degree"] > 0
 
-    # sanity: a non-grad-energy config on the same fake TPU env does flip on
-    plain = _config(None)
-    plain["NeuralNetwork"]["Architecture"].pop("use_sorted_aggregation", None)
-    done_plain = update_config(copy.deepcopy(plain), tr, va, te)
-    assert done_plain["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] is True
-
-    # (b) explicit combination fails with a clear message
-    bad = copy.deepcopy(cfg)
-    bad["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] = True
-    with pytest.raises(ValueError, match="second-order"):
-        update_config(bad, tr, va, te)
+    # (b) the explicit combination the r5 guard rejected completes cleanly
+    explicit = copy.deepcopy(cfg)
+    explicit["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] = True
+    done_ex = update_config(explicit, tr, va, te)
+    assert done_ex["NeuralNetwork"]["Architecture"]["use_sorted_aggregation"] is True
