@@ -153,13 +153,20 @@ def _oc20_workload(arch, batch_size, num_configs, mixed_precision,
                    pack_batches=False):
     """Shared bench-config scaffold: OC20-shaped dataset + energy/forces
     heads + the bench Training block around a caller-supplied Architecture.
-    One builder so the EGNN production cell and the MACE/DimeNet cells
+    One builder so the EGNN production cell and the MACE/DimeNet/GPS cells
     cannot drift on the non-Architecture knobs."""
     from hydragnn_tpu.api import prepare_data
     from hydragnn_tpu.data.pipeline import split_dataset
     from hydragnn_tpu.data.synthetic import oc20_shaped_dataset
 
     graphs = oc20_shaped_dataset(num_configs)
+    if arch.get("global_attn_engine"):
+        # GPS consumes Laplacian PE channels; the explicit-datasets path of
+        # prepare_data does not attach them (api.py does it only for the
+        # config-loaded path), so the bench scaffold does
+        from hydragnn_tpu.data import add_dataset_pe
+
+        graphs = add_dataset_pe(graphs, int(arch.get("pe_dim") or 1))
     tr, va, te = split_dataset(graphs, 0.9, seed=0)
     config = {
         "Verbosity": {"level": 0},
@@ -337,6 +344,64 @@ def _model_cell_workload(model_name: str, mixed_precision=None):
     return _oc20_workload(arch, batch_size, num_configs, mixed_precision)
 
 
+def _gps_cell_workload(attn_variant: str, mixed_precision=None):
+    """GPS global-attention cells (BENCH_GPS=1) — the fork's headline
+    feature (SURVEY §0 pillar 5) finally gets banked graphs/sec/chip + MFU
+    numbers. Same OC20-shaped data + energy/forces heads as every other
+    cell; GIN local MPNN (the mesoscale GPS recipe) so the attention route
+    is the only moving part across the three variants:
+
+    - ``flash``: multihead through the segment-masked Pallas flash kernel
+      (ops/pallas_flash_attention.py) — the r6 tentpole;
+    - ``dense``: multihead through the incumbent per-graph gathered dense
+      layout ([G, H, Nmax, Nmax] logits in HBM) — the oracle A/B side;
+    - ``performer``: the linear-attention variant (segment-sum KV moments).
+
+    Sorted aggregation rides BENCH_CELL_SORTED like the MACE/DimeNet cells
+    (default off — the attention delta must not be confounded)."""
+    if mixed_precision is None:
+        mixed_precision = _default_mp()
+    batch_size = int(os.getenv("BENCH_GPS_BATCH_SIZE", "16"))
+    hidden = int(os.getenv("BENCH_GPS_HIDDEN", "256"))
+    arch = {
+        "mpnn_type": "GIN",
+        "hidden_dim": hidden,
+        "num_conv_layers": 4,
+        "radius": 5.0,
+        "max_neighbours": 20,
+        "global_attn_engine": "GPS",
+        "global_attn_type": (
+            "performer" if attn_variant == "performer" else "multihead"
+        ),
+        "global_attn_heads": int(os.getenv("BENCH_GPS_HEADS", "8")),
+        "pe_dim": 4,
+        # dropout pinned 0 across ALL three variants: flash configs run
+        # attention-prob dropout at 0 by design (models/gps.py), so a
+        # dense cell at the 0.25 default would train different numerics
+        # AND pay dropout-rng work flash skips — the A/B must isolate the
+        # attention route, nothing else
+        "dropout": 0.0,
+        "use_flash_attention": attn_variant == "flash",
+        "use_sorted_aggregation": os.getenv("BENCH_CELL_SORTED", "0") == "1",
+        "task_weights": [1.0, 100.0],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 2,
+                "dim_sharedlayers": 50,
+                "num_headlayers": 2,
+                "dim_headlayers": [256, 256],
+            },
+            "node": {
+                "num_headlayers": 2,
+                "dim_headlayers": [256, 256],
+                "type": "mlp",
+            },
+        },
+    }
+    num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
+    return _oc20_workload(arch, batch_size, num_configs, mixed_precision)
+
+
 def _bench_production(mixed_precision=None, sorted_aggregation=None,
                       profile=None, env_overrides=None, workload=None):
     import jax
@@ -355,6 +420,10 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
         if workload is None:
             config, loader = _production_workload(
                 mixed_precision, sorted_aggregation
+            )
+        elif workload.startswith("GPS_"):
+            config, loader = _gps_cell_workload(
+                workload.split("_", 1)[1], mixed_precision
             )
         else:
             config, loader = _model_cell_workload(workload, mixed_precision)
@@ -451,6 +520,14 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
             and int(arch_done.get("max_in_degree") or 0) > 0
         ),
         "equivariance": bool(arch_done.get("equivariance", False)),
+        # the attention route that can actually engage: flash needs GPS +
+        # the static per-graph node bound (models/gps.py routing)
+        "flash_attention": bool(
+            arch_done.get("global_attn_engine")
+            and arch_done.get("use_flash_attention", False)
+            and int(arch_done.get("max_nodes_per_graph") or 0) > 0
+        ),
+        "global_attn_type": arch_done.get("global_attn_type"),
     }
 
 
@@ -601,6 +678,19 @@ def main_ab():
         {"mp": True, "sorted": False, "model": "MACE", "tag": "mace"},
         {"mp": True, "sorted": False, "model": "DimeNet", "tag": "dimenet"},
     ]
+    if os.getenv("BENCH_GPS", "0") == "1":
+        # GPS attention A/B (the r6 tentpole): flash vs the incumbent
+        # gathered-dense multihead, plus the performer linear variant —
+        # the first on-chip numbers for the fork's headline feature.
+        # Dense first: a mid-matrix wedge then still leaves the baseline.
+        cells += [
+            {"mp": True, "sorted": False, "model": "GPS_dense",
+             "tag": "gps_dense"},
+            {"mp": True, "sorted": False, "model": "GPS_flash",
+             "tag": "gps_flash"},
+            {"mp": True, "sorted": False, "model": "GPS_performer",
+             "tag": "gps_performer"},
+        ]
     n_done = 0
     for cell in cells:
         mp, sorted_agg = cell["mp"], cell["sorted"]
@@ -657,6 +747,9 @@ def main_ab():
                 "sorted_aggregation": sorted_agg,
                 "fused_edge": prod["fused_edge"],
                 "equivariance": prod["equivariance"],
+                "flash_attention": prod["flash_attention"],
+                **({"global_attn_type": prod["global_attn_type"]}
+                   if prod["global_attn_type"] else {}),
                 **({"variant": cell["tag"]} if "tag" in cell else {}),
                 "vs_baseline": round(syn / RECORDED_BASELINE, 3),
                 "synthetic_pna_graphs_per_sec": round(syn, 2),
@@ -689,7 +782,77 @@ def main_ab():
         sys.exit(3)
 
 
+def smoke_gps():
+    """BENCH_GPS_SMOKE=1: CPU-runnable proof that every BENCH_GPS cell
+    builds and trains — one jitted step per attention variant at tiny
+    shapes, with the flash cell FORCED through the Pallas kernel
+    (interpret mode, HYDRAGNN_PALLAS_FLASH=1) and asserted loss-equal to
+    the gathered-dense cell from identical init. This is the CI tier's
+    guard that the bench cells cannot rot between hardware rounds
+    (run-scripts/ci.sh invokes it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    os.environ.setdefault("BENCH_GPS_BATCH_SIZE", "4")
+    os.environ.setdefault("BENCH_GPS_HIDDEN", "32")
+    os.environ.setdefault("BENCH_GPS_HEADS", "4")
+    os.environ.setdefault("BENCH_NUM_CONFIGS", "24")
+    losses = {}
+    for variant in ("dense", "performer", "flash"):
+        config, loader = _gps_cell_workload(variant, mixed_precision=False)
+        batch = next(iter(loader))
+        model = create_model(config)
+        variables = init_model(model, batch, seed=0)
+        tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+        if variant != "flash":
+            state = TrainState.create(variables, tx)
+            _, tot, _ = make_train_step(model, tx)(
+                state, batch, jax.random.PRNGKey(0)
+            )
+            jax.block_until_ready(tot)
+            losses[variant] = float(tot)
+            assert np.isfinite(losses[variant]), (variant, losses)
+            continue
+        # flash cell: ONE model (the flash-flagged one — identical module
+        # structure and rng stream on both routes), env-flipped between the
+        # Pallas kernel (interpret mode on CPU) and the gathered-dense
+        # oracle; the jitted step donates its buffers, so each route gets a
+        # fresh state from a copy of the same init
+        for route, flag in (("flash", "1"), ("flash_dense_oracle", "0")):
+            os.environ["HYDRAGNN_PALLAS_FLASH"] = flag
+            try:
+                state = TrainState.create(
+                    jax.tree_util.tree_map(
+                        lambda x: jnp.array(x, copy=True), variables
+                    ),
+                    tx,
+                )
+                _, tot, _ = make_train_step(model, tx)(
+                    state, batch, jax.random.PRNGKey(0)
+                )
+                jax.block_until_ready(tot)
+            finally:
+                os.environ.pop("HYDRAGNN_PALLAS_FLASH", None)
+            losses[route] = float(tot)
+            assert np.isfinite(losses[route]), (route, losses)
+    delta = abs(losses["flash"] - losses["flash_dense_oracle"])
+    assert delta <= 1e-4 * max(1.0, abs(losses["flash_dense_oracle"])), losses
+    print(json.dumps({
+        "metric": "BENCH_GPS smoke (CPU, one step per attention variant)",
+        "losses": {k: round(v, 6) for k, v in losses.items()},
+        "flash_vs_dense_delta": delta,
+        "ok": True,
+    }))
+
+
 def main():
+    if os.getenv("BENCH_GPS_SMOKE", "0") == "1":
+        smoke_gps()
+        return
     if os.getenv("BENCH_AB", "0") == "1":
         main_ab()
         return

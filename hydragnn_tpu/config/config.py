@@ -301,6 +301,37 @@ def update_config(
             "use_fused_edge_kernel, which then follows it automatically)."
         )
 
+    # ---- GPS flash attention (ops/pallas_flash_attention.py): the
+    # segment-masked online-softmax kernel for global attention. Auto-on
+    # when jitting for TPU and GPS global attention is configured — same
+    # inference + logging contract as use_sorted_aggregation above; the
+    # dense layouts remain the oracle and the route on every other
+    # backend (the model falls back automatically when the kernel cannot
+    # engage). NOTE flash configs carry attention-PROB dropout 0 on every
+    # backend (the probabilities never exist to mask — models/gps.py);
+    # GPSConv's output dropout is unchanged. Explicit true/false wins
+    # (bench.py BENCH_GPS A/B cells pin it).
+    if "use_flash_attention" not in arch or arch["use_flash_attention"] is None:
+        if arch.get("global_attn_engine"):
+            on, source = _jit_target_inference()
+            arch["use_flash_attention"] = on
+            if on:
+                # unlike the aggregation kernels this auto-flip is NOT
+                # numerics-neutral under training (prob-dropout goes to 0)
+                # — say so, so a changed-regularization run is diagnosable
+                # from the log
+                print(
+                    "[hydragnn_tpu.config] use_flash_attention auto-enabled:"
+                    f" jit target inferred as TPU from {source}; NOTE GPS"
+                    " attention-prob dropout runs at 0 under this flag"
+                    " (Architecture.dropout still drives the module-output"
+                    " dropout; set use_flash_attention: false for reference"
+                    " prob-dropout semantics)",
+                    file=sys.stderr,
+                )
+        else:
+            arch["use_flash_attention"] = False
+
     # CGCNN keeps hidden dim = input dim without global attention
     # (reference: config_utils.py:80-87)
     if arch["mpnn_type"] == "CGCNN" and not arch["global_attn_engine"]:

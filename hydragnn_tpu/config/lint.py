@@ -97,6 +97,8 @@ _HANDLED = {
     "NeuralNetwork.Architecture.use_sorted_aggregation",
     "NeuralNetwork.Architecture.max_in_degree",
     "NeuralNetwork.Architecture.use_fused_edge_kernel",
+    "NeuralNetwork.Architecture.use_flash_attention",
+    "NeuralNetwork.Architecture.dropout",
     "NeuralNetwork.Architecture.decoder_mirror_init",
     "NeuralNetwork.Architecture.decoder_recovery_slope",
     "NeuralNetwork.Variables_of_interest.input_node_features",

@@ -79,6 +79,13 @@ class ModelConfig:
     # attention use the per-graph dense [B, Nmax] layout instead of the
     # batch-wide [N, N] mask
     max_nodes_per_graph: int = 0
+    # segment-masked Pallas flash attention for GPS global attention
+    # (Architecture.use_flash_attention; auto-on for TPU jit targets in
+    # config completion): online-softmax tiling over the flat node array,
+    # cross-graph tiles never visited, logits never in HBM
+    # (ops/pallas_flash_attention.py). Consumed by the multihead and ring
+    # attention types; the dense layouts stay as the equivalence oracle.
+    use_flash_attention: bool = False
     dropout: float = 0.25
     # --- geometry / radial basis
     edge_dim: int = 0
@@ -253,6 +260,7 @@ class HydraModel(nn.Module):
                     dropout=cfg.dropout,
                     attn_type=cfg.global_attn_type or "multihead",
                     max_nodes_per_graph=cfg.max_nodes_per_graph,
+                    use_flash_attention=cfg.use_flash_attention,
                 )
             convs.append(mpnn)
         self.graph_convs = convs

@@ -106,6 +106,14 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         global_attn_heads=int(arch.get("global_attn_heads") or 0),
         pe_dim=int(arch.get("pe_dim") or 0),
         max_nodes_per_graph=int(arch.get("max_nodes_per_graph") or 0),
+        use_flash_attention=bool(arch.get("use_flash_attention", False)),
+        # `or 0.25` would turn an intentional 0.0 into the default; only
+        # null/absent falls back (the GPSConv/attention dropout rate —
+        # bench's GPS A/B cells pin it 0 so the attention route is the
+        # only moving part)
+        dropout=float(
+            0.25 if arch.get("dropout") is None else arch["dropout"]
+        ),
         edge_dim=int(arch.get("edge_dim") or 0),
         radius=arch.get("radius"),
         num_gaussians=arch.get("num_gaussians"),

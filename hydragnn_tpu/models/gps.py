@@ -42,12 +42,25 @@ class MultiheadSelfAttention(nn.Module):
     layout), so everything stays static-shaped under jit. Numerics match the
     flat-masked fallback exactly: every real node attends to exactly the real
     nodes of its own graph either way.
+
+    ``use_flash_attention`` (Architecture.use_flash_attention, auto-on for
+    TPU jit targets in config completion) routes the same math through the
+    segment-masked Pallas flash kernel (ops/pallas_flash_attention.py):
+    online-softmax tiling over the flat node array with a block-sparse
+    schedule — cross-graph tiles are never visited and the score matrix
+    never touches HBM. The dense layouts below stay as the equivalence
+    oracle (and the route wherever the kernel cannot engage:
+    ``HYDRAGNN_PALLAS_FLASH=0``, no static node bound, or an attention-prob
+    dropout request — the probabilities the dropout would mask never exist
+    on the flash path, so flash configs carry prob-dropout 0 on EVERY
+    backend; GPSConv's output dropout is unchanged).
     """
 
     channels: int
     heads: int
     dropout: float = 0.0
     max_nodes_per_graph: int = 0
+    use_flash_attention: bool = False
 
     @nn.compact
     def __call__(self, x, batch: GraphBatch, train: bool = False):
@@ -59,7 +72,41 @@ class MultiheadSelfAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         scale = jnp.sqrt(d).astype(x.dtype)
 
-        if self.max_nodes_per_graph > 0:
+        from ..ops.pallas_flash_attention import _flash_route_enabled
+
+        prob_dropout = self.dropout > 0 and train
+        if (
+            self.use_flash_attention
+            and self.max_nodes_per_graph > 0
+            and not prob_dropout
+            and _flash_route_enabled()
+        ):
+            from ..ops.pallas_flash_attention import flash_self_attention
+
+            N = x.shape[0]
+            Nmax = self.max_nodes_per_graph
+            interpret = jax.default_backend() != "tpu"
+
+            # jax.checkpoint keeps the tangent rule's residuals (per-graph
+            # probability blocks) out of the training forward: the forward
+            # stays VMEM-resident, the backward recomputes gathered-dense
+            def attend(qf, kf, vf):
+                return flash_self_attention(
+                    qf, kf, vf, batch.node_graph, batch.node_mask,
+                    batch.num_graphs, Nmax, interpret=interpret,
+                )
+
+            out = jax.checkpoint(attend)(
+                q.reshape(N, H, d), k.reshape(N, H, d), v.reshape(N, H, d)
+            ).reshape(N, C)
+            # same poison contract as the gathered layout below: a graph
+            # past the static bound under-covers its key window — surface
+            # as NaN loss, never as silently wrong numbers
+            overflow = jnp.any(
+                (batch.nodes_per_graph > Nmax) & batch.graph_mask
+            )
+            out = jnp.where(overflow, jnp.nan, out)
+        elif self.max_nodes_per_graph > 0:
             N = x.shape[0]
             G = batch.num_graphs
             Nmax = self.max_nodes_per_graph
@@ -124,10 +171,17 @@ class RingSelfAttention(nn.Module):
     densely (one device), so a checkpoint moves freely between modes.
     Restriction: attention spans every real node in the batch (no per-graph
     block mask) — the batch must hold a single real graph, the SP regime.
+
+    With ``use_flash_attention`` the per-chip block-attend inside the ring
+    runs the flash kernel's inner loop (ops/pallas_flash_attention.py
+    ``flash_block_summary``) instead of a dense einsum: the local
+    [n_q, n_k] score block stays in VMEM, and the online-softmax merge
+    across ring steps happens in plain jnp (parallel/ring_attention.py).
     """
 
     channels: int
     heads: int
+    use_flash_attention: bool = False
 
     @nn.compact
     def __call__(self, x, batch: GraphBatch, train: bool = False):
@@ -147,9 +201,10 @@ class RingSelfAttention(nn.Module):
 
             from ..parallel.ring_attention import ring_self_attention
 
+            use_flash = self.use_flash_attention
             out = shard_map(
                 lambda q_, k_, v_, m_: ring_self_attention(
-                    q_, k_, v_, m_, axis_name=axis
+                    q_, k_, v_, m_, axis_name=axis, use_flash=use_flash
                 ),
                 mesh=mesh,
                 in_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -213,6 +268,7 @@ class GPSConv(nn.Module):
     dropout: float = 0.0
     attn_type: str = "multihead"
     max_nodes_per_graph: int = 0
+    use_flash_attention: bool = False
 
     @nn.compact
     def __call__(self, inv, equiv, batch: GraphBatch, train: bool = False):
@@ -229,13 +285,23 @@ class GPSConv(nn.Module):
         if self.attn_type == "performer":
             h = PerformerSelfAttention(self.channels, self.heads)(inv, batch, train)
         elif self.attn_type == "ring":
-            h = RingSelfAttention(self.channels, self.heads)(inv, batch, train)
+            h = RingSelfAttention(
+                self.channels,
+                self.heads,
+                use_flash_attention=self.use_flash_attention,
+            )(inv, batch, train)
         elif self.attn_type == "multihead":
             h = MultiheadSelfAttention(
                 self.channels,
                 self.heads,
-                self.dropout,
+                # attention-PROB dropout is incompatible with the flash
+                # kernel (the probabilities never exist to mask); flash
+                # configs zero it on every backend so the Pallas route and
+                # the dense oracle train identically — the module-output
+                # dropout below regularizes either way
+                0.0 if self.use_flash_attention else self.dropout,
                 self.max_nodes_per_graph,
+                use_flash_attention=self.use_flash_attention,
             )(inv, batch, train)
         else:
             raise ValueError(f"attn_type {self.attn_type!r} not supported")

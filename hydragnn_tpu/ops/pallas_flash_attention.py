@@ -1,0 +1,450 @@
+"""Pallas TPU kernel: segment-masked flash attention for GPS global attention.
+
+GPS global attention (models/gps.py, reference hydragnn/globalAtt/gps.py:
+125-141) is block-diagonal over graphs: node i attends node j iff both are
+real and share a graph. The incumbent TPU paths materialize the score
+matrix in HBM — ``[G, H, Nmax, Nmax]`` for the per-graph gathered layout,
+``[H, N, N]`` for the flat masked fallback — and the masked fallback also
+*computes* every cross-graph pair just to throw it away.
+
+This kernel is FlashAttention-style online-softmax tiling (PAPERS.md: Dao
+et al.; Rabe & Staats) specialized to the sorted block-diagonal layout the
+batcher already produces (graphs contiguous along the flat node axis,
+data/graph.py):
+
+- grid ``(H, q_blocks, K)``: for query block ``j`` the K inner steps
+  stream only the key/value blocks its graphs can touch. The window is
+  scheduled like the sorted-segment kernels' ``estart`` scheme
+  (ops/pallas_segment.py): ``node_graph`` ascends along the flat layout,
+  so a searchsorted over it gives each q-block's first/last k-block as
+  scalar-prefetch arrays. Cross-graph tiles are never visited — the block
+  index map CLAMPS to the window's last block and ``pl.when`` skips the
+  recompute, so an out-of-window step is a zero-cost revisit of an
+  already-resident block, not a DMA;
+- per visited tile: ``s = q @ k.T`` on the MXU (f32 accumulation),
+  same-graph masking by an in-register compare of the streamed per-node
+  graph-id column/row (padding nodes carry id -1 and never match), and
+  the standard running-max/denominator update in f32 VMEM scratch. The
+  ``[*, N, N]`` logits never exist in HBM — only q/k/v tiles and the
+  final ``[N, H, d]`` output move;
+- inputs stream in their own dtype (bf16 halves the traffic under mixed
+  precision); probabilities are cast back to the streaming dtype for the
+  ``p @ v`` MXU dot, accumulation stays f32 (the same contract as
+  ops/pallas_segment.py).
+
+The kernel also emits the running (max, denominator) statistics, which is
+what makes the single-graph regime reusable: ``flash_block_summary``
+returns the UN-normalized online-softmax partial ``(m, l, acc)`` of local
+queries against one K/V block, and ``parallel/ring_attention.py`` merges
+those partials across ring steps in plain jnp — the per-chip block of
+ring attention rides the same inner loop instead of a dense einsum.
+
+Differentiation is the house custom-JVP: only the primal runs Pallas; the
+tangent rule is the plain-jnp per-graph gathered reference pushed through
+``jax.jvp`` (G·Nmax² work, not N²), so reverse mode transposes to the
+dense-recompute backward and the op composes under ``jax.grad`` to ANY
+order — energy-force (grad-of-grad) training works. Call sites wrap the
+op in ``jax.checkpoint`` (models/gps.py) so the tangent residuals (the
+per-graph probability blocks) are recomputed in the backward instead of
+stored by the forward: the training forward keeps the flash memory
+profile, the backward pays the gathered-dense recompute.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_segment import _pad_to
+
+# masking constant: large-negative instead of finfo.min so the f32
+# running-max arithmetic (exp of differences) never overflows; shared by
+# the kernel and the jnp references so their masked maxima agree exactly
+_NEG = -1.0e30
+
+
+def _flash_route_enabled() -> bool:
+    """Whether GPS attention routes to the Pallas flash kernel.
+
+    Same trace-time contract as ``ops.segment._pallas_route_enabled``:
+    ``HYDRAGNN_PALLAS_FLASH=0/1`` overrides; otherwise the default backend
+    decides. Off-TPU forcing runs the kernel in interpret mode (the CPU
+    dryrun / CI smoke route).
+    """
+    pref = os.getenv("HYDRAGNN_PALLAS_FLASH")
+    if pref is not None:
+        return pref == "1"
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# plain-jnp references: the flat-masked oracle (tests), the per-graph
+# gathered tangent rule, and the one-block summary (ring attention)
+# ---------------------------------------------------------------------------
+
+
+def reference_masked_attention(q, k, v, node_graph, node_mask):
+    """Flat ``[N, N]``-masked softmax attention — the dense oracle, stated
+    exactly like the ``max_nodes_per_graph == 0`` fallback in models/gps.py
+    (rows with no valid key are zeroed rather than left as softmax garbage,
+    matching the kernel's empty-row convention)."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    same = (node_graph[:, None] == node_graph[None, :]) & (
+        node_mask[:, None] & node_mask[None, :]
+    )
+    logits = jnp.einsum("ihd,jhd->hij", q, k) * scale
+    logits = jnp.where(same[None], logits, jnp.asarray(_NEG, logits.dtype))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("hij,jhd->ihd", probs, v)
+    has_key = jnp.any(same, axis=1)
+    return jnp.where(has_key[:, None, None], out, 0.0)
+
+
+def reference_gathered_attention(q, k, v, node_graph, node_mask, num_graphs,
+                                 max_nodes_per_graph):
+    """Per-graph gathered dense attention — the ``[G, Nmax]`` layout of
+    models/gps.py restated over ``[N, H, d]`` operands. Same function as
+    the masked oracle on real rows (graphs within the static bound); this
+    is the kernel's TANGENT rule: G·Nmax² work instead of N²."""
+    n, _, d = q.shape
+    nmax = max_nodes_per_graph
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    counts = jnp.zeros((num_graphs,), jnp.int32).at[node_graph].add(
+        node_mask.astype(jnp.int32)
+    )
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    slot = jnp.arange(nmax, dtype=jnp.int32)
+    valid = slot[None, :] < counts[:, None]
+    idx = jnp.where(valid, starts[:, None] + slot[None, :], n - 1)
+    qg, kg, vg = q[idx], k[idx], v[idx]  # [G, Nmax, H, d]
+    logits = jnp.einsum("gihd,gjhd->ghij", qg, kg) * scale
+    logits = jnp.where(
+        valid[:, None, None, :], logits, jnp.asarray(_NEG, logits.dtype)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)
+    og = jnp.einsum("ghij,gjhd->gihd", probs, vg)
+    out = jnp.zeros_like(q).at[idx.reshape(-1)].add(
+        og.reshape(idx.size, *q.shape[1:])
+        * valid.reshape(-1, 1, 1).astype(q.dtype)
+    )
+    return out
+
+
+def reference_block_summary(q, k, v, key_mask):
+    """One online-softmax partial of all queries against ONE key/value
+    block, in plain jnp: ``m = rowmax``, ``l = sum exp(s - m)``,
+    ``acc = exp(s - m) @ v`` — the quantity ring attention merges across
+    steps. Fully-masked rows return ``(_NEG, 0, 0)``."""
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32)).astype(q.dtype)
+    logits = jnp.einsum("qhd,khd->qhk", q, k) * scale
+    logits = jnp.where(
+        key_mask[None, None, :], logits, jnp.asarray(_NEG, logits.dtype)
+    )
+    m = jnp.max(logits, axis=-1)  # [n_q, H]
+    p = jnp.where(
+        key_mask[None, None, :], jnp.exp(logits - m[..., None]), 0.0
+    )
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("qhk,khd->qhd", p, v)
+    return m, l, acc
+
+
+# ---------------------------------------------------------------------------
+# the kernel
+# ---------------------------------------------------------------------------
+
+
+def _kernel(kstart_ref, klast_ref, gidq_ref, gidk_ref, q_ref, k_ref, v_ref,
+            *refs, scale, emit_stats):
+    # stats outputs exist only for the block-summary (ring) launch: the
+    # self-attention launch would have to WRITE two [H, N, 128] f32 arrays
+    # to HBM just to discard them (pallas outputs cannot be DCE'd)
+    if emit_stats:
+        o_ref, m_ref, l_ref, m_scr, l_scr, acc_scr = refs
+    else:
+        o_ref, m_scr, l_scr, acc_scr = refs
+    j = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # out-of-window steps clamp their block index to the window's last
+    # block (no DMA — the block is already resident) and skip the update
+    @pl.when(kstart_ref[j] + kk <= klast_ref[j])
+    def _step():
+        q = q_ref[0]  # [Bq, d_pad]
+        s = jax.lax.dot_general(
+            q,
+            k_ref[0],
+            (((1,), (1,)), ((), ())),  # contract the head dim: q @ k.T
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Bq, Bk] f32
+        # same-graph mask from the streamed graph-id column/row; padding
+        # nodes carry id -1 on the KEY side and never match
+        mask = (gidq_ref[:] == gidk_ref[:]) & (gidk_ref[:] >= 0)
+        s = jnp.where(mask, s, _NEG)
+        m_prev = m_scr[:, 0:1]
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        # fully-masked tiles keep m_new == m_prev == _NEG: exp(0) == 1 on
+        # the correction, so the explicit where() is what zeroes them
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_scr[:] = jnp.broadcast_to(
+            l_prev * corr + jnp.sum(p, axis=1, keepdims=True), l_scr.shape
+        )
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype),  # bf16 streams hit the MXU fast path
+            v_ref[0],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(kk == pl.num_programs(2) - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        # rows with no valid key (padding queries): l == 0, acc == 0 -> 0
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        if emit_stats:
+            m_ref[0] = m_scr[:]
+            l_ref[0] = l_scr[:]
+
+
+def _forward(q, k, v, gid_q, gid_k, kstart, klast, k_windows,
+             block_q, block_k, interpret, emit_stats=False):
+    """Shared launch: q ``[Nq, H, d]`` against k/v ``[Nk, H, d]`` with
+    per-q-block key-window schedule (kstart/klast in k-block units) and
+    per-node graph ids (-1 = never a valid key). Returns the normalized
+    ``o [Nq, H, d]`` (operand dtype); with ``emit_stats`` also the f32
+    running statistics ``(m [Nq, H], l [Nq, H])`` as extra HBM outputs —
+    only the block-summary launch pays for them."""
+    nq, h, d = q.shape
+    nk = k.shape[0]
+    bq, bk = block_q, block_k
+    d_pad = d + (-d) % 128
+    scale = 1.0 / float(d) ** 0.5
+
+    def _prep(x, blk):
+        x = _pad_to(_pad_to(x, blk, 0), 128, 2)
+        return jnp.transpose(x, (1, 0, 2))  # [H, N_pad, d_pad]
+
+    qt = _prep(q, bq)
+    kt = _prep(k, bk)
+    vt = _prep(v, bk)
+    nq_pad, nk_pad = qt.shape[1], kt.shape[1]
+    j_blocks = nq_pad // bq
+    k_blocks = nk_pad // bk
+    k_windows = max(1, min(k_windows, k_blocks))
+
+    gq = jnp.full((nq_pad, 1), -1, jnp.int32).at[:nq, 0].set(
+        gid_q.astype(jnp.int32)
+    )
+    gk = jnp.full((1, nk_pad), -1, jnp.int32).at[0, :nk].set(
+        gid_k.astype(jnp.int32)
+    )
+    kstart = jnp.clip(kstart.astype(jnp.int32), 0, k_blocks - 1)
+    klast = jnp.clip(klast.astype(jnp.int32), 0, k_blocks - 1)
+
+    def q_index(h_i, j, kk, ks, kl):
+        return (h_i, j, 0)
+
+    def kv_index(h_i, j, kk, ks, kl):
+        return (h_i, jnp.minimum(ks[j] + kk, kl[j]), 0)
+
+    def gidq_index(h_i, j, kk, ks, kl):
+        return (j, 0)
+
+    def gidk_index(h_i, j, kk, ks, kl):
+        return (0, jnp.minimum(ks[j] + kk, kl[j]))
+
+    def out_index(h_i, j, kk, ks, kl):
+        return (h_i, j, 0)
+
+    grid = (h, j_blocks, k_windows)
+    out_specs = [pl.BlockSpec((1, bq, d_pad), out_index)]
+    out_shape = [jax.ShapeDtypeStruct((h, nq_pad, d_pad), q.dtype)]
+    if emit_stats:
+        out_specs += [pl.BlockSpec((1, bq, 128), out_index)] * 2
+        out_shape += [jax.ShapeDtypeStruct((h, nq_pad, 128), jnp.float32)] * 2
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=scale, emit_stats=emit_stats),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bq, 1), gidq_index),
+                pl.BlockSpec((1, bk), gidk_index),
+                pl.BlockSpec((1, bq, d_pad), q_index),
+                pl.BlockSpec((1, bk, d_pad), kv_index),
+                pl.BlockSpec((1, bk, d_pad), kv_index),
+            ],
+            out_specs=out_specs,
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, d_pad), jnp.float32),
+            ],
+        ),
+        out_shape=out_shape,
+        interpret=interpret,
+    )(kstart, klast, gq, gk, qt, kt, vt)
+    o = jnp.transpose(out[0], (1, 0, 2))[:nq, :, :d]
+    if not emit_stats:
+        return o
+    m = jnp.transpose(out[1][:, :, 0])[:nq]  # [Nq, H]
+    l = jnp.transpose(out[2][:, :, 0])[:nq]
+    return o, m, l
+
+
+def _block_windows(node_graph, n, block_q, block_k, max_nodes_per_graph):
+    """Per-q-block key-window schedule over the flat node layout.
+
+    ``node_graph`` ascends (graphs contiguous, padding nodes in the final
+    slot — data/graph.py), so the window of q-block ``j`` spans from the
+    first node of the graph owning its first row to the last node of the
+    graph owning its last row. The static inner-step count covers the
+    worst legal window: a q block can touch at most
+    ``block_q + 2·(Nmax - 1)`` nodes.
+    """
+    ng = node_graph.astype(jnp.int32)
+    j_blocks = (n + block_q - 1) // block_q
+    row0 = jnp.minimum(
+        jnp.arange(j_blocks, dtype=jnp.int32) * block_q, n - 1
+    )
+    row1 = jnp.minimum(row0 + block_q - 1, n - 1)
+    first = jnp.searchsorted(ng, ng[row0], side="left").astype(jnp.int32)
+    last = jnp.searchsorted(ng, ng[row1], side="right").astype(jnp.int32) - 1
+    k_windows = (block_q + 2 * max(max_nodes_per_graph - 1, 0)
+                 + block_k - 1) // block_k + 1
+    return first // block_k, last // block_k, k_windows
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(5, 6, 7, 8, 9))
+def flash_self_attention(
+    q,
+    k,
+    v,
+    node_graph,
+    node_mask,
+    num_graphs: int,
+    max_nodes_per_graph: int,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Segment-masked flash self-attention over the flat node array.
+
+    ``q``/``k``/``v``: ``[N, H, d]``; attention is restricted to same-graph
+    real-node pairs (``node_graph``/``node_mask``), exactly the semantics
+    of both dense paths in models/gps.py. Requires the batcher's layout:
+    graphs CONTIGUOUS along the node axis (``node_graph`` non-decreasing,
+    padding nodes in the final slot) — the block schedule derives from it.
+    A real graph larger than the static ``max_nodes_per_graph`` bound gets
+    an UNSPECIFIED value (its key window is under-covered); the model
+    layer poisons that case to NaN, same as the gathered-dense path.
+    Padding rows come out 0 (the dense oracle leaves softmax garbage
+    there; both are masked downstream).
+
+    ``block_q`` must be a multiple of the sublane tile (16 covers bf16),
+    ``block_k`` of the 128-lane tile. Returns ``[N, H, d]`` in the operand
+    dtype; logits/softmax accumulate in f32 and never touch HBM.
+    Differentiable to arbitrary order (custom-JVP whose tangent is the
+    plain-jnp gathered-dense reference), so energy-force training
+    composes; wrap call sites in ``jax.checkpoint`` to keep the tangent
+    residuals out of the training forward.
+    """
+    n = q.shape[0]
+    gid = jnp.where(node_mask, node_graph.astype(jnp.int32), -1)
+    kstart, klast, k_windows = _block_windows(
+        node_graph, n, block_q, block_k, max_nodes_per_graph
+    )
+    return _forward(
+        q, k, v, gid, gid, kstart, klast, k_windows, block_q, block_k,
+        interpret,
+    )
+
+
+@flash_self_attention.defjvp
+def _flash_jvp(num_graphs, max_nodes_per_graph, block_q, block_k, interpret,
+               primals, tangents):
+    q, k, v, node_graph, node_mask = primals
+    t_q, t_k, t_v, _, _ = tangents
+    out = flash_self_attention(
+        q, k, v, node_graph, node_mask, num_graphs, max_nodes_per_graph,
+        block_q, block_k, interpret,
+    )
+    # tangent in PLAIN jnp — the per-graph gathered reference (G·Nmax²,
+    # not N²) pushed through jax.jvp: linear in the tangents, built from
+    # transposable primitives, differentiable to any order. Reverse mode
+    # transposes it into the dense-recompute backward; jax.checkpoint at
+    # the call site pushes its residuals (the per-graph probability
+    # blocks) into the backward pass.
+    fn = lambda q_, k_, v_: reference_gathered_attention(
+        q_, k_, v_, node_graph, node_mask, num_graphs, max_nodes_per_graph
+    )
+    _, t_out = jax.jvp(fn, (q, k, v), (t_q, t_k, t_v))
+    return out, t_out
+
+
+@functools.partial(jax.custom_jvp, nondiff_argnums=(4, 5, 6))
+def flash_block_summary(
+    q,
+    k,
+    v,
+    key_mask,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Online-softmax partial of local queries against ONE key/value block
+    — the single-graph regime of the flash kernel, reusing its inner loop.
+
+    ``q [n_q, H, d]`` against ``k/v [n_k, H, d]`` with ``key_mask [n_k]``;
+    returns ``(m [n_q, H], l [n_q, H], acc [n_q, H, d])`` such that the
+    normalized attention over several blocks is the standard running-max
+    merge of their partials (parallel/ring_attention.py does the merging
+    in plain jnp between ``ppermute`` rotations). Fully-masked rows give
+    ``(-1e30, 0, 0)``. Statistics are f32 inside the kernel and cast to
+    the operand dtype on return (the ring carries match the dense route's
+    dtypes either way).
+    """
+    nq, nk = q.shape[0], k.shape[0]
+    gid_q = jnp.zeros((nq,), jnp.int32)
+    gid_k = jnp.where(key_mask, 0, -1).astype(jnp.int32)
+    k_blocks = (nk + block_k - 1) // block_k
+    kstart = jnp.zeros((max(1, (nq + block_q - 1) // block_q),), jnp.int32)
+    klast = jnp.full_like(kstart, k_blocks - 1)
+    o, m, l = _forward(
+        q, k, v, gid_q, gid_k, kstart, klast, k_blocks, block_q, block_k,
+        interpret, emit_stats=True,
+    )
+    dt = q.dtype
+    # un-normalize: acc = o * l (exact where l > 0; both zero where l == 0)
+    return m.astype(dt), l.astype(dt), o * l[..., None].astype(dt)
+
+
+@flash_block_summary.defjvp
+def _summary_jvp(block_q, block_k, interpret, primals, tangents):
+    q, k, v, key_mask = primals
+    t_q, t_k, t_v, _ = tangents
+    out = flash_block_summary(q, k, v, key_mask, block_q, block_k, interpret)
+    fn = lambda q_, k_, v_: jax.tree_util.tree_map(
+        lambda x: x.astype(q.dtype),
+        reference_block_summary(q_, k_, v_, key_mask),
+    )
+    _, t_out = jax.jvp(fn, (q, k, v), (t_q, t_k, t_v))
+    return out, t_out

@@ -37,12 +37,30 @@ import jax
 import jax.numpy as jnp
 
 
-def _block_attend(q, k, v, kmask, m, denom, acc, scale):
+def _block_attend(q, k, v, kmask, m, denom, acc, scale, use_flash=False):
     """One online-softmax accumulation step against a K/V block.
 
     q: [n_q, H, dh]; k/v: [n_k, H, dh]; kmask: [n_k] bool;
     m/denom: [n_q, H]; acc: [n_q, H, dh].
+
+    ``use_flash``: compute the block's (max, denom, acc) partial with the
+    segment-masked flash kernel's inner loop
+    (ops/pallas_flash_attention.py ``flash_block_summary`` — the local
+    score block stays in VMEM) and merge it here in plain jnp; the dense
+    einsum below is the identical math and the off-TPU route.
     """
+    if use_flash:
+        from ..ops.pallas_flash_attention import flash_block_summary
+
+        m_b, l_b, acc_b = flash_block_summary(
+            q, k, v, kmask, interpret=jax.default_backend() != "tpu"
+        )
+        new_m = jnp.maximum(m, m_b)
+        corr = jnp.exp(m - new_m)
+        corr_b = jnp.exp(m_b - new_m)
+        denom = denom * corr + l_b * corr_b
+        acc = acc * corr[..., None] + acc_b * corr_b[..., None]
+        return new_m.astype(m.dtype), denom, acc
     # [n_q, H, n_k]
     logits = jnp.einsum("qhd,khd->qhk", q, k) * scale
     neg = jnp.finfo(logits.dtype).min
@@ -65,6 +83,7 @@ def ring_self_attention(
     v: jnp.ndarray,
     key_mask: Optional[jnp.ndarray],
     axis_name: str,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Exact multi-head self-attention with the key/value blocks ring-rotated
     around ``axis_name``. Must run inside ``shard_map``/``pmap`` over that
@@ -73,8 +92,14 @@ def ring_self_attention(
     Shapes (per device): q/k/v ``[n_local, H, dh]``; ``key_mask``
     ``[n_local]`` bool marking real (non-padding) keys, or None.
     Returns ``[n_local, H, dh]`` — each local query attended over the
-    GLOBAL key set.
+    GLOBAL key set. ``use_flash`` routes each per-chip block-attend through
+    the Pallas flash inner loop when the route is enabled
+    (ops/pallas_flash_attention.py ``_flash_route_enabled``); the math is
+    identical, the local score block just never leaves VMEM.
     """
+    from ..ops.pallas_flash_attention import _flash_route_enabled
+
+    use_flash = use_flash and _flash_route_enabled()
     n_dev = jax.lax.psum(1, axis_name)
     scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
     if key_mask is None:
@@ -93,7 +118,9 @@ def ring_self_attention(
 
     def step(carry, _):
         k_blk, v_blk, kmask, m, denom, acc = carry
-        m, denom, acc = _block_attend(q, k_blk, v_blk, kmask, m, denom, acc, scale)
+        m, denom, acc = _block_attend(
+            q, k_blk, v_blk, kmask, m, denom, acc, scale, use_flash
+        )
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         kmask = jax.lax.ppermute(kmask, axis_name, perm)
@@ -106,11 +133,14 @@ def ring_self_attention(
         (k, v, key_mask, m, denom, acc), _ = jax.lax.scan(
             step, (k, v, key_mask, m, denom, acc), None, length=n_dev - 1
         )
-    m, denom, acc = _block_attend(q, k, v, key_mask, m, denom, acc, scale)
+    m, denom, acc = _block_attend(
+        q, k, v, key_mask, m, denom, acc, scale, use_flash
+    )
     return acc / jnp.maximum(denom, 1e-30)[..., None]
 
 
-def sharded_global_attention(mesh, axis_name: str = "data"):
+def sharded_global_attention(mesh, axis_name: str = "data",
+                             use_flash: bool = False):
     """A jitted callable computing exact global self-attention over arrays
     whose leading (node) axis is sharded on ``axis_name`` of ``mesh``:
     (q, k, v, key_mask) -> out, all ``[N_global, H, dh]`` sharded the same
@@ -120,9 +150,14 @@ def sharded_global_attention(mesh, axis_name: str = "data"):
     from jax.sharding import PartitionSpec as P
 
     fn = shard_map(
-        lambda q, k, v, mask: ring_self_attention(q, k, v, mask, axis_name),
+        lambda q, k, v, mask: ring_self_attention(
+            q, k, v, mask, axis_name, use_flash=use_flash
+        ),
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name)),
         out_specs=P(axis_name),
+        # pallas_call has no replication rule (same reason the GPS module's
+        # shard_map disables the check, models/gps.py)
+        check_vma=False,
     )
     return jax.jit(fn)

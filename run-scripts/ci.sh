@@ -31,6 +31,9 @@ python -m pytest tests/ -x -q --deselect tests/test_multihost.py "$@"
 echo "== 2-process distributed tier =="
 python -m pytest tests/test_multihost.py -x -q
 
+echo "== BENCH_GPS smoke (bench GPS cells build + train on CPU; flash==dense) =="
+BENCH_GPS_SMOKE=1 python bench.py
+
 echo "== multichip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -274,6 +274,22 @@ def pytest_example_multidataset_gfm(tmp_path):
     assert "energy MAE" in out and "force MAE" in out
 
 
+@pytest.mark.slow  # full train+predict subprocess; runs in the CI suite
+def pytest_example_multidataset_zero(tmp_path):
+    """Multibranch GFM under ZeRO-3/FSDP (the multidataset_deepspeed
+    analog): trains, predicts, and proves params/moments stayed sharded
+    between steps on the 8-device mesh."""
+    out = _run_example(
+        "examples/multidataset_zero/train.py", "--num_per_dataset", "16",
+        "--num_epoch", "2", cwd=str(tmp_path), timeout=600,
+    )
+    assert "energy MAE" in out and "force MAE" in out
+    # ": 0 sharded" matches ONLY a zero count ("zero_stage=3: 14 sharded
+    # param leaves" must pass; a bare "0 sharded" substring would false-
+    # match counts ending in 0)
+    assert "zero_stage=3" in out and ": 0 sharded param leaves" not in out
+
+
 def pytest_example_alexandria_periodic(tmp_path):
     out = _run_example(
         "examples/alexandria/train.py", "--num_samples", "24",
