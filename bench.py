@@ -438,10 +438,17 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
     variables = init_model(model, batches[0], seed=0)
     tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
     state = TrainState.create(variables, tx)
+    # non-finite step guard A/B (BENCH_GUARD cells): resolved from the
+    # cell's env_overrides explicitly because those are restored right
+    # after the workload build, before the step traces
+    step_guard = (env_overrides or {}).get(
+        "HYDRAGNN_STEP_GUARD", os.environ.get("HYDRAGNN_STEP_GUARD", "1")
+    ) == "1"
     step = make_train_step(
         model,
         tx,
         mixed_precision=config["NeuralNetwork"]["Training"]["mixed_precision"],
+        guard=step_guard,
     )
     rng = jax.random.PRNGKey(0)
 
@@ -520,6 +527,7 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
             and int(arch_done.get("max_in_degree") or 0) > 0
         ),
         "equivariance": bool(arch_done.get("equivariance", False)),
+        "step_guard": step_guard,
         # the attention route that can actually engage: flash needs GPS +
         # the static per-graph node bound (models/gps.py routing)
         "flash_attention": bool(
@@ -678,6 +686,19 @@ def main_ab():
         {"mp": True, "sorted": False, "model": "MACE", "tag": "mace"},
         {"mp": True, "sorted": False, "model": "DimeNet", "tag": "dimenet"},
     ]
+    if os.getenv("BENCH_GUARD", "0") == "1":
+        # non-finite step guard A/B (the r7 fault-tolerance tentpole):
+        # bound the guard's cost (one global-norm pass + a lax.cond) on the
+        # production EGNN shape. Pinned for the next hardware round; the
+        # CPU-side loss-equality proof is BENCH_GUARD_SMOKE (ci.sh).
+        cells += [
+            {"mp": True, "sorted": False, "tag": "guard_on",
+             "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0",
+                     "HYDRAGNN_STEP_GUARD": "1"}},
+            {"mp": True, "sorted": False, "tag": "guard_off",
+             "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0",
+                     "HYDRAGNN_STEP_GUARD": "0"}},
+        ]
     if os.getenv("BENCH_GPS", "0") == "1":
         # GPS attention A/B (the r6 tentpole): flash vs the incumbent
         # gathered-dense multihead, plus the performer linear variant —
@@ -747,6 +768,7 @@ def main_ab():
                 "sorted_aggregation": sorted_agg,
                 "fused_edge": prod["fused_edge"],
                 "equivariance": prod["equivariance"],
+                "step_guard": prod["step_guard"],
                 "flash_attention": prod["flash_attention"],
                 **({"global_attn_type": prod["global_attn_type"]}
                    if prod["global_attn_type"] else {}),
@@ -849,9 +871,75 @@ def smoke_gps():
     }))
 
 
+def smoke_guard():
+    """BENCH_GUARD_SMOKE=1: CPU-runnable proof for the BENCH_GUARD A/B —
+    the guarded step is numerically IDENTICAL to the unguarded step on
+    finite batches (f32 and bf16; acceptance for the r7 tentpole), plus a
+    small timed A/B so the cell shape cannot rot between hardware rounds
+    (run-scripts/ci.sh invokes it; the banked on-chip numbers come from
+    BENCH_AB=1 BENCH_GUARD=1 next hardware round)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    os.environ.setdefault("BENCH_BATCH_SIZE", "4")
+    os.environ.setdefault("BENCH_HIDDEN", "32")
+    os.environ.setdefault("BENCH_HEAD_DIM", "32")
+    os.environ.setdefault("BENCH_NUM_CONFIGS", "16")
+    os.environ.setdefault("BENCH_PACK", "0")
+    out = {}
+    for mp in (False, True):
+        config, loader = _production_workload(
+            mixed_precision=mp, sorted_aggregation=False
+        )
+        batch = next(iter(loader))
+        model = create_model(config)
+        variables = init_model(model, batch, seed=0)
+        tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+        losses, times = {}, {}
+        for guard in (True, False):
+            state = TrainState.create(
+                jax.tree_util.tree_map(
+                    lambda x: jnp.array(x, copy=True), variables
+                ),
+                tx,
+            )
+            step = make_train_step(model, tx, mixed_precision=mp, guard=guard)
+            ls = []
+            for i in range(3):  # compile + step into updated params
+                state, tot, _ = step(state, batch, jax.random.PRNGKey(i))
+                ls.append(float(tot))
+            t0 = time.perf_counter()
+            for i in range(5):
+                state, tot, _ = step(state, batch, jax.random.PRNGKey(10 + i))
+            jax.block_until_ready(tot)
+            times[guard] = (time.perf_counter() - t0) / 5
+            losses[guard] = ls
+            assert all(np.isfinite(l) for l in ls), (guard, ls)
+        # identical, not close: the guard's taken branch IS the unguarded
+        # update arithmetic
+        assert losses[True] == losses[False], (mp, losses)
+        out["bf16" if mp else "f32"] = {
+            "losses_equal": True,
+            "guarded_step_secs": round(times[True], 6),
+            "unguarded_step_secs": round(times[False], 6),
+        }
+    print(json.dumps({
+        "metric": "BENCH_GUARD smoke (CPU, guarded==unguarded)",
+        **out,
+        "ok": True,
+    }))
+
+
 def main():
     if os.getenv("BENCH_GPS_SMOKE", "0") == "1":
         smoke_gps()
+        return
+    if os.getenv("BENCH_GUARD_SMOKE", "0") == "1":
+        smoke_guard()
         return
     if os.getenv("BENCH_AB", "0") == "1":
         main_ab()

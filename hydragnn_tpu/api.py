@@ -422,6 +422,13 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         startfrom = training.get("startfrom") or log_name
         state = load_existing_model(state, startfrom)
 
+    # every device-placement transform applied to the state below is also
+    # recorded here, so the rollback restore path (non_finite_policy:
+    # rollback) can replay the SAME placement on a freshly deserialized
+    # host-array state — a restored state must be indistinguishable from a
+    # resumed one (train/loop.py restore_fn)
+    placement_fns: List[Any] = []
+
     # ZeRO-1 analog (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
     # hydragnn/utils/optimizer/optimizer.py:43-113): shard the large optimizer
     # moments over the data axis of the (global) device mesh; params stay
@@ -447,10 +454,15 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         from .parallel import make_mesh, replicate_state, shard_optimizer_state
 
         mesh = make_mesh()
-        state = replicate_state(state, mesh)
-        state = state.replace(
-            opt_state=shard_optimizer_state(state.opt_state, mesh)
-        )
+
+        def _place_zero1(st, _mesh=mesh):
+            st = replicate_state(st, _mesh)
+            return st.replace(
+                opt_state=shard_optimizer_state(st.opt_state, _mesh)
+            )
+
+        placement_fns.append(_place_zero1)
+        state = _place_zero1(state)
 
     # mesh-step mode: multi-host DP (shard_map over the global (branch,
     # data) mesh, grads psum over ICI/DCN) and/or branch-parallel decoders —
@@ -495,28 +507,39 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             )
 
             mesh = make_mesh(branch_size=model.cfg.num_branches)
-            state = place_branch_state(state, tx, mesh)
+
+            def _place_branch(st, _mesh=mesh):
+                return place_branch_state(st, tx, _mesh)
+
+            placement_fns.append(_place_branch)
+            state = _place_branch(state)
             _pstep = make_branch_parallel_train_step(model, tx, mesh, cge, mp)
             _peval = make_branch_parallel_eval_step(model, mesh, cge, mp)
         else:
             mesh = make_mesh()
-            state = replicate_state(state, mesh)
-            if use_zero:
-                # ZeRO-1 on the multi-host mesh: moment leaves sharded
-                # P(data) AFTER the replicate (which would otherwise
-                # clobber them)
-                state = state.replace(
-                    opt_state=shard_optimizer_state(state.opt_state, mesh)
-                )
-            if zero_stage >= 3:
-                # ZeRO-3/FSDP: params stored sharded between steps, full
-                # copies transient inside each step (parallel/mesh.py
-                # shard_params_zero3)
-                from .parallel import shard_params_zero3
 
-                state = state.replace(
-                    params=shard_params_zero3(state.params, mesh)
-                )
+            def _place_mesh(st, _mesh=mesh):
+                st = replicate_state(st, _mesh)
+                if use_zero:
+                    # ZeRO-1 on the multi-host mesh: moment leaves sharded
+                    # P(data) AFTER the replicate (which would otherwise
+                    # clobber them)
+                    st = st.replace(
+                        opt_state=shard_optimizer_state(st.opt_state, _mesh)
+                    )
+                if zero_stage >= 3:
+                    # ZeRO-3/FSDP: params stored sharded between steps, full
+                    # copies transient inside each step (parallel/mesh.py
+                    # shard_params_zero3)
+                    from .parallel import shard_params_zero3
+
+                    st = st.replace(
+                        params=shard_params_zero3(st.params, _mesh)
+                    )
+                return st
+
+            placement_fns.append(_place_mesh)
+            state = _place_mesh(state)
             _pstep = make_parallel_train_step(
                 model, tx, mesh, cge, mp,
                 zero2=zero_stage >= 2, zero3=zero_stage >= 3,
@@ -535,12 +558,28 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         )
         writer.add_scalar("lr", scalars.get("lr", 0.0), epoch)
 
+    retention = int(training.get("checkpoint_retention", 0) or 0)
     if training.get("checkpoint_backend", "msgpack") == "orbax":
         from .train.checkpoint import save_model_orbax
 
-        save_fn = lambda s, e=None: save_model_orbax(s, log_name, epoch=e)
+        save_fn = lambda s, e=None: save_model_orbax(
+            s, log_name, epoch=e, retention=retention
+        )
     else:
-        save_fn = lambda s, e=None: save_model(s, log_name, epoch=e)
+        save_fn = lambda s, e=None: save_model(
+            s, log_name, epoch=e, retention=retention
+        )
+
+    def restore_fn(template):
+        # rollback path (Training.non_finite_policy: rollback): restore the
+        # last VERIFIED checkpoint of THIS run (digest-checked, walking back
+        # on corruption — train/checkpoint.py), then replay the recorded
+        # device placement so the restored state matches the step's contract
+        st = load_existing_model(template, log_name)
+        for place in placement_fns:
+            st = place(st)
+        return st
+
     try:
         with Timer("train_validate_test"):
             state, hist = train_validate_test(
@@ -558,6 +597,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 log_fn=log_fn,
                 step_fn=step_fn,
                 eval_fn=eval_fn,
+                restore_fn=restore_fn,
             )
     finally:
         writer.close()

@@ -383,6 +383,31 @@ def update_config(
     training.setdefault("EarlyStopping", False)
     training.setdefault("Checkpoint", False)
     training.setdefault("checkpoint_warmup", 0)
+    # ---- fault tolerance (docs/ROBUSTNESS.md): the in-graph non-finite
+    # step guard's policy + the verified-checkpoint retention chain
+    training.setdefault("non_finite_policy", "warn_skip")
+    if training["non_finite_policy"] not in ("error", "warn_skip", "rollback"):
+        raise ValueError(
+            f"Training.non_finite_policy {training['non_finite_policy']!r} "
+            "must be 'error', 'warn_skip' or 'rollback'"
+        )
+    training.setdefault("non_finite_rollback_after", 3)
+    training.setdefault("non_finite_lr_backoff", 0.5)
+    training.setdefault("non_finite_max_rollbacks", 3)
+    # 0 = keep every per-epoch checkpoint (historical behavior); N > 0
+    # prunes to the newest N, bounding disk and the corruption-fallback walk
+    training.setdefault("checkpoint_retention", 0)
+    if training["non_finite_policy"] == "rollback" and not training["Checkpoint"]:
+        # rollback restores the last verified checkpoint — without best-val
+        # checkpointing only the preemption/end-of-run saves exist, so the
+        # first rollback of a fresh run would find nothing to restore
+        print(
+            "[hydragnn_tpu.config] non_finite_policy=rollback without "
+            "Training.Checkpoint: enable checkpointing or the first "
+            "rollback of a fresh run will fail with no checkpoint to "
+            "restore",
+            file=sys.stderr,
+        )
     training.setdefault("Optimizer", {"type": "AdamW", "learning_rate": 1e-3})
     training["Optimizer"].setdefault("type", "AdamW")
     training["Optimizer"].setdefault("learning_rate", 1e-3)

@@ -122,6 +122,12 @@ _HANDLED = {
     "NeuralNetwork.Training.startfrom",
     "NeuralNetwork.Training.Checkpoint",
     "NeuralNetwork.Training.checkpoint_warmup",
+    "NeuralNetwork.Training.checkpoint_backend",
+    "NeuralNetwork.Training.checkpoint_retention",
+    "NeuralNetwork.Training.non_finite_policy",
+    "NeuralNetwork.Training.non_finite_rollback_after",
+    "NeuralNetwork.Training.non_finite_lr_backoff",
+    "NeuralNetwork.Training.non_finite_max_rollbacks",
     "NeuralNetwork.Training.compute_grad_energy",
     "NeuralNetwork.Training.conv_checkpointing",
     "NeuralNetwork.Training.Optimizer",

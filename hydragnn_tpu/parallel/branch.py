@@ -136,6 +136,7 @@ def make_branch_parallel_train_step(
     mesh: Mesh,
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
+    guard=None,
 ):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks): DP over
     ``data`` x decoder-sharded ``branch``. The stacked batch must be
@@ -149,6 +150,12 @@ def make_branch_parallel_train_step(
     b_local = cfg.num_branches // bsize
     local = _local_model(model, b_local)
     lcfg = local.cfg
+    # resolve at BUILD time like the other step builders (dp.py, loop.py):
+    # the env default must freeze when the step is constructed, not when it
+    # first traces, and guard=True/False gives programmatic A/B control
+    from ..train.guard import guard_enabled
+
+    use_guard = guard_enabled(guard)
 
     def per_device_loss(params, batch_stats, batch, rng):
         if mixed_precision:
@@ -258,10 +265,30 @@ def make_branch_parallel_train_step(
         grads, tot, tasks, new_stats = grad_map(
             state.params, state.batch_stats, batch, rng
         )
+        # chaos-test hook + non-finite step guard (train/guard.py): the
+        # decision rides the reduced loss/grads, so every device agrees
+        from ..train.guard import guarded_update, step_ok
+        from ..utils import faultinject
+
+        grads = faultinject.poison_grads(
+            grads, state.step, faultinject.lr_of(state.opt_state)
+        )
+
         # optimizer update under the outer jit: decoder grads/moments stay
         # branch-sharded by propagation, encoder leaves replicated
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
+        def do_update():
+            updates, opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            return optax.apply_updates(state.params, updates), opt_state
+
+        if use_guard:
+            return (
+                guarded_update(state, step_ok(tot, grads), do_update, new_stats),
+                tot,
+                tasks,
+            )
+        params, opt_state = do_update()
         return (
             state.replace(
                 params=params,

@@ -53,6 +53,7 @@ def make_parallel_train_step(
     zero2: bool = False,
     zero2_min_size: int = 1024,
     zero3: bool = False,
+    guard=None,
 ):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh.
 
@@ -63,8 +64,14 @@ def make_parallel_train_step(
     (sharded grads + moments, replicated params). ``zero3=True`` (with
     ``shard_params_zero3`` applied to the state) additionally keeps the
     UPDATED params sharded ``P(data)`` at step output — the FSDP profile:
-    full params exist only transiently inside the step."""
+    full params exist only transiently inside the step. ``guard`` (default
+    on): non-finite step guard, computed on the pmean'd loss/gradients so
+    every device and host takes the same branch (train/guard.py)."""
     cfg = model.cfg
+    from ..train.guard import guard_enabled, guarded_update, step_ok
+    from ..utils import faultinject
+
+    use_guard = guard_enabled(guard)
 
     def per_device_loss(params, batch_stats, batch, rng):
         if mixed_precision:
@@ -121,6 +128,13 @@ def make_parallel_train_step(
         grads, tot, tasks, new_stats = grad_map(
             state.params, state.batch_stats, batch, rng
         )
+        # chaos-test hook: exact no-op unless a fault is armed (trace-time).
+        # AFTER the pmean, so the poison (like the real failure it models)
+        # is identical on every device and the guard decision agrees.
+        grads = faultinject.poison_grads(
+            grads, state.step, faultinject.lr_of(state.opt_state)
+        )
+
         # The optimizer update runs OUTSIDE the shard_map, under the outer
         # jit: with replicated optimizer state this is byte-identical to the
         # old in-map update, and with ZeRO-1 state (shard_optimizer_state:
@@ -130,37 +144,66 @@ def make_parallel_train_step(
         # makes XLA all-gather the updates, which IS the ZeRO-1 exchange
         # (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
         # hydragnn/utils/optimizer/optimizer.py:43-101).
-        if zero2:
-            from .mesh import zero2_grad_constraint
+        def do_update():
+            g = grads
+            if zero2:
+                from .mesh import zero2_grad_constraint
 
-            grads = zero2_grad_constraint(grads, mesh, min_size=zero2_min_size)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        if zero3:
-            # FSDP output contract: updated params leave the step sharded,
-            # so the gathered full copies are transient step-local buffers
-            from .mesh import zero3_param_constraint
+                g = zero2_grad_constraint(g, mesh, min_size=zero2_min_size)
+            updates, opt_state = tx.update(g, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            if zero3:
+                # FSDP output contract: updated params leave the step
+                # sharded, so the gathered full copies are transient
+                # step-local buffers
+                from .mesh import zero3_param_constraint
 
-            params = zero3_param_constraint(
-                params, mesh, min_size=zero2_min_size
+                params = zero3_param_constraint(
+                    params, mesh, min_size=zero2_min_size
+                )
+            elif zero2:
+                # pin the post-update params back to replicated: the sharded
+                # updates make XLA all-gather here (the ZeRO-2 param
+                # exchange) instead of falling back to full-grad replication
+                # upstream
+                params = jax.lax.with_sharding_constraint(
+                    params, NamedSharding(mesh, P())
+                )
+            return params, opt_state
+
+        if use_guard:
+            # ok is computed from the pmean'd loss/grads — replicated
+            # values, so the guard's select agrees across the whole mesh
+            new_state = guarded_update(
+                state, step_ok(tot, grads), do_update, new_stats
             )
-        elif zero2:
-            # pin the post-update params back to replicated: the sharded
-            # updates make XLA all-gather here (the ZeRO-2 param exchange)
-            # instead of falling back to full-grad replication upstream
-            params = jax.lax.with_sharding_constraint(
-                params, NamedSharding(mesh, P())
-            )
-        return (
-            state.replace(
+            # the guard's per-leaf select merges old and new params,
+            # which does not preserve do_update's output constraint —
+            # re-apply the ZeRO output contract on the merged params or
+            # GSPMD is free to leave them sharded
+            if zero3:
+                from .mesh import zero3_param_constraint
+
+                new_state = new_state.replace(
+                    params=zero3_param_constraint(
+                        new_state.params, mesh, min_size=zero2_min_size
+                    )
+                )
+            elif zero2:
+                new_state = new_state.replace(
+                    params=jax.lax.with_sharding_constraint(
+                        new_state.params, NamedSharding(mesh, P())
+                    )
+                )
+        else:
+            params, opt_state = do_update()
+            new_state = state.replace(
                 params=params,
                 opt_state=opt_state,
                 batch_stats=new_stats,
                 step=state.step + 1,
-            ),
-            tot,
-            tasks,
-        )
+            )
+        return new_state, tot, tasks
 
     # donate the incoming state so params/opt-state update in place in HBM
     return jax.jit(step, donate_argnums=0)

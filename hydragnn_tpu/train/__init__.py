@@ -1,4 +1,5 @@
-from .checkpoint import load_existing_model, save_model
+from .checkpoint import load_existing_model, save_model, save_model_orbax
+from .guard import NonFinitePolicy, guard_enabled, guarded_update, step_ok
 from .loop import (
     BestCheckpoint,
     EarlyStopping,
@@ -23,8 +24,13 @@ from .state import TrainState
 __all__ = [
     "BestCheckpoint",
     "EarlyStopping",
+    "NonFinitePolicy",
     "ReduceLROnPlateau",
     "TrainState",
+    "guard_enabled",
+    "guarded_update",
+    "save_model_orbax",
+    "step_ok",
     "compute_loss",
     "energy_force_loss",
     "evaluate",

@@ -1,20 +1,49 @@
-"""Model/optimizer checkpoint IO.
+"""Model/optimizer checkpoint IO — atomic, verified, fault-tolerant.
 
 Equivalent of the reference's save/load (hydragnn/utils/model/model.py:63-149):
 one file per save holding model + optimizer state, per-epoch files plus a
 ``latest`` pointer. Serialization is flax msgpack over the TrainState pytree
 (device arrays -> host); restore requires a template state of the same
 structure, which ``run_prediction`` rebuilds from the saved config.
+
+Fault model (docs/ROBUSTNESS.md): a preemption can SIGKILL the process at
+ANY instruction, and the parallel FS can throw transient IO errors or rot
+bytes at rest. The protocol:
+
+- every file (payload, sha256 sidecar, ``latest`` pointer) is written
+  tmp-file -> fsync -> ``os.replace`` -> dir fsync, so a reader never sees
+  a torn file — only the old version or the new one;
+- the ``latest`` pointer is written LAST and is the commit point: a kill
+  anywhere inside a save leaves ``latest`` on the previous verified
+  checkpoint (<= 1 epoch lost);
+- a sha256 sidecar is written with every payload; restore verifies the
+  digest and walks back through older epoch files on mismatch/corruption;
+- transient ``OSError``s retry with exponential backoff
+  (HYDRAGNN_CKPT_RETRIES / HYDRAGNN_CKPT_RETRY_BASE — tests pin the base
+  to 0 so no wall-clock sleeps gate CI);
+- ``retention`` > 0 prunes the per-epoch chain to its newest N files after
+  a committed save, bounding both disk and the restore walk.
+
+Injection points for the chaos suite live in utils/faultinject.py
+(``ckpt_write`` IO errors; ``ckpt_tmp_written`` / ``ckpt_msgpack_replaced``
+/ ``ckpt_digest_written`` kill points).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
-from typing import Optional
+import re
+import time
+import warnings
+from typing import List, Optional
 
 from flax import serialization
 
+from ..utils import faultinject
 from .state import TrainState
+
+_EPOCH_RE = re.compile(r"_epoch(\d+)\.msgpack$")
 
 
 def _run_dir(log_name: str, path: str = "./logs") -> str:
@@ -23,11 +52,111 @@ def _run_dir(log_name: str, path: str = "./logs") -> str:
     return d
 
 
+def _retry_plan() -> List[float]:
+    """Backoff schedule for transient IO errors: attempt i sleeps
+    base * 2^i before retrying (base 0 => no sleeping, the CI setting)."""
+    attempts = max(int(os.getenv("HYDRAGNN_CKPT_RETRIES", "4")), 1)
+    base = float(os.getenv("HYDRAGNN_CKPT_RETRY_BASE", "0.25"))
+    return [base * (2.0**i) for i in range(attempts)]
+
+
+def _fsync_replace(path: str, data: bytes) -> None:
+    """One atomic publish: tmp file + fsync + os.replace + dir fsync. A
+    reader (or a restore after SIGKILL) sees the old content or the new —
+    never a prefix."""
+    faultinject.maybe_ioerror("ckpt_write")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        faultinject.maybe_kill("ckpt_tmp_written")
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    # fsync the directory so the rename itself is durable across power loss
+    try:
+        dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync; the replace stands
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """`_fsync_replace` with exponential-backoff retries on transient
+    OSErrors (flaky parallel FS). The LAST failure propagates."""
+    plan = _retry_plan()
+    for i, delay in enumerate(plan):
+        try:
+            return _fsync_replace(path, data)
+        except OSError:
+            if i == len(plan) - 1:
+                raise
+            if delay > 0:
+                time.sleep(delay)
+
+
+def _sha256_path(fname: str) -> str:
+    return fname + ".sha256"
+
+
+def _epoch_from_env() -> Optional[int]:
+    """HYDRAGNN_EPOCH, hardened: a malformed value at the very end of a run
+    must not crash the save — warn and fall back to the unsuffixed name."""
+    env = os.getenv("HYDRAGNN_EPOCH")
+    if env is None:
+        return None
+    try:
+        return int(env)
+    except ValueError:
+        warnings.warn(
+            f"HYDRAGNN_EPOCH={env!r} is not an integer; saving without an "
+            "epoch suffix instead of failing the checkpoint",
+            stacklevel=3,
+        )
+        return None
+
+
+def _prune_retention(d: str, log_name: str, retention: int) -> None:
+    """Keep only the newest ``retention`` per-epoch msgpack files (plus
+    sidecars). 0/negative = keep everything. Never touches the unsuffixed
+    base file or the orbax tree."""
+    if retention <= 0:
+        return
+    epochs = []
+    for fn in os.listdir(d):
+        m = _EPOCH_RE.search(fn)
+        if m and fn.startswith(log_name):
+            epochs.append((int(m.group(1)), fn))
+    for _, fn in sorted(epochs, reverse=True)[retention:]:
+        for victim in (os.path.join(d, fn), _sha256_path(os.path.join(d, fn))):
+            try:
+                os.unlink(victim)
+            except OSError:
+                pass  # pruning is best-effort; a leftover file is harmless
+
+
 def save_model(
-    state: TrainState, log_name: str, path: str = "./logs", epoch: Optional[int] = None
+    state: TrainState,
+    log_name: str,
+    path: str = "./logs",
+    epoch: Optional[int] = None,
+    retention: int = 0,
 ) -> str:
     """Serialize state; per-epoch filename + 'latest' pointer file
     (reference: model.py:63-106, HYDRAGNN_EPOCH env drives per-epoch names).
+
+    Writes payload -> sha256 sidecar -> ``latest``, each atomically; the
+    pointer is the commit point. ``retention`` > 0 prunes older epoch files
+    after the commit (Training.checkpoint_retention).
 
     Rank-gated: on multi-host runs only process 0 writes — but sharded
     leaves (ZeRO-1 moments, branch-parallel decoder banks) are first
@@ -42,35 +171,60 @@ def save_model(
     if jax.process_index() != 0:
         return ""
     if epoch is None:
-        env = os.getenv("HYDRAGNN_EPOCH")
-        epoch = int(env) if env is not None else None
+        epoch = _epoch_from_env()
     d = _run_dir(log_name, path)
     suffix = f"_epoch{epoch}" if epoch is not None else ""
     fname = os.path.join(d, f"{log_name}{suffix}.msgpack")
-    with open(fname, "wb") as f:
-        f.write(serialization.to_bytes(state))
-    latest = os.path.join(d, "latest")
-    with open(latest, "w") as f:
-        f.write(os.path.basename(fname))
+    blob = serialization.to_bytes(state)
+    # SAME-NAME overwrite hazard: if this filename was saved before (epoch
+    # suffix reused, or the unsuffixed default name), a kill between the
+    # payload replace below and the new sidecar write would leave payload=v2
+    # beside sidecar=sha(v1) — a fully valid checkpoint restore-rejected as
+    # corrupt. Drop the old sidecar FIRST: every kill window then leaves
+    # either a verified pair or a complete payload with no sidecar, which
+    # restore accepts (atomic replace guarantees completeness) with an
+    # 'unverified' warning.
+    try:
+        os.unlink(_sha256_path(fname))
+    except FileNotFoundError:
+        pass
+    atomic_write(fname, blob)
+    faultinject.maybe_kill("ckpt_msgpack_replaced")
+    atomic_write(
+        _sha256_path(fname), hashlib.sha256(blob).hexdigest().encode("ascii")
+    )
+    faultinject.maybe_kill("ckpt_digest_written")
+    # the pointer commits the save: everything above is invisible to
+    # restore until this replace lands
+    atomic_write(
+        os.path.join(d, "latest"), os.path.basename(fname).encode("utf-8")
+    )
+    _prune_retention(d, log_name, retention)
     return fname
 
 
 def save_model_orbax(
     state: TrainState, log_name: str, path: str = "./logs",
-    epoch: Optional[int] = None,
+    epoch: Optional[int] = None, retention: int = 0,
 ) -> str:
     """Orbax save: the idiomatic JAX checkpoint path for pod scale —
     sharding-aware (every process writes its own shards; do NOT rank-gate)
     and layout-portable. Opt in with ``Training.checkpoint_backend:
-    "orbax"``; the msgpack path stays the default for single-host runs."""
+    "orbax"``; the msgpack path stays the default for single-host runs.
+    Orbax's own commit protocol makes the step directory atomic; the
+    ``latest`` pointer is published with the same tmp+fsync+replace as the
+    msgpack path. ``retention`` maps Training.checkpoint_retention onto the
+    manager's ``max_to_keep`` (0 = keep every step)."""
     import orbax.checkpoint as ocp
 
     if epoch is None:
-        env = os.getenv("HYDRAGNN_EPOCH")
-        epoch = int(env) if env is not None else 0
+        epoch = _epoch_from_env() or 0
     d = _run_dir(log_name, path)
     ckpt_dir = os.path.abspath(os.path.join(d, "orbax"))
-    with ocp.CheckpointManager(ckpt_dir) as mgr:
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=retention if retention > 0 else None
+    )
+    with ocp.CheckpointManager(ckpt_dir, options=options) as mgr:
         # CheckpointManager.save refuses existing steps; re-saves of a step
         # (best-val updates, resumed runs) replace the old checkpoint
         if int(epoch) in mgr.all_steps():
@@ -80,9 +234,63 @@ def save_model_orbax(
     import jax
 
     if jax.process_index() == 0:
-        with open(os.path.join(d, "latest"), "w") as f:
-            f.write(f"orbax/{int(epoch)}")
+        atomic_write(
+            os.path.join(d, "latest"), f"orbax/{int(epoch)}".encode("utf-8")
+        )
     return os.path.join(ckpt_dir, str(int(epoch)))
+
+
+def _verified_read(full: str, tried: List[str]) -> Optional[bytes]:
+    """Read a payload and check it against its sha256 sidecar. Returns the
+    bytes, or None (with the reason appended to ``tried``)."""
+    base = os.path.basename(full)
+    try:
+        with open(full, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        tried.append(f"{base}: unreadable ({e})")
+        return None
+    side = _sha256_path(full)
+    if os.path.exists(side):
+        try:
+            with open(side) as f:
+                want = f.read().strip()
+        except OSError as e:
+            tried.append(f"{base}: sidecar unreadable ({e})")
+            return None
+        got = hashlib.sha256(blob).hexdigest()
+        if got != want:
+            tried.append(
+                f"{base}: sha256 mismatch (file {got[:12]}… != sidecar "
+                f"{want[:12]}… — torn or bit-rotted; falling back)"
+            )
+            return None
+    else:
+        # pre-sidecar checkpoint (or one whose save was killed between the
+        # payload and the digest): accept, but say so — the pointer-commit
+        # protocol means such a file was still completely written
+        warnings.warn(
+            f"checkpoint {base} has no sha256 sidecar; restoring unverified",
+            stacklevel=4,
+        )
+    return blob
+
+
+def _msgpack_candidates(d: str, entry: Optional[str]) -> List[str]:
+    """Restore order: the ``latest`` entry first, then every other msgpack
+    in the run dir, newest epoch first (unsuffixed base file last)."""
+    out = []
+    if entry and not entry.startswith("orbax/"):
+        out.append(entry)
+    epochs, plain = [], []
+    for fn in os.listdir(d):
+        if not fn.endswith(".msgpack") or fn in out:
+            continue
+        m = _EPOCH_RE.search(fn)
+        (epochs if m else plain).append((int(m.group(1)) if m else -1, fn))
+    out.extend(fn for _, fn in sorted(epochs, reverse=True))
+    out.extend(fn for _, fn in sorted(plain))
+    return out
 
 
 def load_existing_model(
@@ -91,24 +299,66 @@ def load_existing_model(
     """Restore into a template with identical pytree structure
     (reference: load_existing_model, model.py:128-149). The ``latest``
     pointer selects the backend: an ``orbax/<step>`` entry restores through
-    orbax, a ``*.msgpack`` entry through flax serialization."""
+    orbax, a ``*.msgpack`` entry through flax serialization.
+
+    Every msgpack candidate is digest-verified against its sha256 sidecar;
+    on corruption (or a failed orbax restore) the walk falls back through
+    older retained epochs, newest first. Total failure raises a
+    FileNotFoundError that lists the run dir's files and every candidate
+    tried with the reason it was rejected."""
     d = os.path.join(path, log_name)
+    tried: List[str] = []
+    if not os.path.isdir(d):
+        raise FileNotFoundError(
+            f"no checkpoint for run {log_name!r}: directory {d!r} does not "
+            f"exist (searched under path={path!r}). Was the run saved with "
+            "a different log name or Training.startfrom?"
+        )
     latest = os.path.join(d, "latest")
+    entry: Optional[str] = None
     if os.path.exists(latest):
-        with open(latest) as f:
-            entry = f.read().strip()
+        try:
+            with open(latest) as f:
+                entry = f.read().strip()
+        except OSError as e:
+            tried.append(f"latest: unreadable ({e})")
     else:
         entry = f"{log_name}.msgpack"
-    if entry.startswith("orbax/"):
-        import orbax.checkpoint as ocp
+        tried.append("latest: missing (trying the default msgpack name)")
+    if entry and entry.startswith("orbax/"):
+        try:
+            import orbax.checkpoint as ocp
 
-        step = int(entry.split("/", 1)[1])
-        with ocp.CheckpointManager(
-            os.path.abspath(os.path.join(d, "orbax"))
-        ) as mgr:
-            return mgr.restore(
-                step, args=ocp.args.StandardRestore(template_state)
-            )
-    fname = os.path.join(d, entry)
-    with open(fname, "rb") as f:
-        return serialization.from_bytes(template_state, f.read())
+            step = int(entry.split("/", 1)[1])
+            with ocp.CheckpointManager(
+                os.path.abspath(os.path.join(d, "orbax"))
+            ) as mgr:
+                return mgr.restore(
+                    step, args=ocp.args.StandardRestore(template_state)
+                )
+        except Exception as e:  # noqa: BLE001 — fall back to the msgpack chain
+            tried.append(f"{entry}: orbax restore failed ({e})")
+    for fn in _msgpack_candidates(d, entry):
+        full = os.path.join(d, fn)
+        if not os.path.exists(full):
+            tried.append(f"{fn}: missing")
+            continue
+        blob = _verified_read(full, tried)
+        if blob is None:
+            continue
+        try:
+            return serialization.from_bytes(template_state, blob)
+        except Exception as e:  # noqa: BLE001 — structure drift / truncation
+            tried.append(f"{fn}: deserialization failed ({e})")
+    try:
+        files = sorted(os.listdir(d))
+    except OSError:
+        files = ["<unlistable>"]
+    raise FileNotFoundError(
+        f"no loadable checkpoint for run {log_name!r} in {d!r}.\n"
+        f"  files present: {files}\n"
+        f"  candidates tried: {tried or ['<none>']}\n"
+        "Each candidate above was rejected for the stated reason; a sha256 "
+        "mismatch means the file is corrupt — delete it to silence the "
+        "fallback, or restore an older epoch by editing 'latest'."
+    )

@@ -95,6 +95,7 @@ def make_train_step(
     tx: optax.GradientTransformation,
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
+    guard: Optional[bool] = None,
 ):
     """Build the jitted SGD step: (state, batch, rng) -> (state, loss, tasks).
 
@@ -106,8 +107,18 @@ def make_train_step(
     to bf16 inside the differentiated function, so gradients flow back
     through the cast and land in f32 for the optimizer; running batch-norm
     statistics are re-cast to f32 before being stored. Targets stay f32, so
-    residuals and the loss accumulate in f32 by dtype promotion."""
+    residuals and the loss accumulate in f32 by dtype promotion.
+
+    ``guard`` (default: on, env HYDRAGNN_STEP_GUARD=0 disables): in-graph
+    non-finite step guard — loss/global-grad-norm finiteness is computed in
+    the same program and a bad step's optimizer update is gated to identity
+    (per-leaf select), advancing the state's skip counters (train/guard.py).
+    A good step commits the EXACT unguarded update values."""
     cfg = model.cfg
+    from ..utils import faultinject
+    from .guard import guard_enabled, guarded_update, step_ok
+
+    use_guard = guard_enabled(guard)
 
     def loss_fn(params, batch_stats, batch, rng):
         if mixed_precision:
@@ -130,14 +141,31 @@ def make_train_step(
         (tot, (tasks, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, batch, rng
         )
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            params=params,
-            opt_state=opt_state,
-            batch_stats=mutated.get("batch_stats", state.batch_stats),
-            step=state.step + 1,
+        # chaos-test hook: exact no-op unless a fault is armed (trace-time)
+        grads = faultinject.poison_grads(
+            grads, state.step, faultinject.lr_of(state.opt_state)
         )
+        new_stats = mutated.get("batch_stats", state.batch_stats)
+        if use_guard:
+
+            def do_update():
+                updates, opt_state = tx.update(
+                    grads, state.opt_state, state.params
+                )
+                return optax.apply_updates(state.params, updates), opt_state
+
+            new_state = guarded_update(
+                state, step_ok(tot, grads), do_update, new_stats
+            )
+        else:
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(
+                params=params,
+                opt_state=opt_state,
+                batch_stats=new_stats,
+                step=state.step + 1,
+            )
         return new_state, tot, tasks
 
     return train_step
@@ -272,6 +300,13 @@ def train_epoch(loader, step_fn, state, rng):
         (float(t), {k: float(v) for k, v in d.items()}, n)
         for t, d, n in entries
     ]
+    # a guarded-and-skipped step reports its (non-finite) loss but applied
+    # no update — excluding it keeps the epoch mean meaningful for the
+    # plateau scheduler / early stopping. If EVERY step was non-finite
+    # (unguarded collapse), keep them: a NaN epoch must not be masked.
+    finite = [e for e in entries if np.isfinite(e[0])]
+    if finite and len(finite) < len(entries):
+        entries = finite
     tot, tasks = _weighted_avg(entries)
     return state, tot, tasks, rng
 
@@ -340,6 +375,7 @@ def train_validate_test(
     log_fn: Optional[Callable[[int, Dict[str, float]], None]] = None,
     step_fn: Optional[Callable] = None,
     eval_fn: Optional[Callable] = None,
+    restore_fn: Optional[Callable[[TrainState], TrainState]] = None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Outer epoch loop (reference: train_validate_test.py:52-264).
 
@@ -347,7 +383,9 @@ def train_validate_test(
     skips val/test epochs (reference :179); ``HYDRAGNN_MAX_NUM_BATCH`` caps
     timed batches (reference :46-47). ``step_fn``/``eval_fn`` override the
     default single-host jitted steps (used by the multi-host mesh path,
-    api.py).
+    api.py). ``restore_fn`` (template_state -> restored state) is the
+    rollback path of ``Training.non_finite_policy: rollback`` — api.py
+    wires it to the verified-checkpoint restore with mesh re-placement.
     """
     training = config["NeuralNetwork"]["Training"]
     num_epoch = training["num_epoch"]
@@ -378,6 +416,18 @@ def train_validate_test(
     from ..utils import tracer as tr
     from ..utils.profile import Profiler
     from ..utils.walltime import should_stop
+    from .guard import NonFinitePolicy
+
+    # Training.non_finite_policy: what a guard-skipped step means at the
+    # epoch boundary (the only place the loop syncs the host anyway)
+    nf_policy = NonFinitePolicy(
+        policy=str(training.get("non_finite_policy", "warn_skip")),
+        rollback_after=int(training.get("non_finite_rollback_after", 3)),
+        lr_backoff=float(training.get("non_finite_lr_backoff", 0.5)),
+        max_rollbacks=int(training.get("non_finite_max_rollbacks", 3)),
+        restore_fn=restore_fn,
+        log_name=log_name,
+    )
 
     profiler = Profiler(config.get("Profile"), log_dir=f"./logs/{log_name}/profile")
     check_remaining = training.get("CheckRemainingTime", False)
@@ -430,6 +480,17 @@ def train_validate_test(
                     train_loader, step_fn, state, rng
                 )
             hist["train"].append(tr_loss)
+            # non-finite-step policy: warn/raise/rollback BEFORE val/test so
+            # a rollback epoch evaluates the restored state, not a stale one
+            rollbacks_before = nf_policy.rollbacks_done
+            state = nf_policy.after_epoch(state, epoch)
+            if nf_policy.rollbacks_done > rollbacks_before:
+                # the warmup ramp below recomputes the LR from base_lr every
+                # warmup epoch — scale the base too, or the next ramp line
+                # would silently erase the backoff the rollback just applied
+                base_lr *= nf_policy.lr_backoff ** (
+                    nf_policy.rollbacks_done - rollbacks_before
+                )
 
             if do_valtest:
                 with tr.timer("validate"):

@@ -15,6 +15,12 @@ class TrainState:
     batch_stats: Any
     opt_state: Any
     step: int
+    # non-finite guard counters (train/guard.py): advanced IN-GRAPH by the
+    # guarded train steps — total skipped steps, and the consecutive-skip
+    # streak any good step resets. Serialized with the checkpoint so a
+    # resumed run keeps its fault history.
+    skipped_steps: Any = 0
+    consecutive_skips: Any = 0
 
     @staticmethod
     def create(variables: Dict[str, Any], tx: optax.GradientTransformation) -> "TrainState":

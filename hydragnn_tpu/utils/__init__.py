@@ -2,7 +2,7 @@
 region tracer, phase timers, device profiler, leveled printing, metric
 writer, SLURM walltime stop."""
 
-from . import tracer
+from . import faultinject, tracer
 from .printing import (
     iterate_tqdm,
     print_distributed,
@@ -19,6 +19,7 @@ __all__ = [
     "MetricsWriter",
     "Profiler",
     "Timer",
+    "faultinject",
     "iterate_tqdm",
     "parse_slurm_remaining",
     "peak_memory_stats",

@@ -1,0 +1,166 @@
+"""Deterministic fault injection for the fault-tolerance layer.
+
+Every recovery path in train/ and checkpoint IO is exercised in CI through
+the injection points below instead of being trusted: a NaN landing in the
+gradients at a known step, a SIGKILL at a named point inside the checkpoint
+writer, a bit flipped in a saved checkpoint, an IOError on the first n write
+attempts (the flaky-parallel-FS model). All points are env/config driven and
+deterministic — no time-based races, no random faults.
+
+Injection points (env is the primary surface; ``configure`` mirrors it for
+in-process tests):
+
+- ``HYDRAGNN_FAULT_NAN_STEP``: poison the gradients with NaN inside the
+  jitted train step — ``"5"`` (exactly step 5), ``"5+"`` (every step >= 5),
+  ``"3,7"`` (a list). Read at TRACE time: set it before the step function's
+  first call.
+- ``HYDRAGNN_FAULT_NAN_LR_GT``: poison the gradients while the injected
+  learning rate is above the threshold — the deterministic model of
+  "diverged because the LR is too high", which the rollback policy's LR
+  backoff genuinely recovers from. ANDed with NAN_STEP when both are set.
+- ``HYDRAGNN_FAULT_KILL_AT``: comma-separated point names; ``maybe_kill``
+  SIGKILLs the process when called with a listed name (checkpoint writer
+  points: ``ckpt_tmp_written``, ``ckpt_msgpack_replaced``,
+  ``ckpt_digest_written`` — see train/checkpoint.py).
+- ``HYDRAGNN_FAULT_IO_ERRORS``: ``maybe_ioerror`` raises OSError on the
+  first n calls per point name (per process), then succeeds — the transient
+  flaky-FS model the checkpoint writer's retry loop must absorb.
+
+``flip_bit`` is the host-side corruption tool for the torn/rotted-checkpoint
+tests: flip one bit of a saved file and assert restore falls back to the
+previous verified epoch.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Dict, Optional
+
+# per-point counters for maybe_ioerror (per process — checkpoint saves run
+# in-process, so a counter here is exactly "the first n attempts")
+_io_error_counts: Dict[str, int] = {}
+# configure() overrides; env wins when both are set
+_config: Dict[str, str] = {}
+
+
+def configure(**kwargs: Optional[str]) -> None:
+    """In-process mirror of the env surface for tests:
+    ``configure(nan_step="5+", io_errors="2", kill_at="ckpt_tmp_written")``.
+    Pass ``None`` to clear a key."""
+    keymap = {
+        "nan_step": "HYDRAGNN_FAULT_NAN_STEP",
+        "nan_lr_gt": "HYDRAGNN_FAULT_NAN_LR_GT",
+        "kill_at": "HYDRAGNN_FAULT_KILL_AT",
+        "io_errors": "HYDRAGNN_FAULT_IO_ERRORS",
+    }
+    for k, v in kwargs.items():
+        if k not in keymap:
+            raise KeyError(f"unknown faultinject key {k!r}; known: {sorted(keymap)}")
+        if v is None:
+            _config.pop(keymap[k], None)
+        else:
+            _config[keymap[k]] = str(v)
+
+
+def reset() -> None:
+    """Clear configure() state and the per-point IO-error counters."""
+    _config.clear()
+    _io_error_counts.clear()
+
+
+def _get(key: str) -> Optional[str]:
+    env = os.environ.get(key)
+    return env if env is not None else _config.get(key)
+
+
+def poison_grads(grads, step, lr=None):
+    """Inside the jitted train step: return ``grads`` with every floating
+    leaf replaced by NaN when the armed condition holds at runtime, or
+    ``grads`` unchanged (an exact no-op — the env is read at TRACE time, so
+    an unarmed run compiles the identity).
+
+    ``step`` is the (traced) ``state.step`` counter; ``lr`` the (traced)
+    injected learning rate, when the optimizer carries one."""
+    spec = _get("HYDRAGNN_FAULT_NAN_STEP")
+    lr_gt = _get("HYDRAGNN_FAULT_NAN_LR_GT")
+    if spec is None and lr_gt is None:
+        return grads
+    import jax
+    import jax.numpy as jnp
+
+    cond = None
+    if spec is not None:
+        s = jnp.asarray(step)
+        if spec.endswith("+"):
+            cond = s >= int(spec[:-1])
+        else:
+            cond = jnp.zeros((), bool)
+            for k in spec.split(","):
+                cond = cond | (s == int(k))
+    if lr_gt is not None and lr is not None:
+        c = jnp.asarray(lr) > float(lr_gt)
+        cond = c if cond is None else cond & c
+    if cond is None:
+        return grads
+
+    def poison(g):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.floating):
+            return g
+        return jnp.where(cond, jnp.full_like(g, jnp.nan), g)
+
+    return jax.tree_util.tree_map(poison, grads)
+
+
+def lr_of(opt_state):
+    """The (traced) injected learning rate of an inject_hyperparams optimizer
+    state, or None — the lr hook for poison_grads' LR-threshold mode."""
+    hp = getattr(opt_state, "hyperparams", None)
+    if isinstance(hp, dict) and "learning_rate" in hp:
+        return hp["learning_rate"]
+    return None
+
+
+def maybe_kill(point: str) -> None:
+    """SIGKILL this process when ``point`` is armed — the preemption-
+    mid-write model. SIGKILL (not SIGTERM): nothing may run after it, which
+    is exactly the torn-write scenario the atomic checkpoint protocol must
+    survive."""
+    spec = _get("HYDRAGNN_FAULT_KILL_AT")
+    if spec is None:
+        return
+    if point in (p.strip() for p in spec.split(",")):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_ioerror(point: str) -> None:
+    """Raise OSError on the first n calls for ``point`` (n from
+    HYDRAGNN_FAULT_IO_ERRORS), then succeed — deterministic transient-IO
+    model for the checkpoint writer's retry/backoff loop."""
+    spec = _get("HYDRAGNN_FAULT_IO_ERRORS")
+    if spec is None:
+        return
+    n = int(spec)
+    done = _io_error_counts.get(point, 0)
+    if done < n:
+        _io_error_counts[point] = done + 1
+        raise OSError(
+            f"injected transient IO error {done + 1}/{n} at {point!r} "
+            "(HYDRAGNN_FAULT_IO_ERRORS)"
+        )
+
+
+def flip_bit(path: str, byte_offset: Optional[int] = None, bit: int = 0) -> int:
+    """Flip one bit of the file at ``path`` in place (default: the middle
+    byte — inside the msgpack payload, past any header). Returns the byte
+    offset flipped. The corruption tool for the verified-restore tests."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError(f"cannot bit-flip empty file {path}")
+    off = size // 2 if byte_offset is None else byte_offset
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ (1 << bit)]))
+    return off

@@ -34,6 +34,12 @@ python -m pytest tests/test_multihost.py -x -q
 echo "== BENCH_GPS smoke (bench GPS cells build + train on CPU; flash==dense) =="
 BENCH_GPS_SMOKE=1 python bench.py
 
+echo "== BENCH_GUARD smoke (guarded==unguarded loss, f32+bf16; step-time A/B shape) =="
+BENCH_GUARD_SMOKE=1 python bench.py
+
+echo "== chaos resume smoke (SIGTERM mid-run -> Training.continue round-trip) =="
+python run-scripts/chaos_smoke.py
+
 echo "== multichip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

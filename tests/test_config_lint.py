@@ -40,6 +40,26 @@ def pytest_lint_statuses():
     assert "definitely_a_typo" in report and "summary:" in report
 
 
+def pytest_lint_handles_fault_tolerance_keys():
+    """The r7 fault-tolerance Training keys (docs/ROBUSTNESS.md) — a config
+    carrying them must lint clean, not as typos."""
+    cfg = {
+        "NeuralNetwork": {
+            "Training": {
+                "non_finite_policy": "rollback",
+                "non_finite_rollback_after": 2,
+                "non_finite_lr_backoff": 0.5,
+                "non_finite_max_rollbacks": 3,
+                "checkpoint_retention": 5,
+                "checkpoint_backend": "orbax",
+            },
+        },
+    }
+    statuses = {f.path: f.status for f in lint_config(cfg)}
+    for key, status in statuses.items():
+        assert status == "handled", (key, status)
+
+
 @pytest.mark.skipif(not os.path.isdir(_REF), reason="reference tree absent")
 def pytest_reference_configs_have_no_unknown_keys():
     paths = sorted(

@@ -1,0 +1,431 @@
+"""Chaos suite: the fault-tolerance layer exercised deterministically through
+utils/faultinject.py — no recovery path is trusted untested.
+
+Covers the non-finite step guard (train/guard.py: in-graph skip, counters,
+policy handling at the epoch boundary) on the single-device and mesh train
+steps, and the three ``Training.non_finite_policy`` modes end-to-end through
+``train_validate_test``. Checkpoint-IO chaos (SIGKILL mid-save, bit flips,
+flaky-FS IOErrors) lives in tests/test_checkpoint.py.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+from hydragnn_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _tiny_setup(batch_size=4, num_configs=16, num_shards=1):
+    raw = deterministic_graph_dataset(num_configs, seed=97)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "num_epoch": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(
+        tr, batch_size, seed=0, num_shards=num_shards, drop_last=True
+    )
+    model = create_model(config)
+    batch = next(iter(loader))
+    one = batch
+    if num_shards > 1:
+        one = jax.tree_util.tree_map(lambda x: np.asarray(x)[0], batch)
+    variables = init_model(model, one, seed=0)
+    tx = make_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    return config, model, batch, variables, tx
+
+
+def _copy(variables):
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), variables)
+
+
+# ---------------------------------------------------------------------------
+# acceptance: guarded step numerically identical to unguarded on finite
+# batches (f32 in tier-1; the bf16 leg rides the unfiltered CI run plus
+# BENCH_GUARD_SMOKE, which asserts both precisions — 870s tier-1 box)
+@pytest.mark.parametrize(
+    "mixed_precision", [False, pytest.param(True, marks=pytest.mark.slow)]
+)
+def pytest_guarded_step_loss_equals_unguarded(mixed_precision):
+    _, model, batch, variables, tx = _tiny_setup()
+    losses = {}
+    for guard in (True, False):
+        state = TrainState.create(_copy(variables), tx)
+        step = make_train_step(
+            model, tx, mixed_precision=mixed_precision, guard=guard
+        )
+        ls = []
+        for i in range(3):
+            state, tot, _ = step(state, batch, jax.random.PRNGKey(i))
+            ls.append(float(tot))
+        losses[guard] = ls
+    # same params, same update arithmetic (the guard's select commits the
+    # unguarded update values verbatim on a good step) — the losses must
+    # agree exactly, not approximately
+    assert losses[True] == losses[False], losses
+
+
+def pytest_nan_step_skipped_counters_and_params(monkeypatch):
+    """An injected-NaN step must leave params/opt-state untouched and
+    advance the counters; the next good step resets the streak."""
+    _, model, batch, variables, tx = _tiny_setup()
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "1")
+    state = TrainState.create(_copy(variables), tx)
+    step = make_train_step(model, tx, guard=True)
+    state, t0, _ = step(state, batch, jax.random.PRNGKey(0))
+    w_before = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(state.params)[0])
+    )
+    state, t1, _ = step(state, batch, jax.random.PRNGKey(1))  # poisoned
+    w_after = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(state.params)[0])
+    )
+    np.testing.assert_array_equal(w_before, w_after)
+    assert int(state.skipped_steps) == 1
+    assert int(state.consecutive_skips) == 1
+    assert int(state.step) == 2  # skipped steps still count as attempts
+    state, t2, _ = step(state, batch, jax.random.PRNGKey(2))
+    assert int(state.skipped_steps) == 1
+    assert int(state.consecutive_skips) == 0
+    assert np.isfinite(float(t2))
+    # params stayed finite throughout — the guard's whole point
+    assert all(
+        bool(jnp.all(jnp.isfinite(p)))
+        for p in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+def pytest_unguarded_step_propagates_nan(monkeypatch):
+    """Control for the A/B: with the guard off the same injected NaN lands
+    in the params and the counters never move — what BENCH_GUARD=0 runs."""
+    _, model, batch, variables, tx = _tiny_setup()
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "0")
+    state = TrainState.create(_copy(variables), tx)
+    step = make_train_step(model, tx, guard=False)
+    state, _, _ = step(state, batch, jax.random.PRNGKey(0))
+    assert int(np.asarray(state.skipped_steps)) == 0
+    leaves = jax.tree_util.tree_leaves(state.params)
+    assert any(not bool(jnp.all(jnp.isfinite(p))) for p in leaves)
+
+
+def pytest_guard_env_kill_switch(monkeypatch):
+    """HYDRAGNN_STEP_GUARD=0 disables the default-on guard at trace time."""
+    from hydragnn_tpu.train.guard import guard_enabled
+
+    assert guard_enabled(None) is True
+    monkeypatch.setenv("HYDRAGNN_STEP_GUARD", "0")
+    assert guard_enabled(None) is False
+    assert guard_enabled(True) is True  # explicit arg wins over env
+
+
+def pytest_poison_spec_forms():
+    """The three HYDRAGNN_FAULT_NAN_STEP spellings: exact, open-ended,
+    list — plus the LR-threshold AND-mode."""
+    g = {"w": jnp.ones((3,))}
+
+    def poisoned(step, lr=None):
+        out = faultinject.poison_grads(g, jnp.asarray(step), lr)
+        return not bool(jnp.all(jnp.isfinite(out["w"])))
+
+    faultinject.configure(nan_step="5")
+    assert poisoned(5) and not poisoned(4) and not poisoned(6)
+    faultinject.configure(nan_step="5+")
+    assert poisoned(5) and poisoned(9) and not poisoned(4)
+    faultinject.configure(nan_step="3,7")
+    assert poisoned(3) and poisoned(7) and not poisoned(5)
+    faultinject.configure(nan_step=None, nan_lr_gt="0.015")
+    assert poisoned(0, jnp.asarray(0.02)) and not poisoned(0, jnp.asarray(0.01))
+    faultinject.configure(nan_step="5+", nan_lr_gt="0.015")
+    assert poisoned(9, jnp.asarray(0.02)) and not poisoned(9, jnp.asarray(0.01))
+    assert not poisoned(4, jnp.asarray(0.02))
+    faultinject.reset()
+    # unarmed: exact identity, not a where() with a false condition
+    assert faultinject.poison_grads(g, jnp.asarray(0)) is g
+
+
+def pytest_mesh_step_guard_skips(monkeypatch):
+    """The mesh DP step's guard: decision computed on the pmean'd grads, so
+    every device skips the same step; counters advance in-graph."""
+    from hydragnn_tpu.parallel import make_mesh, replicate_state
+    from hydragnn_tpu.parallel.dp import ensure_stacked, make_parallel_train_step
+
+    n = min(4, jax.local_device_count())
+    mesh = make_mesh(devices=jax.devices()[:n])
+    _, model, batch, variables, tx = _tiny_setup(
+        batch_size=2 * n, num_configs=4 * n, num_shards=n
+    )
+    batch = ensure_stacked(batch)
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "0")
+    state = replicate_state(TrainState.create(_copy(variables), tx), mesh)
+    step = make_parallel_train_step(model, tx, mesh, guard=True)
+    w0 = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(state.params)[0])
+    )
+    state, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+    w1 = np.asarray(
+        jax.device_get(jax.tree_util.tree_leaves(state.params)[0])
+    )
+    np.testing.assert_array_equal(w0, w1)
+    assert int(np.asarray(state.skipped_steps)) == 1
+    monkeypatch.delenv("HYDRAGNN_FAULT_NAN_STEP")
+    # a fresh trace without the fault: the same state trains on
+    step2 = make_parallel_train_step(model, tx, mesh, guard=True)
+    state, tot, _ = step2(state, batch, jax.random.PRNGKey(1))
+    assert np.isfinite(float(tot))
+    assert int(np.asarray(state.consecutive_skips)) == 0
+
+
+# ---------------------------------------------------------------------------
+# policies end-to-end through the epoch loop (no setup_distributed — the
+# loop is driven directly, like the mesh-path callers do)
+
+
+def _policy_config(policy, lr=0.02, **training_over):
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "chaos",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 64},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1]},
+            "graph_features": {"name": ["s"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["s"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 6,
+                "batch_size": 16,
+                "perc_train": 0.5,
+                "non_finite_policy": policy,
+                "Checkpoint": True,
+                "Optimizer": {"type": "AdamW", "learning_rate": lr},
+                **training_over,
+            },
+        },
+    }
+
+
+def _run_loop(config, log_name, monkeypatch=None):
+    from hydragnn_tpu.api import prepare_data
+    from hydragnn_tpu.train import train_validate_test
+    from hydragnn_tpu.train.checkpoint import load_existing_model, save_model
+
+    if monkeypatch is not None:
+        # policy handling is train-side; skipping val/test epochs halves
+        # the wall-clock (va_loss falls back to tr_loss — BestCheckpoint
+        # and the plateau scheduler still exercise)
+        monkeypatch.setenv("HYDRAGNN_VALTEST", "0")
+    config, (tr_l, va_l, te_l), _ = prepare_data(config)
+    model = create_model(config)
+    variables = init_model(model, next(iter(tr_l)), seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = TrainState.create(variables, tx)
+    return train_validate_test(
+        model, state, tx, tr_l, va_l, te_l, config,
+        log_name=log_name,
+        save_fn=lambda s, e=None: save_model(s, log_name, epoch=e),
+        restore_fn=lambda t: load_existing_model(t, log_name),
+    )
+
+
+def pytest_policy_warn_skip_converges(tmp_path, monkeypatch):
+    """Acceptance: an injected-NaN step is skipped with counters advanced
+    and training still converges on the synthetic workload."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "3")
+    state, hist = _run_loop(_policy_config("warn_skip"), "ws", monkeypatch)
+    assert int(np.asarray(state.skipped_steps)) == 1
+    assert all(np.isfinite(l) for l in hist["train"]), hist["train"]
+    assert hist["train"][-1] < hist["train"][0], hist["train"]
+
+
+@pytest.mark.slow
+def pytest_policy_error_raises(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "1")
+    with pytest.raises(RuntimeError, match="non-finite"):
+        _run_loop(_policy_config("error"), "err", monkeypatch)
+
+
+def pytest_policy_rollback_restores_and_backs_off_lr(tmp_path, monkeypatch):
+    """The divergence story the rollback policy exists for: the LR is too
+    hot (every grad past step 4 goes NaN while lr > 0.015); after K=2
+    agreed consecutive skips the loop restores the last verified checkpoint
+    and halves the LR below the threshold — and training then genuinely
+    converges."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "4+")
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_LR_GT", "0.015")
+    state, hist = _run_loop(
+        _policy_config("rollback", non_finite_rollback_after=2), "rb",
+        monkeypatch,
+    )
+    # the backoff landed: 0.02 -> 0.01 appears in the LR history
+    assert any(abs(l - 0.01) < 1e-9 for l in hist["lr"]), hist["lr"]
+    assert np.isfinite(hist["train"][-1])
+    assert hist["train"][-1] < hist["train"][0], hist["train"]
+    # post-rollback params are finite (restored + cleanly trained)
+    assert all(
+        bool(jnp.all(jnp.isfinite(jnp.asarray(p, jnp.float32))))
+        for p in jax.tree_util.tree_leaves(state.params)
+    )
+
+
+@pytest.mark.slow
+def pytest_policy_rollback_without_checkpoint_is_actionable(
+    tmp_path, monkeypatch
+):
+    """Rollback with nothing to restore must fail with an instruction, not
+    a bare FileNotFoundError from deep inside checkpoint IO."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "0+")
+    cfg = _policy_config(
+        "rollback", non_finite_rollback_after=1, Checkpoint=False
+    )
+    with pytest.raises((RuntimeError, FileNotFoundError)) as e:
+        _run_loop(cfg, "rb_nockpt", monkeypatch)
+    assert "checkpoint" in str(e.value).lower()
+
+
+@pytest.mark.slow
+def pytest_policy_rollback_bounded(tmp_path, monkeypatch):
+    """A run that keeps diverging after restore+backoff must terminate with
+    the max-rollbacks error, not loop forever: the poison here ignores the
+    LR, so every rollback replays into the same wall."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_STEP", "2+")
+    cfg = _policy_config(
+        "rollback",
+        non_finite_rollback_after=1,
+        non_finite_max_rollbacks=2,
+        num_epoch=30,
+    )
+    with pytest.raises(RuntimeError, match="max_rollbacks|keeps diverging"):
+        _run_loop(cfg, "rb_bounded", monkeypatch)
+
+
+@pytest.mark.slow
+def pytest_rollback_backoff_survives_warmup_ramp(tmp_path, monkeypatch):
+    """The warmup LR ramp recomputes the LR from base_lr each warmup epoch;
+    a rollback's backoff must scale that base too, or the next ramp line
+    silently reinstates the pre-backoff schedule (code-review finding)."""
+    monkeypatch.chdir(tmp_path)
+    # LR-threshold poison: the ramp crosses 0.02 at epoch 2 (0.05 * 3/6),
+    # every step there goes NaN, rollback halves the base to 0.025 — and
+    # epoch 3's ramp line 0.025 * 4/6 stays BELOW the threshold, so the
+    # epoch is clean. Without the base_lr scaling, epoch 3 ramps from the
+    # original base (0.05 * 4/6 = 0.033 > 0.02), re-diverges and rolls
+    # back again — its recorded LR is then a rollback-set value instead.
+    monkeypatch.setenv("HYDRAGNN_FAULT_NAN_LR_GT", "0.02")
+    cfg = _policy_config(
+        "rollback",
+        lr=0.05,
+        non_finite_rollback_after=2,
+        warmup_epochs=6,
+        num_epoch=4,
+    )
+    state, hist = _run_loop(cfg, "rb_warmup", monkeypatch)
+    assert abs(hist["lr"][3] - 0.025 * 4 / 6) < 1e-6, hist["lr"]
+    assert np.isfinite(hist["train"][3]), hist["train"]
+
+
+def pytest_config_completion_validates_policy():
+    raw = _policy_config("warn_skip")
+    raw["NeuralNetwork"]["Training"]["non_finite_policy"] = "explode"
+    graphs = deterministic_graph_dataset(8, seed=97)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in graphs]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    with pytest.raises(ValueError, match="non_finite_policy"):
+        update_config(raw, tr, va, te)
+
+
+def pytest_config_completion_defaults_fault_keys():
+    raw = _policy_config("warn_skip")
+    del raw["NeuralNetwork"]["Training"]["non_finite_policy"]
+    graphs = deterministic_graph_dataset(8, seed=97)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in graphs]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    done = update_config(raw, tr, va, te)["NeuralNetwork"]["Training"]
+    assert done["non_finite_policy"] == "warn_skip"
+    assert done["non_finite_rollback_after"] == 3
+    assert done["non_finite_lr_backoff"] == 0.5
+    assert done["non_finite_max_rollbacks"] == 3
+    assert done["checkpoint_retention"] == 0
