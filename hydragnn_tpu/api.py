@@ -31,7 +31,13 @@ from .data.pipeline import (
 from .data.synthetic import deterministic_graph_dataset
 from .data.transforms import apply_dataset_transforms, wants_transforms
 from .models.create import create_model, init_model
-from .train.checkpoint import load_existing_model, save_model
+from .train.checkpoint import (
+    clear_loader_state,
+    load_existing_model,
+    load_loader_state,
+    save_loader_state,
+    save_model,
+)
 from .train.loop import test_model, train_validate_test
 from .train.optimizer import make_optimizer
 from .train.state import TrainState
@@ -111,6 +117,14 @@ def _load_raw_dataset(config: Dict[str, Any]) -> List[Graph]:
             kwargs["charge_density_correction"] = ds.get(
                 "charge_density_correction", False
             )
+        # warn_skip/quarantine extend to the file level: a truncated or
+        # garbled raw dump drops that file (counted + warned) instead of
+        # killing the run; 'error' keeps the historical fail-fast parse
+        kwargs["on_error"] = (
+            "raise"
+            if ds.get("bad_sample_policy", "warn_skip") == "error"
+            else "skip"
+        )
         raw = load_raw_dataset(ds["path"]["total"], fmt, **kwargs)
         return finalize_graphs(
             raw,
@@ -150,11 +164,39 @@ def _wants_zero2_mesh(training: Dict[str, Any]) -> bool:
     return jax.process_count() == 1 and jax.local_device_count() > 1
 
 
+def _make_validator(config: Dict[str, Any]):
+    """Run-level SampleValidator from ``Dataset.bad_sample_policy``
+    (docs/ROBUSTNESS.md "Data plane"): one instance spans ingest filtering
+    and every loader, so its per-reason tally is the run's complete
+    skipped-sample record. Quarantine manifests land in the run dir."""
+    from .data.validate import SampleValidator
+
+    policy = str(
+        config.get("Dataset", {}).get("bad_sample_policy", "warn_skip")
+    )
+    quarantine_dir = None
+    if policy == "quarantine":
+        quarantine_dir = os.path.join(
+            "./logs", get_log_name_config(config), "quarantine"
+        )
+    return SampleValidator(policy, quarantine_dir=quarantine_dir)
+
+
 def prepare_data(
     config: Dict[str, Any], datasets: Optional[Tuple[List[Graph], ...]] = None
 ):
     """Load -> normalize -> select variables -> split -> loaders; returns
-    (completed config, loaders, minmax)."""
+    (completed config, loaders, minmax).
+
+    Every sample passes the data-plane validation gate (data/validate.py)
+    BEFORE normalization/splitting — one NaN feature reaching
+    ``MinMax.fit`` would NaN the normalization of the whole dataset, so
+    dirty samples are dropped (or raised on, per
+    ``Dataset.bad_sample_policy``) at the door; the validator rides on the
+    returned loaders so the epoch loop can log the tally."""
+    validator = _make_validator(config)
+    from .utils import faultinject
+
     if datasets is None:
         raw = _load_raw_dataset(config)
         ds_cfg = config.get("Dataset", {})
@@ -163,6 +205,11 @@ def prepare_data(
             # serialized_dataset_loader.py:130-180). Rotation is shift/cell
             # aware so applying it after edge construction is exact.
             (raw,) = apply_dataset_transforms(ds_cfg, raw)
+        # chaos hook (exact no-op unarmed): NaN-poison armed sample indices
+        # so the validation gate below is exercised end-to-end with a skip
+        # tally that must match the injection plan
+        raw = faultinject.poison_samples(raw)
+        raw = validator.filter(raw, source="ingest")
         if config["NeuralNetwork"]["Training"].get("compute_grad_energy", False):
             # energy/forces ride on the graphs directly (no target extraction
             # or minmax scaling — physical units matter); input node-feature
@@ -201,8 +248,18 @@ def prepare_data(
             trainset, valset, testset = apply_dataset_transforms(
                 ds_cfg, trainset, valset, testset
             )
+        # explicit datasets get the same validation gate, per split
+        trainset = validator.filter(trainset, source="train")
+        valset = validator.filter(valset, source="val")
+        testset = validator.filter(testset, source="test")
 
     config = update_config(config, trainset, valset, testset)
+    if validator.policy == "quarantine":
+        # the run name is derived from COMPLETED config keys — retarget the
+        # manifest to the real run dir (any ingest-time entries move along)
+        validator.set_quarantine_dir(
+            os.path.join("./logs", get_log_name_config(config), "quarantine")
+        )
     training = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
     batch_size = training["batch_size"]
@@ -282,6 +339,11 @@ def prepare_data(
         # top in-degree against the bound (config.py:194-207); the loader
         # check exists for directly constructed loaders
         sort_edges=bool(arch.get("use_sorted_aggregation", False)),
+        # data-plane fault tolerance: batch-time budget policing rides the
+        # run's validator, and the prefetch watchdog turns a wedged producer
+        # into an actionable LoaderStallError (docs/ROBUSTNESS.md)
+        validator=validator,
+        stall_timeout=float(training.get("loader_stall_timeout", 600.0) or 0.0),
     )
     # equal per-dataset step budget for GFM fleets: weighted draws with
     # replacement, the SPMD analog of the reference's uneven branch process
@@ -328,6 +390,9 @@ def prepare_data(
         test_loader = BranchRoutedLoader(
             testset, batch_size, shuffle=False, oversampling=False, **route_kw
         )
+        # branch-routed loaders did their validation at the ingest gate
+        # above; carry the validator so the epoch loop still logs the tally
+        train_loader.validator = validator
         return config, (train_loader, val_loader, test_loader), mm
     train_loader = GraphLoader(
         trainset,
@@ -345,10 +410,15 @@ def prepare_data(
         # multi-host batches must stay full so every process steps in
         # lockstep with identical shard shapes
         drop_last=jax.process_count() > 1,
+        source="train",
         **shard_kw,
     )
-    val_loader = GraphLoader(valset, batch_size, shuffle=False, **shard_kw)
-    test_loader = GraphLoader(testset, batch_size, shuffle=False, **shard_kw)
+    val_loader = GraphLoader(
+        valset, batch_size, shuffle=False, source="val", **shard_kw
+    )
+    test_loader = GraphLoader(
+        testset, batch_size, shuffle=False, source="test", **shard_kw
+    )
     return config, (train_loader, val_loader, test_loader), mm
 
 
@@ -419,8 +489,43 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # hydragnn/utils/model/model.py:118-125, run_training.py:114) — restore
     # before any device placement so the loaded host arrays get re-placed
     if training.get("continue"):
+        import warnings as _warnings
+
         startfrom = training.get("startfrom") or log_name
         state = load_existing_model(state, startfrom)
+        # mid-epoch resume (docs/ROBUSTNESS.md "Data plane"): a loader-state
+        # sidecar beside the checkpoint means the save happened BETWEEN
+        # steps — arm the train loader to replay the interrupted epoch's
+        # remaining batches in the same order, after guarding that the data
+        # recipe still matches (a changed seed/batch count would replay the
+        # wrong stream — then epoch-granularity resume is the honest choice)
+        ls = load_loader_state(startfrom)
+        if ls is not None:
+            recipe_ok = hasattr(train_loader, "resume") and ls.seed == int(
+                getattr(train_loader, "seed", 0) or 0
+            )
+            if recipe_ok:
+                train_loader.resume(ls.epoch, ls.next_batch)
+                # batch-count guard AFTER arming: pack-mode batch counts are
+                # epoch-dependent, so len() is only comparable once the
+                # loader sits at the sidecar's epoch
+                if ls.num_batches and ls.num_batches != len(train_loader):
+                    train_loader.resume(0, 0)  # disarm: fresh epoch 0 start
+                    recipe_ok = False
+            if recipe_ok:
+                if verbosity > 0:
+                    print(
+                        f"[{log_name}] resuming mid-epoch: replaying epoch "
+                        f"{ls.epoch} from batch {ls.next_batch}"
+                    )
+            else:
+                _warnings.warn(
+                    f"loader-state sidecar of run {startfrom!r} does not "
+                    "match the current loader (seed/batch-count drift, or a "
+                    "loader without resume support); resuming at epoch "
+                    "granularity instead of mid-epoch",
+                    stacklevel=2,
+                )
 
     # every device-placement transform applied to the state below is also
     # recorded here, so the rollback restore path (non_finite_policy:
@@ -562,13 +667,27 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     if training.get("checkpoint_backend", "msgpack") == "orbax":
         from .train.checkpoint import save_model_orbax
 
-        save_fn = lambda s, e=None: save_model_orbax(
+        _save_model = lambda s, e=None: save_model_orbax(
             s, log_name, epoch=e, retention=retention
         )
     else:
-        save_fn = lambda s, e=None: save_model(
+        _save_model = lambda s, e=None: save_model(
             s, log_name, epoch=e, retention=retention
         )
+
+    def save_fn(s, e=None):
+        out = _save_model(s, e)
+        # any committed save invalidates an older mid-epoch cursor; the
+        # mid-epoch preemption path re-publishes its sidecar right after
+        # this (loader_state_fn below), so a PRESENT sidecar always
+        # describes the checkpoint it sits beside
+        clear_loader_state(log_name)
+        return out
+
+    def loader_state_fn(d):
+        from .train.state import LoaderState
+
+        save_loader_state(LoaderState.from_dict(d), log_name)
 
     def restore_fn(template):
         # rollback path (Training.non_finite_policy: rollback): restore the
@@ -598,6 +717,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 step_fn=step_fn,
                 eval_fn=eval_fn,
                 restore_fn=restore_fn,
+                loader_state_fn=loader_state_fn,
             )
     finally:
         writer.close()

@@ -397,6 +397,26 @@ def update_config(
     # 0 = keep every per-epoch checkpoint (historical behavior); N > 0
     # prunes to the newest N, bounding disk and the corruption-fallback walk
     training.setdefault("checkpoint_retention", 0)
+    # ---- data plane (docs/ROBUSTNESS.md "Data plane"): what a sample that
+    # fails validation (non-finite features, degenerate edges, budget
+    # overflow, corrupt bytes) means, and how long the loader's prefetch
+    # consumer waits on a silent producer before raising LoaderStallError
+    # (0 disables the stall clock; producer DEATH is always detected)
+    ds_cfg = config.setdefault("Dataset", {})
+    ds_cfg.setdefault("bad_sample_policy", "warn_skip")
+    from ..data.validate import POLICIES
+
+    if ds_cfg["bad_sample_policy"] not in POLICIES:
+        raise ValueError(
+            f"Dataset.bad_sample_policy {ds_cfg['bad_sample_policy']!r} "
+            f"must be one of {POLICIES}"
+        )
+    training.setdefault("loader_stall_timeout", 600.0)
+    if float(training["loader_stall_timeout"] or 0) < 0:
+        raise ValueError(
+            "Training.loader_stall_timeout must be >= 0 (seconds; 0 "
+            f"disables), got {training['loader_stall_timeout']!r}"
+        )
     if training["non_finite_policy"] == "rollback" and not training["Checkpoint"]:
         # rollback restores the last verified checkpoint — without best-val
         # checkpointing only the preemption/end-of-run saves exist, so the

@@ -51,6 +51,7 @@ _HANDLED = {
     "Dataset.normalize",
     "Dataset.synthetic",
     "Dataset.lennard_jones",
+    "Dataset.bad_sample_policy",
     "NeuralNetwork.Profile",
     "NeuralNetwork.Profile.enable",
     "NeuralNetwork.Profile.target_epoch",
@@ -128,6 +129,7 @@ _HANDLED = {
     "NeuralNetwork.Training.non_finite_rollback_after",
     "NeuralNetwork.Training.non_finite_lr_backoff",
     "NeuralNetwork.Training.non_finite_max_rollbacks",
+    "NeuralNetwork.Training.loader_stall_timeout",
     "NeuralNetwork.Training.compute_grad_energy",
     "NeuralNetwork.Training.conv_checkpointing",
     "NeuralNetwork.Training.Optimizer",

@@ -86,6 +86,22 @@ def _load_lib():
     lib.dds_serve_stop.argtypes = [ctypes.c_void_p]
     lib.dds_connect.restype = ctypes.c_void_p
     lib.dds_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    # timeout-aware connect + per-connection socket timeouts: feature-detect
+    # so a stale prebuilt .so (no compiler on the host to rebuild from the
+    # updated source) degrades to the historical blocking behavior instead
+    # of failing to load
+    try:
+        lib.dds_connect_t.restype = ctypes.c_void_p
+        lib.dds_connect_t.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.dds_set_timeout.restype = None
+        lib.dds_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib._has_timeouts = True
+    except AttributeError:  # pragma: no cover - stale binary only
+        lib._has_timeouts = False
     lib.dds_fetch.restype = ctypes.c_int64
     lib.dds_fetch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.dds_fetch_read.restype = ctypes.c_int64
@@ -194,9 +210,34 @@ class DDStore:
             pass
 
 
+def _env_float(key: str, default: float) -> float:
+    try:
+        return float(os.environ.get(key, default))
+    except ValueError:
+        return default
+
+
+def _env_int(key: str, default: int) -> int:
+    try:
+        return int(os.environ.get(key, default))
+    except ValueError:
+        return default
+
+
 class RemoteStoreClient:
     """Persistent TCP connection fetching blobs from a serving DDStore on
     another host (the MPI one-sided get analog, distdataset.py:159-183).
+
+    Hardened for the multi-day-run regime (docs/ROBUSTNESS.md "Data
+    plane"): the socket carries send/receive timeouts from creation (a
+    server that accepts but never responds can no longer wedge the loader
+    forever), and ``get`` absorbs transient connection failures with
+    reconnect + exponential backoff + jitter, bounded by
+    ``HYDRAGNN_DDSTORE_RETRIES`` attempts (base delay
+    ``HYDRAGNN_DDSTORE_RETRY_BASE`` seconds — tests pin 0 so nothing
+    sleeps; socket timeout ``HYDRAGNN_DDSTORE_TIMEOUT`` seconds). The
+    terminal error names host, port, global id and attempt count so a dead
+    peer is attributable from the traceback alone.
 
     Not thread-safe (the request/response protocol shares one socket and
     one scratch buffer); fork-safe — a forked loader worker detects the
@@ -204,19 +245,44 @@ class RemoteStoreClient:
     child never interleave requests on one fd.
     """
 
-    def __init__(self, host: str, port: int):
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        timeout_s: Optional[float] = None,
+        retries: Optional[int] = None,
+        retry_base: Optional[float] = None,
+    ):
         self._lib = _load_lib()
         self.host, self.port = host, port
+        self.timeout_s = (
+            _env_float("HYDRAGNN_DDSTORE_TIMEOUT", 30.0)
+            if timeout_s is None
+            else float(timeout_s)
+        )
+        self.retries = max(
+            _env_int("HYDRAGNN_DDSTORE_RETRIES", 4)
+            if retries is None
+            else int(retries),
+            1,
+        )
+        self.retry_base = (
+            _env_float("HYDRAGNN_DDSTORE_RETRY_BASE", 0.25)
+            if retry_base is None
+            else float(retry_base)
+        )
         self._c = None
         self._connect()
 
     def _connect(self) -> None:
-        if getattr(self, "_c", None):
-            # drop the previous connection (e.g. one inherited across fork:
-            # fds are per-process, so closing here never touches the parent)
-            self._lib.dds_disconnect(self._c)
-            self._c = None
-        self._c = self._lib.dds_connect(self.host.encode(), self.port)
+        self._drop()
+        timeout_ms = int(self.timeout_s * 1000)
+        if getattr(self._lib, "_has_timeouts", False):
+            self._c = self._lib.dds_connect_t(
+                self.host.encode(), self.port, timeout_ms
+            )
+        else:  # pragma: no cover - stale binary only
+            self._c = self._lib.dds_connect(self.host.encode(), self.port)
         if not self._c:
             self._c = None
             raise ConnectionError(f"cannot connect to {self.host}:{self.port}")
@@ -224,14 +290,33 @@ class RemoteStoreClient:
         # leave get() retrying _connect, never fetching on a NULL handle
         self._pid = os.getpid()
 
-    def get(self, global_id: int) -> bytes:
+    def _drop(self) -> None:
+        """Discard the current connection, swallowing teardown errors (the
+        socket may already be dead — that is why we are dropping it)."""
+        c, self._c = getattr(self, "_c", None), None
+        if c:
+            try:
+                self._lib.dds_disconnect(c)
+            except Exception:
+                pass
+
+    def _fetch_once(self, global_id: int) -> bytes:
+        from ..utils import faultinject
+
+        # chaos hook: an exact no-op unless HYDRAGNN_FAULT_SOCKET_DROP arms
+        # a drop on this call — then it raises the same ConnectionError a
+        # real peer reset produces, exercising the reconnect path below
+        faultinject.maybe_socket_drop("ddstore_get")
         if self._c is None or os.getpid() != self._pid:
             # inherited across fork, or a previous reconnect failed: the
             # parent still owns the old socket / there is nothing to fetch on
             self._connect()
         n = self._lib.dds_fetch(self._c, global_id)
         if n == -2:
-            raise ConnectionError(f"connection to {self.host}:{self.port} lost")
+            raise ConnectionError(
+                f"connection to {self.host}:{self.port} lost (or timed out "
+                f"after {self.timeout_s}s) fetching id {global_id}"
+            )
         if n < 0:
             raise KeyError(global_id)
         buf = ctypes.create_string_buffer(int(n))
@@ -239,10 +324,34 @@ class RemoteStoreClient:
         assert got == n
         return buf.raw
 
+    def get(self, global_id: int) -> bytes:
+        """Fetch one blob, reconnecting with exponential backoff + jitter on
+        transient connection failures. ``KeyError`` (the server answered:
+        id not held) is authoritative and never retried."""
+        import random
+        import time
+
+        last: Optional[ConnectionError] = None
+        for attempt in range(self.retries):
+            try:
+                return self._fetch_once(global_id)
+            except ConnectionError as e:
+                last = e
+                # the stream is dead or desynced either way: drop it so the
+                # next attempt reconnects from scratch
+                self._drop()
+                if attempt + 1 < self.retries and self.retry_base > 0:
+                    delay = self.retry_base * (2.0**attempt)
+                    time.sleep(delay * (1.0 + 0.25 * random.random()))
+        raise ConnectionError(
+            f"remote store {self.host}:{self.port} unreachable fetching "
+            f"global_id {global_id} after {self.retries} attempts "
+            "(HYDRAGNN_DDSTORE_RETRIES; socket timeout "
+            f"{self.timeout_s}s via HYDRAGNN_DDSTORE_TIMEOUT): {last}"
+        ) from last
+
     def close(self) -> None:
-        if getattr(self, "_c", None):
-            self._lib.dds_disconnect(self._c)
-            self._c = None
+        self._drop()
 
     def __del__(self):  # pragma: no cover
         try:
@@ -255,6 +364,28 @@ def _pack_graph(g: Graph) -> bytes:
     out = io.BytesIO()
     pickle.dump(g, out, protocol=pickle.HIGHEST_PROTOCOL)
     return out.getvalue()
+
+
+def _unpack_graph(blob: bytes, idx: int, where: str) -> Graph:
+    """Deserialize a fetched sample, turning any failure into a typed
+    ``CorruptSampleError`` naming the sample and its store — bit rot or wire
+    corruption must be attributable (and skippable under
+    ``Dataset.bad_sample_policy``), not an anonymous UnpicklingError killing
+    the run. The chaos hook flips the leading byte when
+    HYDRAGNN_FAULT_CORRUPT_SAMPLE arms this id (utils/faultinject.py)."""
+    from ..utils import faultinject
+
+    from .validate import CorruptSampleError
+
+    blob = faultinject.corrupt_blob(blob, idx)
+    try:
+        return pickle.loads(blob)
+    except Exception as e:  # noqa: BLE001 — any decode failure is corruption
+        raise CorruptSampleError(
+            f"sample {idx} from {where} failed to deserialize "
+            f"({type(e).__name__}: {e}); the stored bytes are corrupt — "
+            "repopulate the store, or let the sample validator quarantine it"
+        ) from e
 
 
 class DistDataset(AbstractBaseDataset):
@@ -336,7 +467,9 @@ class DistDataset(AbstractBaseDataset):
             self._len = int(manifest["len"])
 
     def get(self, idx: int) -> Graph:
-        return pickle.loads(self.store.get(idx))
+        return _unpack_graph(
+            self.store.get(idx), idx, f"shared-memory store {self.store.name!r}"
+        )
 
     def __len__(self) -> int:
         return self._len
@@ -417,16 +550,21 @@ class MultiHostDistDataset(AbstractBaseDataset):
             raise IndexError(idx)
         owner = idx // self._block
         if owner == self._rank:
-            return pickle.loads(self.store.get(idx - self._lo))
+            return _unpack_graph(
+                self.store.get(idx - self._lo), idx,
+                f"shared-memory store {self.store.name!r}",
+            )
+        where = "host {}:{}".format(*self._hosts[owner])
         try:
-            return pickle.loads(self._client(owner).get(idx))
+            return _unpack_graph(self._client(owner).get(idx), idx, where)
         except ConnectionError:
-            # evict the dead connection and retry once — a transient reset
+            # the client already retried with backoff internally; evict the
+            # dead connection and rebuild once more — a transient reset
             # (peer restart, network blip) must not poison the cache forever
             c = self._clients.pop(owner, None)
             if c is not None:
                 c.close()
-            return pickle.loads(self._client(owner).get(idx))
+            return _unpack_graph(self._client(owner).get(idx), idx, where)
 
     def __len__(self) -> int:
         return self._total

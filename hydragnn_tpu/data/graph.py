@@ -64,6 +64,23 @@ class Graph:
     def num_edges(self) -> int:
         return int(self.senders.shape[0])
 
+    def float_channels(self):
+        """Yield ``(name, array)`` for every numeric payload channel of this
+        sample — inputs, geometry, and targets alike. The single source of
+        truth for "which arrays must be finite" used by the sample validator
+        (data/validate.py); a new Graph field with numeric content should be
+        added here so validation covers it automatically."""
+        for name in ("x", "pos", "edge_attr", "edge_shifts", "pe", "rel_pe"):
+            v = getattr(self, name)
+            if v is not None:
+                yield name, np.asarray(v)
+        if self.graph_y is not None:
+            yield "graph_y", np.asarray(self.graph_y)
+        for table, label in ((self.graph_targets, "graph_target"),
+                             (self.node_targets, "node_target")):
+            for key, v in (table or {}).items():
+                yield f"{label}:{key}", np.asarray(v)
+
 
 @struct.dataclass
 class GraphBatch:

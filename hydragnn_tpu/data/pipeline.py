@@ -10,6 +10,7 @@ here is host-side numpy; the output of ``GraphLoader`` is a statically padded
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -25,6 +26,21 @@ from .graph import (
     batch_graphs_np,
     graph_batch_from_np,
 )
+
+# prefetch watchdog cadence: how often the consumer wakes to check producer
+# liveness / the stall clock, and how long the teardown join waits before
+# declaring the producer thread leaked (both module-level so tests can pin)
+_WATCHDOG_TICK_S = 0.1
+_PRODUCER_JOIN_TIMEOUT_S = 2.0
+
+
+class LoaderStallError(RuntimeError):
+    """The prefetch producer thread died without delivering its end-of-epoch
+    sentinel, or produced nothing for longer than
+    ``Training.loader_stall_timeout`` — a wedged worker (deadlocked fetch,
+    hung filesystem) that would otherwise hang the run forever on a bare
+    queue get. The message names the batch cursor so the stall is
+    attributable."""
 
 
 def _pack_spec(
@@ -272,6 +288,9 @@ class GraphLoader:
         bucket_window: int = 16,
         pack: bool = False,
         with_triplets: bool = False,
+        validator=None,
+        source: str = "dataset",
+        stall_timeout: float = 600.0,
     ):
         """``num_shards`` > 1 emits *stacked* batches with a leading device
         axis [num_shards, ...]: each shard is an independent padded batch with
@@ -281,7 +300,32 @@ class GraphLoader:
         ``spec`` may be a single ``PadSpec`` (every batch padded to it) or a
         ``SpecLadder`` (each batch padded to the smallest fitting level);
         ``num_buckets`` > 1 with ``spec=None`` builds a ladder from the data
-        (the variable-graph-size strategy, SURVEY §5.7)."""
+        (the variable-graph-size strategy, SURVEY §5.7).
+
+        ``validator`` (data/validate.SampleValidator) gates bad samples at
+        construction per ``Dataset.bad_sample_policy`` — non-finite
+        channels, degenerate edge indices, and (under a fixed ``spec``)
+        budget-overflow graphs are dropped-and-counted or raised instead of
+        crashing mid-epoch; ``source`` labels this loader's rejects in the
+        tally/manifest. ``stall_timeout`` (seconds; 0 disables) bounds how
+        long the prefetch consumer waits on a silent producer before
+        raising ``LoaderStallError``."""
+        self.validator = validator
+        self.source = source
+        self.stall_timeout = float(stall_timeout or 0.0)
+        if validator is not None:
+            # content checks always; budget caps only when the spec is fixed
+            # (auto-built ladders/budgets are derived from the data below and
+            # fit every sample by construction)
+            worst = (
+                spec.specs[-1] if isinstance(spec, SpecLadder) else spec
+            )
+            graphs = validator.filter(
+                graphs,
+                source=source,
+                max_nodes=worst.n_nodes - 1 if worst is not None else None,
+                max_edges=worst.n_edges if worst is not None else None,
+            )
         self.graphs = graphs
         self.batch_size = batch_size
         self.num_shards = num_shards
@@ -371,7 +415,9 @@ class GraphLoader:
                     )
                     if top > int(max_in_degree):
                         raise ValueError(
-                            f"graph {gi} has in-degree {top} > max_in_degree "
+                            f"graph {gi} (dataset_id "
+                            f"{int(getattr(g, 'dataset_id', 0) or 0)}) has "
+                            f"in-degree {top} > max_in_degree "
                             f"{max_in_degree}; raise Architecture.max_in_degree "
                             "(the Pallas sorted-segment kernel would produce "
                             "unspecified sums for over-degree nodes)"
@@ -396,10 +442,45 @@ class GraphLoader:
             else None
         )
         self.epoch = 0
+        # mid-epoch resume (docs/ROBUSTNESS.md "Data plane"): start_batch
+        # skips the first k batches of the epoch WITHOUT building them — the
+        # epoch permutation is a pure function of (seed, epoch), so (epoch,
+        # cursor) is the loader's complete state and the remaining batches
+        # replay in exactly the order an unkilled run would have seen
+        self.start_batch = 0
+        self._resume: Optional[Tuple[int, int]] = None
 
     def set_epoch(self, epoch: int) -> None:
-        """Reseed the shuffle per epoch (DistributedSampler.set_epoch analog)."""
-        self.epoch = epoch
+        """Reseed the shuffle per epoch (DistributedSampler.set_epoch analog).
+
+        The first call after ``resume()`` keeps the armed (epoch, cursor)
+        instead — the resumed run's first training epoch replays the
+        interrupted epoch's tail; later calls behave normally."""
+        if self._resume is not None:
+            self.epoch, self.start_batch = self._resume
+            self._resume = None
+        else:
+            self.epoch = epoch
+            self.start_batch = 0
+
+    def resume(self, epoch: int, next_batch: int) -> None:
+        """Arm deterministic mid-epoch resume at (``epoch``, ``next_batch``):
+        applied immediately AND kept through the next ``set_epoch`` (the
+        training loop's per-epoch reseed), one-shot."""
+        self.epoch = int(epoch)
+        self.start_batch = int(next_batch)
+        self._resume = (int(epoch), int(next_batch))
+
+    def state_dict(self, next_batch: int = 0) -> Dict[str, int]:
+        """Loader state for checkpointing: the shuffle RNG is derived from
+        (seed, epoch), so these four ints fully determine the remaining
+        batch stream (train/checkpoint.py save_loader_state)."""
+        return {
+            "seed": int(self.seed),
+            "epoch": int(self.epoch),
+            "next_batch": int(next_batch),
+            "num_batches": int(len(self)),
+        }
 
     def __len__(self) -> int:
         if self.pack:
@@ -483,9 +564,27 @@ class GraphLoader:
             gn, ge = g.num_nodes, g.num_edges
             gt = int(trips[i]) if cap_t else 0
             if gn > cap_n or ge > cap_e or (cap_t and gt > cap_t):
+                if self.validator is not None:
+                    # warn_skip/quarantine: drop-and-count instead of killing
+                    # the run (dedup in the validator keeps the per-epoch
+                    # re-pack from inflating the tally); error policy raises
+                    # a BadSampleError naming the sample
+                    self.validator.reject(
+                        g, int(i), "budget_overflow", source=self.source,
+                        detail=(
+                            f"nodes={gn}, edges={ge}, triplets={gt} vs pack "
+                            f"budget {spec}"
+                        ),
+                    )
+                    continue
                 raise ValueError(
-                    f"graph {i} (nodes={gn}, edges={ge}) exceeds the pack "
-                    f"budget {spec}; pass a larger spec"
+                    f"graph {i} (dataset_id "
+                    f"{int(getattr(g, 'dataset_id', 0) or 0)}, nodes={gn}, "
+                    f"edges={ge}"
+                    + (f", triplets={gt}" if cap_t else "")
+                    + f") exceeds the pack budget {spec}; pass a larger spec "
+                    "or set Dataset.bad_sample_policy to warn_skip/quarantine "
+                    "to drop oversized samples"
                 )
             if cur and (
                 n + gn > cap_n
@@ -554,21 +653,25 @@ class GraphLoader:
         return np.concatenate([head, tail])
 
     def _batches(self) -> Iterator[GraphBatch]:
+        # mid-epoch resume: the first ``start_batch`` batches of the epoch
+        # are skipped WITHOUT being built (the index stream is deterministic
+        # in (seed, epoch), so slicing the batch sequence is exact)
+        start = max(int(self.start_batch), 0)
         if self.pack:
-            yield from self._packed_batches()
+            yield from self._packed_batches(start)
             return
         idx = self._local_indices()
         if self.size_bucketing and len(idx) > self.batch_size:
             idx = self._bucket_order(idx)
         bs = self.batch_size
         n_full = len(idx) // bs
-        for b in range(n_full):
+        for b in range(start, n_full):
             yield self._make([self.graphs[i] for i in idx[b * bs : (b + 1) * bs]])
         rem = len(idx) - n_full * bs
-        if rem and not self.drop_last:
+        if rem and not self.drop_last and start <= n_full:
             yield self._make([self.graphs[i] for i in idx[n_full * bs :]])
 
-    def _packed_batches(self) -> Iterator[GraphBatch]:
+    def _packed_batches(self, start: int = 0) -> Iterator[GraphBatch]:
         # multi-host: stop at the globally agreed count so every host issues
         # the same number of (collective-bearing) steps
         groups, limit = self._pack_state()
@@ -580,6 +683,8 @@ class GraphLoader:
                 if emitted >= limit:
                     return
                 emitted += 1
+                if emitted <= start:
+                    continue
                 yield batch_graphs(
                     [self.graphs[i] for i in grp],
                     self.spec,
@@ -593,6 +698,8 @@ class GraphLoader:
             ):
                 return
             emitted += 1
+            if emitted <= start:
+                continue
             yield self._make_stacked(
                 [[self.graphs[i] for i in grp] for grp in chunk], self.spec
             )
@@ -605,9 +712,12 @@ class GraphLoader:
         import queue
         import threading
 
+        from ..utils import faultinject
+
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
-        _END, _ERR = object(), object()
+        _END, _ERR, _NOTSET = object(), object(), object()
+        epoch_start = int(self.start_batch)
 
         def put_or_stop(item) -> bool:
             while not stop.is_set():
@@ -620,7 +730,11 @@ class GraphLoader:
 
         def producer():
             try:
-                for batch in self._batches():
+                for k, batch in enumerate(self._batches()):
+                    # chaos hooks (exact no-ops unarmed): a producer wedged
+                    # in a slow build, or dead without its sentinel
+                    if faultinject.maybe_loader_fault(epoch_start + k) == "die":
+                        return
                     if not put_or_stop(batch):
                         return
                 put_or_stop(_END)
@@ -629,17 +743,72 @@ class GraphLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        # exposed for tests asserting the thread is reaped after errors/break
+        self._producer_thread = t
+        timeout = float(self.stall_timeout or 0.0)
+        delivered = 0
         try:
             while True:
-                item = q.get()
+                # timed wait + liveness watchdog instead of a bare blocking
+                # get: a producer that died without the sentinel, or one
+                # stalled past ``stall_timeout``, raises an actionable error
+                # instead of hanging the run forever
+                item = _NOTSET
+                waited = 0.0
+                while item is _NOTSET:
+                    try:
+                        item = q.get(timeout=_WATCHDOG_TICK_S)
+                    except queue.Empty:
+                        if not t.is_alive():
+                            # the producer may have published a final item
+                            # between our timeout and the liveness check
+                            try:
+                                item = q.get_nowait()
+                                break
+                            except queue.Empty:
+                                raise LoaderStallError(
+                                    "prefetch producer thread exited without "
+                                    "an end-of-epoch sentinel after batch "
+                                    f"{epoch_start + delivered - 1} (epoch "
+                                    f"{self.epoch}); the worker died outside "
+                                    "python (or was killed) — restarting the "
+                                    "epoch is required"
+                                ) from None
+                        waited += _WATCHDOG_TICK_S
+                        if timeout and waited >= timeout:
+                            raise LoaderStallError(
+                                "prefetch producer produced nothing for "
+                                f"{waited:.1f}s (> loader_stall_timeout="
+                                f"{timeout}s) while building batch "
+                                f"{epoch_start + delivered} of epoch "
+                                f"{self.epoch}; the worker is wedged (hung "
+                                "fetch/filesystem?) — raise "
+                                "Training.loader_stall_timeout if batches "
+                                "legitimately take this long"
+                            ) from None
                 if item is _END:
                     break
                 if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
                     raise item[1]
+                delivered += 1
                 yield item
         finally:
             # abandoned mid-epoch (break / exception): release the producer
+            # and reap it with a bounded join — a producer blocked inside a
+            # slow batch build cannot observe ``stop`` until it finishes, so
+            # warn (daemon thread, leaked until process exit) instead of
+            # blocking teardown indefinitely
             stop.set()
+            t.join(timeout=_PRODUCER_JOIN_TIMEOUT_S)
+            if t.is_alive():
+                warnings.warn(
+                    "prefetch producer thread still alive "
+                    f"{_PRODUCER_JOIN_TIMEOUT_S}s after the epoch was "
+                    "abandoned (blocked in a batch build?); leaking the "
+                    "daemon thread",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
 
     def _make(self, graphs: List[Graph]) -> GraphBatch:
         with_trip = bool(self.spec.n_triplets)

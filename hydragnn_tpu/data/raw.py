@@ -220,19 +220,49 @@ def raw_sample_files(path: str) -> List[str]:
     )
 
 
-def load_raw_dataset(path: str, fmt: str, **loader_kwargs) -> List[Graph]:
+def load_raw_dataset(
+    path: str, fmt: str, on_error: str = "raise", **loader_kwargs
+) -> List[Graph]:
     """Load every raw file under ``path`` with the format's parser
     (reference: AbstractRawDataLoader.load_raw_data,
     preprocess/raw_dataset_loader.py:29-277). Raises when a directory mixes
     samples with and without graph targets — downstream normalization cannot
-    represent that."""
+    represent that.
+
+    ``on_error="skip"`` (wired from ``Dataset.bad_sample_policy`` by
+    api.prepare_data) drops files the parser cannot read — truncated or
+    garbled simulation outputs are routine in large raw dumps — warning
+    with the filename and a final tally instead of killing the run on the
+    first bad file."""
+    import warnings
+
+    if on_error not in ("raise", "skip"):
+        raise ValueError(f"on_error must be 'raise' or 'skip', got {on_error!r}")
     fmt = fmt.upper()
     loader = _LOADERS[fmt]
     graphs = []
+    skipped = []
     for name in raw_sample_files(path):
         if fmt in _EXTS and not name.lower().endswith(_EXTS[fmt]):
             continue
-        graphs.append(loader(os.path.join(path, name), **loader_kwargs))
+        try:
+            graphs.append(loader(os.path.join(path, name), **loader_kwargs))
+        except Exception as e:  # noqa: BLE001 — parser failure on one file
+            if on_error == "raise":
+                raise
+            skipped.append(name)
+            if len(skipped) <= 3:
+                warnings.warn(
+                    f"skipping unparseable {fmt} file {name!r}: "
+                    f"{type(e).__name__}: {e}",
+                    stacklevel=2,
+                )
+    if skipped:
+        warnings.warn(
+            f"{len(skipped)} of the {fmt} files under {path!r} failed to "
+            f"parse and were skipped (first: {skipped[:5]})",
+            stacklevel=2,
+        )
     with_y = [g.graph_y is not None for g in graphs]
     if any(with_y) and not all(with_y):
         missing = [i for i, w in enumerate(with_y) if not w][:5]

@@ -1,0 +1,276 @@
+"""Sample validation and quarantine — the ingest gate of the fault-tolerant
+data plane (docs/ROBUSTNESS.md "Data plane").
+
+The foundation-model workload streams tens of heterogeneous chemistry
+datasets through the loader (SURVEY §0, pillar 2); at that scale dirty
+samples are the common case, and a single NaN feature or out-of-range edge
+index must not kill a multi-day run *or* poison it silently (one NaN sample
+reaching ``MinMax.fit`` NaNs the normalization of every sample). This module
+provides:
+
+- ``validate_graph``: one sample -> rejection reason or None. Checks every
+  numeric channel for non-finite values (``Graph.float_channels`` is the
+  field census), edge indices for range/degeneracy (senders/receivers
+  outside ``[0, num_nodes)``, self-loop-only connectivity), empty graphs,
+  and optional node/edge pad-budget caps.
+- ``SampleValidator``: applies ``Dataset.bad_sample_policy`` to every
+  rejection — ``error`` raises a ``BadSampleError`` naming the sample,
+  ``warn_skip`` (default) drops it with a per-reason structured count,
+  ``quarantine`` additionally records it in a run-dir JSONL manifest
+  (``quarantine/manifest.jsonl``: index, dataset_id, reason, sizes) so the
+  bad samples are findable without a bisect. The per-reason tally is logged
+  by the epoch loop (train/loop.py) — silent data loss is impossible.
+
+Validation runs at *ingestion* (api.prepare_data filters the raw dataset
+before normalization/splitting) and again structurally at *batch* time (the
+pack-mode budget check in data/pipeline.py consults the same validator, so
+a budget-overflow graph is skipped-and-counted instead of killing the run).
+
+Exercised by fault injection (utils/faultinject.py:
+``HYDRAGNN_FAULT_SAMPLE_NAN`` / ``HYDRAGNN_FAULT_CORRUPT_SAMPLE``) in
+tests/test_data_plane.py and run-scripts/data_chaos_smoke.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .graph import Graph
+
+POLICIES = ("error", "warn_skip", "quarantine")
+
+# rejection reasons (the keys of the structured skip tally)
+R_NONFINITE = "nonfinite_features"  # any non-finite numeric channel
+R_BAD_EDGE = "bad_edge_index"  # sender/receiver outside [0, num_nodes)
+R_SELF_LOOP = "self_loop_only"  # every edge is a self loop
+R_EMPTY = "empty_graph"  # zero nodes
+R_BUDGET = "budget_overflow"  # exceeds the pad/pack budget
+R_CORRUPT = "corrupt_sample"  # bytes failed to deserialize
+
+
+class BadSampleError(ValueError):
+    """A sample failed validation under ``bad_sample_policy: error``."""
+
+
+class CorruptSampleError(ValueError):
+    """Stored sample bytes failed to deserialize (bit rot / torn write /
+    wire corruption). Raised by the blob-store datasets (data/ddstore.py)
+    with the store name and sample id, so the bad blob is findable."""
+
+
+def validate_graph(
+    g: Graph,
+    max_nodes: Optional[int] = None,
+    max_edges: Optional[int] = None,
+) -> Optional[str]:
+    """Return the rejection reason for ``g``, or None when it is clean.
+
+    Cheap and numpy-only (one ``isfinite`` reduction per channel); order is
+    most-diagnostic first, so a sample that is broken several ways reports
+    its most actionable defect."""
+    n = g.num_nodes
+    if n == 0:
+        return R_EMPTY
+    e = g.num_edges
+    if e:
+        s = np.asarray(g.senders, np.int64)
+        r = np.asarray(g.receivers, np.int64)
+        if int(s.min()) < 0 or int(r.min()) < 0 or int(s.max()) >= n or int(r.max()) >= n:
+            return R_BAD_EDGE
+        if bool(np.all(s == r)):
+            return R_SELF_LOOP
+    for _name, arr in g.float_channels():
+        if np.issubdtype(arr.dtype, np.floating) and not bool(
+            np.isfinite(arr).all()
+        ):
+            return R_NONFINITE
+    if max_nodes is not None and n > int(max_nodes):
+        return R_BUDGET
+    if max_edges is not None and e > int(max_edges):
+        return R_BUDGET
+    return None
+
+
+class SampleValidator:
+    """Policy + structured bookkeeping for rejected samples.
+
+    One validator instance spans a run's whole data plane (ingest filter +
+    every loader), so ``stats()`` is the run-level tally the epoch loop
+    logs. Rejections are deduplicated on (source, index, reason): batch-time
+    re-checks (the pack path re-packs every epoch) never inflate the counts
+    past the injection/ingest plan.
+    """
+
+    # individually reported rejects before falling back to the tally only
+    _VERBOSE_LIMIT = 3
+
+    def __init__(
+        self,
+        policy: str = "warn_skip",
+        quarantine_dir: Optional[str] = None,
+    ):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"bad_sample_policy {policy!r} must be one of {POLICIES}"
+            )
+        if policy == "quarantine" and quarantine_dir is None:
+            raise ValueError(
+                "bad_sample_policy 'quarantine' needs a quarantine_dir (the "
+                "run-dir manifest location)"
+            )
+        self.policy = policy
+        self.quarantine_dir = quarantine_dir
+        if policy == "quarantine":
+            # one validator spans one run: start a fresh manifest so the
+            # file always describes THIS run's quarantined samples (a stale
+            # manifest from a previous run over the same log name would
+            # silently double the apparent rejects)
+            try:
+                os.unlink(self.manifest_path)
+            except OSError:
+                pass
+        self.checked = 0
+        self.counts: Dict[str, int] = {}
+        self._seen = set()  # (source, index, reason) dedup
+        self._reported = 0
+
+    # -- manifest -----------------------------------------------------------
+    @property
+    def manifest_path(self) -> Optional[str]:
+        if self.quarantine_dir is None:
+            return None
+        return os.path.join(self.quarantine_dir, "manifest.jsonl")
+
+    def _quarantine(self, entry: Dict) -> None:
+        os.makedirs(self.quarantine_dir, exist_ok=True)
+        with open(self.manifest_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(entry) + "\n")
+            f.flush()
+
+    def set_quarantine_dir(self, quarantine_dir: str) -> None:
+        """Retarget the manifest location, carrying any already-written
+        entries along. api.prepare_data needs this: the validator is created
+        (and ingest rejects recorded) before config completion fills the
+        defaults the run name is derived from, so the final run-dir location
+        is only known later. Clears a stale manifest at the new location
+        first — fresh-run semantics hold wherever the manifest ends up."""
+        if quarantine_dir == self.quarantine_dir:
+            return
+        old = self.manifest_path
+        old_dir = self.quarantine_dir
+        self.quarantine_dir = quarantine_dir
+        if self.policy != "quarantine":
+            return
+        try:
+            os.unlink(self.manifest_path)
+        except OSError:
+            pass
+        if old and os.path.exists(old):
+            os.makedirs(self.quarantine_dir, exist_ok=True)
+            os.replace(old, self.manifest_path)
+            try:
+                os.rmdir(old_dir)
+            except OSError:
+                pass
+
+    # -- rejection ----------------------------------------------------------
+    def reject(self, g: Optional[Graph], index: int, reason: str,
+               source: str = "dataset", detail: str = "") -> None:
+        """Record (or raise, under ``error``) one rejected sample."""
+        ds_id = int(getattr(g, "dataset_id", 0) or 0) if g is not None else -1
+        if self.policy == "error":
+            raise BadSampleError(
+                f"sample {index} (dataset_id {ds_id}, source {source!r}) "
+                f"rejected: {reason}"
+                + (f" — {detail}" if detail else "")
+                + ". Set Dataset.bad_sample_policy to 'warn_skip' or "
+                "'quarantine' to drop bad samples instead of failing."
+            )
+        key = (source, int(index), reason)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.counts[reason] = self.counts.get(reason, 0) + 1
+        entry = {
+            "index": int(index),
+            "dataset_id": ds_id,
+            "reason": reason,
+            "source": source,
+            "num_nodes": g.num_nodes if g is not None else None,
+            "num_edges": g.num_edges if g is not None else None,
+        }
+        if detail:
+            entry["detail"] = detail
+        if self.policy == "quarantine":
+            self._quarantine(entry)
+        if self._reported < self._VERBOSE_LIMIT:
+            self._reported += 1
+            print(
+                f"[hydragnn_tpu.data] skipping bad sample {index} "
+                f"(dataset_id {ds_id}, source {source!r}): {reason}"
+                + (f" — {detail}" if detail else ""),
+                file=sys.stderr,
+            )
+
+    # -- checking / filtering ----------------------------------------------
+    def check(self, g: Graph, index: int, source: str = "dataset",
+              max_nodes: Optional[int] = None,
+              max_edges: Optional[int] = None) -> Optional[str]:
+        """Validate one sample; record the rejection per policy. Returns the
+        reason (the caller must skip the sample) or None (keep it)."""
+        self.checked += 1
+        reason = validate_graph(g, max_nodes=max_nodes, max_edges=max_edges)
+        if reason is not None:
+            self.reject(g, index, reason, source=source)
+        return reason
+
+    def filter(self, graphs: Sequence[Graph], source: str = "dataset",
+               max_nodes: Optional[int] = None,
+               max_edges: Optional[int] = None) -> List[Graph]:
+        """Drop every invalid sample of ``graphs`` (recording each), keeping
+        order. Indices in the tally/manifest are positions in ``graphs``."""
+        return [
+            g
+            for i, g in enumerate(graphs)
+            if self.check(g, i, source=source,
+                          max_nodes=max_nodes, max_edges=max_edges) is None
+        ]
+
+    # -- reporting ----------------------------------------------------------
+    @property
+    def skipped_total(self) -> int:
+        return sum(self.counts.values())
+
+    def stats(self) -> Dict:
+        """Structured loader stats: checked/skipped totals, the per-reason
+        skip counts, the active policy and manifest location."""
+        return {
+            "checked": self.checked,
+            "skipped": dict(self.counts),
+            "skipped_total": self.skipped_total,
+            "policy": self.policy,
+            "quarantine_manifest": (
+                self.manifest_path
+                if self.policy == "quarantine" and self.counts
+                else None
+            ),
+        }
+
+    def tally(self) -> str:
+        """One-line human tally for the epoch log."""
+        if not self.counts:
+            return "no skipped samples"
+        parts = ", ".join(
+            f"{k}={v}" for k, v in sorted(self.counts.items())
+        )
+        extra = (
+            f" (quarantine manifest: {self.manifest_path})"
+            if self.policy == "quarantine"
+            else ""
+        )
+        return f"{self.skipped_total} skipped [{parts}]{extra}"

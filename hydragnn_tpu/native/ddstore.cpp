@@ -350,9 +350,34 @@ void dds_serve_stop(void* server) {
   delete sv;
 }
 
-// Persistent client connection to a serving host. Returns nullptr on
-// connect failure.
-void* dds_connect(const char* host, int port) {
+namespace {
+
+void set_fd_timeout(int fd, int timeout_ms) {
+  // SO_RCVTIMEO/SO_SNDTIMEO make a blocked read/write (and, on Linux, a
+  // blocked connect via SNDTIMEO) fail with EAGAIN after the deadline;
+  // read_full/write_full then report a broken stream and the Python client
+  // reconnects — a server that accepts but never responds can no longer
+  // wedge the loader forever. 0 disables (historical blocking behavior).
+  if (timeout_ms <= 0) return;
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+}  // namespace
+
+// Apply send/receive timeouts (milliseconds; <= 0 leaves the socket
+// blocking) to an existing client connection.
+void dds_set_timeout(void* conn, int timeout_ms) {
+  set_fd_timeout(((Conn*)conn)->fd, timeout_ms);
+}
+
+// Persistent client connection to a serving host, with an optional
+// connect/IO timeout applied to the socket AT CREATION (timeout_ms <= 0 =
+// blocking, the historical behavior). Returns nullptr on connect failure.
+void* dds_connect_t(const char* host, int port, int timeout_ms) {
   addrinfo hints{}, *res = nullptr;
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
@@ -364,6 +389,7 @@ void* dds_connect(const char* host, int port) {
     freeaddrinfo(res);
     return nullptr;
   }
+  set_fd_timeout(fd, timeout_ms);  // bounds connect() too (SO_SNDTIMEO)
   if (connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
     close(fd);
     freeaddrinfo(res);
@@ -375,6 +401,10 @@ void* dds_connect(const char* host, int port) {
   Conn* c = new Conn;
   c->fd = fd;
   return c;
+}
+
+void* dds_connect(const char* host, int port) {
+  return dds_connect_t(host, port, 0);
 }
 
 // Fetch global id into the connection's scratch buffer. Returns the blob

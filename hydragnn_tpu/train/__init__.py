@@ -1,4 +1,11 @@
-from .checkpoint import load_existing_model, save_model, save_model_orbax
+from .checkpoint import (
+    clear_loader_state,
+    load_existing_model,
+    load_loader_state,
+    save_loader_state,
+    save_model,
+    save_model_orbax,
+)
 from .guard import NonFinitePolicy, guard_enabled, guarded_update, step_ok
 from .loop import (
     BestCheckpoint,
@@ -19,14 +26,18 @@ from .loss import (
     predict_energy_forces,
 )
 from .optimizer import ReduceLROnPlateau, make_optimizer
-from .state import TrainState
+from .state import LoaderState, TrainState
 
 __all__ = [
     "BestCheckpoint",
     "EarlyStopping",
     "NonFinitePolicy",
     "ReduceLROnPlateau",
+    "LoaderState",
     "TrainState",
+    "clear_loader_state",
+    "load_loader_state",
+    "save_loader_state",
     "guard_enabled",
     "guarded_update",
     "save_model_orbax",

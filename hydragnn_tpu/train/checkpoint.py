@@ -41,9 +41,10 @@ from typing import List, Optional
 from flax import serialization
 
 from ..utils import faultinject
-from .state import TrainState
+from .state import LoaderState, TrainState
 
 _EPOCH_RE = re.compile(r"_epoch(\d+)\.msgpack$")
+_LOADER_STATE_FILE = "loader_state.json"
 
 
 def _run_dir(log_name: str, path: str = "./logs") -> str:
@@ -238,6 +239,66 @@ def save_model_orbax(
             os.path.join(d, "latest"), f"orbax/{int(epoch)}".encode("utf-8")
         )
     return os.path.join(ckpt_dir, str(int(epoch)))
+
+
+def save_loader_state(
+    state: LoaderState, log_name: str, path: str = "./logs"
+) -> str:
+    """Publish the loader-position sidecar (``loader_state.json``) beside
+    the TrainState checkpoint — the mid-epoch-resume record (docs/
+    ROBUSTNESS.md "Data plane"). Written with the same atomic tmp+fsync+
+    replace protocol as every other checkpoint file; the training loop
+    writes it AFTER the model save of a mid-epoch preemption stop, and any
+    epoch-boundary save clears it (``clear_loader_state``), so a present
+    sidecar always describes the committed checkpoint. Rank-gated like the
+    msgpack save."""
+    import json
+
+    import jax
+
+    if jax.process_index() != 0:
+        return ""
+    d = _run_dir(log_name, path)
+    fname = os.path.join(d, _LOADER_STATE_FILE)
+    atomic_write(fname, json.dumps(state.to_dict()).encode("utf-8"))
+    return fname
+
+
+def load_loader_state(
+    log_name: str, path: str = "./logs"
+) -> Optional[LoaderState]:
+    """Read the loader-position sidecar of a run, or None when the run
+    stopped at an epoch boundary (no mid-epoch resume needed). A malformed
+    sidecar degrades to epoch-granularity resume with a warning — it must
+    never block the (far more valuable) model restore."""
+    import json
+
+    fname = os.path.join(path, log_name, _LOADER_STATE_FILE)
+    if not os.path.exists(fname):
+        return None
+    try:
+        with open(fname, encoding="utf-8") as f:
+            return LoaderState.from_dict(json.load(f))
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        warnings.warn(
+            f"loader-state sidecar {fname} is unreadable ({e}); resuming at "
+            "epoch granularity instead of mid-epoch",
+            stacklevel=2,
+        )
+        return None
+
+
+def clear_loader_state(log_name: str, path: str = "./logs") -> None:
+    """Remove the loader-position sidecar (epoch-boundary saves make the
+    mid-epoch cursor stale). Missing file is fine; rank-gated."""
+    import jax
+
+    if jax.process_index() != 0:
+        return
+    try:
+        os.unlink(os.path.join(path, log_name, _LOADER_STATE_FILE))
+    except OSError:
+        pass
 
 
 def _verified_read(full: str, tried: List[str]) -> Optional[bytes]:

@@ -15,6 +15,7 @@ TPU re-design of the reference's ``train_validate_test``/``train``/``validate``
 from __future__ import annotations
 
 import os
+import sys
 import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -261,7 +262,17 @@ def _maybe_device_prefetch(iterator):
     return device_prefetch(iterator, depth=depth)
 
 
-def train_epoch(loader, step_fn, state, rng):
+def train_epoch(loader, step_fn, state, rng, start_batch: int = 0):
+    """One training epoch. Returns ``(state, tot, tasks, rng, cursor)``:
+    ``cursor`` is None when the epoch completed, or the next-batch offset
+    (loader-absolute) when a SIGTERM arrived between steps — the mid-epoch
+    preemption stop (single-process only: the per-step flag check cannot be
+    agreed across hosts without a per-step collective, so multi-host runs
+    keep the epoch-boundary stop). ``start_batch`` fast-forwards a loader
+    WITHOUT native resume support by consuming (not stepping) its first
+    batches; loaders that implement ``resume()`` skip building them
+    entirely and report their offset via ``start_batch`` attribute."""
+    from ..utils import preemption
     from ..utils import tracer as tr
 
     # Device-side loss bookkeeping: the per-step (loss, tasks) scalars stay
@@ -271,6 +282,12 @@ def train_epoch(loader, step_fn, state, rng):
     # serialize the pipeline — the reference tolerates this because torch
     # .item() overlaps with DDP bucket comms, XLA does not).
     entries = []
+    # the loader may already skip batches itself (GraphLoader.resume);
+    # cursor values reported to checkpoints are absolute within the epoch
+    offset = int(getattr(loader, "start_batch", 0) or 0)
+    check_preempt = jax.process_count() == 1
+    cursor = None
+    consumed = 0
     it = _maybe_device_prefetch(iter(loader))
     for i in range(len(loader)):
         # dataload span covers host batching + H2D staging (the reference's
@@ -283,6 +300,9 @@ def train_epoch(loader, step_fn, state, rng):
             tr.stop("dataload")
             break
         tr.stop("dataload")
+        consumed += 1
+        if i < start_batch:
+            continue  # fast-forward (mid-epoch resume on a generic loader)
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
         state, tot, tasks = step_fn(state, batch, sub)
@@ -291,6 +311,12 @@ def train_epoch(loader, step_fn, state, rng):
         n = int(np.asarray(batch.graph_mask).sum())
         tr.stop("train_step")
         entries.append((tot, tasks, n))
+        if check_preempt and preemption.preempted():
+            # SIGTERM between steps: stop HERE and let the loop checkpoint
+            # state + loader cursor, so resume replays exactly the batches
+            # this epoch never stepped (docs/ROBUSTNESS.md "Data plane")
+            cursor = offset + consumed
+            break
         max_batches = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
         if max_batches is not None and i + 1 >= int(max_batches):
             break
@@ -308,7 +334,7 @@ def train_epoch(loader, step_fn, state, rng):
     if finite and len(finite) < len(entries):
         entries = finite
     tot, tasks = _weighted_avg(entries)
-    return state, tot, tasks, rng
+    return state, tot, tasks, rng, cursor
 
 
 def evaluate(loader, eval_fn, state):
@@ -376,6 +402,7 @@ def train_validate_test(
     step_fn: Optional[Callable] = None,
     eval_fn: Optional[Callable] = None,
     restore_fn: Optional[Callable[[TrainState], TrainState]] = None,
+    loader_state_fn: Optional[Callable[[Dict[str, int]], None]] = None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Outer epoch loop (reference: train_validate_test.py:52-264).
 
@@ -386,6 +413,9 @@ def train_validate_test(
     api.py). ``restore_fn`` (template_state -> restored state) is the
     rollback path of ``Training.non_finite_policy: rollback`` — api.py
     wires it to the verified-checkpoint restore with mesh re-placement.
+    ``loader_state_fn`` persists the loader cursor dict of a MID-epoch
+    preemption stop (api.py wires it to ``save_loader_state``); without it
+    a mid-epoch SIGTERM still checkpoints, at epoch-replay granularity.
     """
     training = config["NeuralNetwork"]["Training"]
     num_epoch = training["num_epoch"]
@@ -464,6 +494,9 @@ def train_validate_test(
     # steady state. The plateau scheduler only engages after the ramp.
     warmup_epochs = int(training.get("warmup_epochs", 0))
     base_lr = float(state.learning_rate)
+    # data-plane skip tally dedup: log at the epoch boundary only when the
+    # run-level count changed (ingest skips report once, at epoch 0)
+    reported_skips = 0
     try:
         for epoch in range(num_epoch):
             t0 = time.time()
@@ -476,10 +509,58 @@ def train_validate_test(
             profiler.epoch_begin(epoch)
             train_loader.set_epoch(epoch)
             with tr.timer("train"):
-                state, tr_loss, tr_tasks, rng = train_epoch(
+                state, tr_loss, tr_tasks, rng, cursor = train_epoch(
                     train_loader, step_fn, state, rng
                 )
             hist["train"].append(tr_loss)
+            # data-plane skip tally (data/validate.py): whenever the run's
+            # validator has dropped samples, say so at the epoch boundary —
+            # silent data loss is not an option (docs/ROBUSTNESS.md)
+            sval = getattr(train_loader, "validator", None)
+            if sval is not None and sval.skipped_total != reported_skips:
+                reported_skips = sval.skipped_total
+                print(
+                    f"[{log_name}] epoch {epoch}: data-plane skips: "
+                    f"{sval.tally()}",
+                    file=sys.stderr,
+                )
+            if cursor is not None:
+                # SIGTERM between steps: checkpoint state + loader cursor
+                # NOW (the grace window is ticking — no val/test, no policy
+                # pass) and stop; Training.continue replays the remaining
+                # batches of THIS epoch in the same order (api.py wires
+                # loader_state_fn -> save_loader_state). hist stays
+                # rectangular: the partial epoch's train loss stands in for
+                # the never-run val/test, like the HYDRAGNN_VALTEST=0 path.
+                hist["val"].append(tr_loss)
+                hist["test"].append(tr_loss)
+                hist["lr"].append(state.learning_rate)
+                preemption.note_global_stop()
+                if save_fn is not None:
+                    save_fn(state, epoch)
+                    if loader_state_fn is not None:
+                        # GraphLoader owns the record shape (state_dict);
+                        # generic loaders fall back to the same four fields
+                        if hasattr(train_loader, "state_dict"):
+                            sd = train_loader.state_dict(int(cursor))
+                        else:
+                            sd = {
+                                "epoch": int(
+                                    getattr(train_loader, "epoch", epoch)
+                                ),
+                                "next_batch": int(cursor),
+                                "seed": int(
+                                    getattr(train_loader, "seed", 0) or 0
+                                ),
+                                "num_batches": int(len(train_loader)),
+                            }
+                        loader_state_fn(sd)
+                if verbosity > 0:
+                    print(
+                        f"[{log_name}] SIGTERM: checkpointed mid-epoch "
+                        f"{epoch} at batch {cursor}, stopping"
+                    )
+                break
             # non-finite-step policy: warn/raise/rollback BEFORE val/test so
             # a rollback epoch evaluates the restored state, not a stale one
             rollbacks_before = nf_policy.rollbacks_done

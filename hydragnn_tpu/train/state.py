@@ -1,7 +1,9 @@
-"""Training state pytree: params, batch stats, optimizer state, step."""
+"""Training state pytree: params, batch stats, optimizer state, step —
+plus the loader-state record serialized beside it for mid-epoch resume."""
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -47,3 +49,37 @@ class TrainState:
         hp = dict(self.opt_state.hyperparams)
         hp["learning_rate"] = jax.numpy.asarray(lr, dtype=jax.numpy.float32)
         return self.replace(opt_state=self.opt_state._replace(hyperparams=hp))
+
+
+@dataclasses.dataclass(frozen=True)
+class LoaderState:
+    """Sampler/loader position serialized beside the TrainState checkpoint
+    (train/checkpoint.py ``save_loader_state``) so a preempted run resumes
+    MID-epoch instead of replaying from the epoch boundary.
+
+    The loader's shuffle RNG is a pure function of (seed, epoch)
+    (data/pipeline.GraphLoader._global_indices), so this record is the
+    loader's complete state: resuming at (epoch, next_batch) replays the
+    remaining batches in exactly the order the interrupted epoch would have
+    produced. ``seed``/``num_batches`` are consistency guards — a resume
+    against a different recipe (changed seed, dataset, or batch size) is
+    detected and the record ignored with a warning instead of silently
+    replaying the wrong stream.
+    """
+
+    epoch: int
+    next_batch: int
+    seed: int = 0
+    num_batches: int = 0
+
+    def to_dict(self) -> Dict[str, int]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "LoaderState":
+        return LoaderState(
+            epoch=int(d["epoch"]),
+            next_batch=int(d["next_batch"]),
+            seed=int(d.get("seed", 0)),
+            num_batches=int(d.get("num_batches", 0)),
+        )

@@ -26,6 +26,26 @@ in-process tests):
   first n calls per point name (per process), then succeeds — the transient
   flaky-FS model the checkpoint writer's retry loop must absorb.
 
+Data-plane points (docs/ROBUSTNESS.md "Data plane"):
+
+- ``HYDRAGNN_FAULT_SAMPLE_NAN``: ``poison_samples`` NaNs the first feature
+  of the dataset samples at the listed indices (``"3"`` / ``"3,7"``) — the
+  dirty-ingest model the sample validator must catch, with per-reason skip
+  counts matching the injection plan exactly.
+- ``HYDRAGNN_FAULT_CORRUPT_SAMPLE``: ``corrupt_blob`` flips the leading
+  byte of the listed sample ids' serialized bytes on fetch, so
+  deserialization fails deterministically (DistDataset's corrupt-sample
+  error path).
+- ``HYDRAGNN_FAULT_SOCKET_DROP``: ``maybe_socket_drop`` raises
+  ConnectionError on the listed call numbers per point (``"2"`` = the 2nd
+  call) — the transient-connection model RemoteStoreClient's
+  reconnect/backoff loop must absorb with zero sample loss.
+- ``HYDRAGNN_FAULT_LOADER_STALL`` (``"k"`` or ``"k:secs"``) /
+  ``HYDRAGNN_FAULT_LOADER_DIE`` (``"k"``): ``maybe_loader_fault`` makes the
+  prefetch producer sleep before batch k, or exit silently without its end
+  sentinel — the wedged/dead-worker models the loader watchdog turns into
+  an actionable LoaderStallError.
+
 ``flip_bit`` is the host-side corruption tool for the torn/rotted-checkpoint
 tests: flip one bit of a saved file and assert restore falls back to the
 previous verified epoch.
@@ -40,6 +60,8 @@ from typing import Dict, Optional
 # per-point counters for maybe_ioerror (per process — checkpoint saves run
 # in-process, so a counter here is exactly "the first n attempts")
 _io_error_counts: Dict[str, int] = {}
+# per-point call counters for maybe_socket_drop ("drop on the nth call")
+_socket_call_counts: Dict[str, int] = {}
 # configure() overrides; env wins when both are set
 _config: Dict[str, str] = {}
 
@@ -53,6 +75,11 @@ def configure(**kwargs: Optional[str]) -> None:
         "nan_lr_gt": "HYDRAGNN_FAULT_NAN_LR_GT",
         "kill_at": "HYDRAGNN_FAULT_KILL_AT",
         "io_errors": "HYDRAGNN_FAULT_IO_ERRORS",
+        "sample_nan": "HYDRAGNN_FAULT_SAMPLE_NAN",
+        "corrupt_sample": "HYDRAGNN_FAULT_CORRUPT_SAMPLE",
+        "socket_drop": "HYDRAGNN_FAULT_SOCKET_DROP",
+        "loader_stall": "HYDRAGNN_FAULT_LOADER_STALL",
+        "loader_die": "HYDRAGNN_FAULT_LOADER_DIE",
     }
     for k, v in kwargs.items():
         if k not in keymap:
@@ -64,9 +91,10 @@ def configure(**kwargs: Optional[str]) -> None:
 
 
 def reset() -> None:
-    """Clear configure() state and the per-point IO-error counters."""
+    """Clear configure() state and the per-point counters."""
     _config.clear()
     _io_error_counts.clear()
+    _socket_call_counts.clear()
 
 
 def _get(key: str) -> Optional[str]:
@@ -148,6 +176,86 @@ def maybe_ioerror(point: str) -> None:
             f"injected transient IO error {done + 1}/{n} at {point!r} "
             "(HYDRAGNN_FAULT_IO_ERRORS)"
         )
+
+
+def _index_set(spec: Optional[str]) -> set:
+    """Parse a comma-separated index list spec (``"3"`` / ``"3,7"``)."""
+    if not spec:
+        return set()
+    return {int(k) for k in spec.split(",") if k.strip()}
+
+
+def poison_samples(graphs):
+    """Dataset-ingest corruption: return ``graphs`` with the first feature of
+    every armed index (HYDRAGNN_FAULT_SAMPLE_NAN, ``"3,7"``) replaced by NaN.
+    No-op (the same list object) when unarmed. The dirty-data model the
+    sample validator must catch — each poisoned sample must show up as
+    exactly one ``nonfinite_features`` skip."""
+    spec = _get("HYDRAGNN_FAULT_SAMPLE_NAN")
+    idxs = _index_set(spec)
+    if not idxs:
+        return graphs
+    import dataclasses
+
+    import numpy as np
+
+    out = list(graphs)
+    for i in idxs:
+        if 0 <= i < len(out):
+            x = np.array(out[i].x, dtype=np.float32, copy=True)
+            x.flat[0] = np.nan
+            out[i] = dataclasses.replace(out[i], x=x)
+    return out
+
+
+def corrupt_blob(blob: bytes, idx: int) -> bytes:
+    """Fetched-bytes corruption: when ``idx`` is armed
+    (HYDRAGNN_FAULT_CORRUPT_SAMPLE), flip the leading byte so
+    deserialization fails deterministically (a pickle stream never survives
+    a mangled protocol opcode). Returns ``blob`` unchanged otherwise."""
+    if idx not in _index_set(_get("HYDRAGNN_FAULT_CORRUPT_SAMPLE")):
+        return blob
+    if not blob:
+        return blob
+    return bytes([blob[0] ^ 0xFF]) + blob[1:]
+
+
+def maybe_socket_drop(point: str) -> None:
+    """Raise ConnectionError on the armed call numbers for ``point``
+    (HYDRAGNN_FAULT_SOCKET_DROP, 1-based: ``"2"`` drops the 2nd call,
+    ``"1,3"`` the 1st and 3rd) — the transient-connection model the remote
+    store client's reconnect/backoff loop must absorb."""
+    spec = _get("HYDRAGNN_FAULT_SOCKET_DROP")
+    if spec is None:
+        return
+    call = _socket_call_counts.get(point, 0) + 1
+    _socket_call_counts[point] = call
+    if call in _index_set(spec):
+        raise ConnectionError(
+            f"injected socket drop on call {call} at {point!r} "
+            "(HYDRAGNN_FAULT_SOCKET_DROP)"
+        )
+
+
+def maybe_loader_fault(batch_index: int) -> Optional[str]:
+    """Prefetch-producer fault hook, called before building batch
+    ``batch_index``. Returns ``"die"`` when the producer must exit silently
+    without its end sentinel (HYDRAGNN_FAULT_LOADER_DIE = ``"k"``); sleeps
+    in place for the armed stall (HYDRAGNN_FAULT_LOADER_STALL = ``"k"`` or
+    ``"k:secs"``, default 60s — longer than any sane watchdog timeout) and
+    returns None. Both model a wedged/dead loader worker the watchdog must
+    turn into an actionable error instead of a silent hang."""
+    die = _get("HYDRAGNN_FAULT_LOADER_DIE")
+    if die is not None and batch_index in _index_set(die):
+        return "die"
+    stall = _get("HYDRAGNN_FAULT_LOADER_STALL")
+    if stall is not None:
+        k, _, secs = stall.partition(":")
+        if int(k) == batch_index:
+            import time
+
+            time.sleep(float(secs) if secs else 60.0)
+    return None
 
 
 def flip_bit(path: str, byte_offset: Optional[int] = None, bit: int = 0) -> int:

@@ -40,6 +40,9 @@ BENCH_GUARD_SMOKE=1 python bench.py
 echo "== chaos resume smoke (SIGTERM mid-run -> Training.continue round-trip) =="
 python run-scripts/chaos_smoke.py
 
+echo "== data-plane chaos smoke (NaN samples/skip tally, error policy, socket drops, mid-epoch kill+resume order) =="
+python run-scripts/data_chaos_smoke.py
+
 echo "== multichip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

@@ -1,0 +1,664 @@
+"""Fault-tolerant data plane (docs/ROBUSTNESS.md "Data plane"): sample
+validation/quarantine policies, the prefetch stall watchdog, prefetch error
+propagation, and deterministic mid-epoch resume — every path exercised
+through the deterministic injection points of utils/faultinject.py, the way
+tests/test_faults.py exercises the step guard."""
+
+import dataclasses
+import json
+import os
+import signal
+import warnings
+
+import numpy as np
+import pytest
+
+import hydragnn_tpu.data.pipeline as pipeline_mod
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    BadSampleError,
+    GraphLoader,
+    LoaderStallError,
+    MinMax,
+    PadSpec,
+    SampleValidator,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+    validate_graph,
+)
+from hydragnn_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+@pytest.fixture(autouse=True)
+def _fast_watchdog(monkeypatch):
+    # keep teardown joins short so the leak-warning tests don't sleep
+    monkeypatch.setattr(pipeline_mod, "_PRODUCER_JOIN_TIMEOUT_S", 0.5)
+
+
+def _graphs(n=20, seed=1):
+    return deterministic_graph_dataset(n, seed=seed)
+
+
+def _nan_x(g):
+    x = np.array(g.x, np.float32, copy=True)
+    x.flat[0] = np.nan
+    return dataclasses.replace(g, x=x)
+
+
+# ---------------------------------------------------------------------------
+# validate_graph: one reason per defect class
+def pytest_validate_graph_reasons():
+    g = _graphs(1)[0]
+    assert validate_graph(g) is None
+    assert validate_graph(_nan_x(g)) == "nonfinite_features"
+    pos = np.array(g.pos, np.float32, copy=True)
+    pos[0, 0] = np.inf
+    assert validate_graph(dataclasses.replace(g, pos=pos)) == "nonfinite_features"
+    # graph-level target NaN is caught too (float_channels covers targets)
+    assert (
+        validate_graph(
+            dataclasses.replace(g, graph_y=np.asarray([np.nan], np.float32))
+        )
+        == "nonfinite_features"
+    )
+    # out-of-range / negative edge indices
+    bad = np.array(g.senders, copy=True)
+    bad[0] = g.num_nodes + 3
+    assert validate_graph(dataclasses.replace(g, senders=bad)) == "bad_edge_index"
+    bad = np.array(g.receivers, copy=True)
+    bad[0] = -1
+    assert validate_graph(dataclasses.replace(g, receivers=bad)) == "bad_edge_index"
+    # self-loop-only connectivity
+    loops = np.arange(min(g.num_nodes, g.num_edges), dtype=np.int32)
+    assert (
+        validate_graph(
+            dataclasses.replace(g, senders=loops, receivers=loops.copy())
+        )
+        == "self_loop_only"
+    )
+    # empty graph
+    empty = dataclasses.replace(
+        g,
+        x=np.zeros((0, g.x.shape[1]), np.float32),
+        pos=np.zeros((0, 3), np.float32),
+        senders=np.zeros((0,), np.int32),
+        receivers=np.zeros((0,), np.int32),
+        z=None,
+    )
+    assert validate_graph(empty) == "empty_graph"
+    # budget overflow only when caps are given
+    assert validate_graph(g, max_nodes=g.num_nodes - 1) == "budget_overflow"
+    assert validate_graph(g, max_edges=g.num_edges - 1) == "budget_overflow"
+    assert validate_graph(g, max_nodes=g.num_nodes, max_edges=g.num_edges) is None
+
+
+def pytest_validator_policies(tmp_path):
+    gs = _graphs(8)
+    gs[2] = _nan_x(gs[2])
+    gs[5] = _nan_x(dataclasses.replace(gs[5], dataset_id=3))
+
+    # error: raises naming the sample index and dataset_id
+    with pytest.raises(BadSampleError, match=r"sample 2 \(dataset_id 0"):
+        SampleValidator("error").filter(gs, source="ingest")
+
+    # warn_skip: drops with per-reason counts
+    v = SampleValidator("warn_skip")
+    kept = v.filter(gs, source="ingest")
+    assert len(kept) == 6
+    assert v.stats()["skipped"] == {"nonfinite_features": 2}
+    assert v.checked == 8
+    # dedup: re-checking the same (source, index, reason) never re-counts
+    v.reject(gs[2], 2, "nonfinite_features", source="ingest")
+    assert v.skipped_total == 2
+
+    # quarantine: manifest rows carry index + dataset_id + reason
+    q = SampleValidator("quarantine", quarantine_dir=str(tmp_path / "q"))
+    kept = q.filter(gs, source="ingest")
+    assert len(kept) == 6
+    rows = [
+        json.loads(l)
+        for l in open(q.manifest_path, encoding="utf-8").read().splitlines()
+    ]
+    assert [(r["index"], r["dataset_id"], r["reason"]) for r in rows] == [
+        (2, 0, "nonfinite_features"),
+        (5, 3, "nonfinite_features"),
+    ]
+    assert q.stats()["quarantine_manifest"] == q.manifest_path
+    # a fresh validator over the same run dir starts a fresh manifest —
+    # re-running a run must not append to (and double) the old file
+    q2 = SampleValidator("quarantine", quarantine_dir=str(tmp_path / "q"))
+    q2.filter(gs, source="ingest")
+    rows2 = open(q2.manifest_path, encoding="utf-8").read().splitlines()
+    assert len(rows2) == 2
+    # the policy gate itself rejects a missing manifest dir
+    with pytest.raises(ValueError, match="quarantine_dir"):
+        SampleValidator("quarantine")
+    with pytest.raises(ValueError, match="bad_sample_policy"):
+        SampleValidator("nonsense")
+
+
+def pytest_set_quarantine_dir_moves_manifest(tmp_path):
+    # api.prepare_data learns the completed run name only after config
+    # completion: retargeting must carry ingest-time entries to the real
+    # run dir and clear any stale manifest already there
+    gs = _graphs(8)
+    gs[2] = _nan_x(gs[2])
+    stale = tmp_path / "real" / "manifest.jsonl"
+    stale.parent.mkdir(parents=True)
+    stale.write_text('{"index": 99, "reason": "stale"}\n')
+    v = SampleValidator("quarantine", quarantine_dir=str(tmp_path / "early"))
+    v.filter(gs, source="ingest")
+    v.set_quarantine_dir(str(tmp_path / "real"))
+    rows = [
+        json.loads(l)
+        for l in open(v.manifest_path, encoding="utf-8").read().splitlines()
+    ]
+    assert [(r["index"], r["reason"]) for r in rows] == [
+        (2, "nonfinite_features")
+    ]
+    assert not (tmp_path / "early").exists()  # moved, old dir cleaned up
+    # later rejects land in the new manifest
+    v.reject(gs[3], 3, "budget_overflow", source="train")
+    assert len(open(v.manifest_path, encoding="utf-8").read().splitlines()) == 2
+
+
+# ---------------------------------------------------------------------------
+# loader integration
+def pytest_loader_filters_bad_samples_and_clean_data_is_bit_identical():
+    gs = _graphs(20)
+    dirty = list(gs)
+    dirty[3] = _nan_x(dirty[3])
+    v = SampleValidator("warn_skip")
+    loader = GraphLoader(dirty, 4, shuffle=True, seed=7, validator=v)
+    assert len(loader.graphs) == 19
+    assert v.stats()["skipped"] == {"nonfinite_features": 1}
+    list(loader)  # iterates fine without the bad sample
+
+    # acceptance: a clean dataset through the validated loader is
+    # BIT-identical to the pre-validation loader (same batch order/content)
+    v2 = SampleValidator("warn_skip")
+    a = list(GraphLoader(gs, 4, shuffle=True, seed=7, validator=v2))
+    b = list(GraphLoader(gs, 4, shuffle=True, seed=7))
+    assert v2.skipped_total == 0
+    assert len(a) == len(b)
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ba.x), np.asarray(bb.x))
+        np.testing.assert_array_equal(
+            np.asarray(ba.senders), np.asarray(bb.senders)
+        )
+
+
+def pytest_injected_nan_samples_counted_exactly():
+    # the chaos-smoke contract: skip counts match the injection plan exactly
+    faultinject.configure(sample_nan="3,7")
+    gs = faultinject.poison_samples(_graphs(16))
+    v = SampleValidator("warn_skip")
+    kept = v.filter(gs, source="ingest")
+    assert len(kept) == 14
+    assert v.stats()["skipped"] == {"nonfinite_features": 2}
+
+
+def pytest_pack_budget_overflow_policies():
+    gs = _graphs(12)
+    sizes = [g.num_nodes for g in gs]
+    big_id = int(np.argmax(sizes))
+    n_over = sum(s == max(sizes) for s in sizes)
+    spec = PadSpec(
+        n_nodes=gs[big_id].num_nodes,  # cap_n = n_nodes-1 < biggest graph
+        n_edges=4096,
+        n_graphs=9,
+    )
+    # no validator: actionable raise naming index + dataset_id
+    loader = GraphLoader(gs, 4, spec=spec, pack=True, shuffle=False)
+    with pytest.raises(ValueError, match=rf"graph {big_id} \(dataset_id 0"):
+        list(loader)
+    # error policy through the validator: BadSampleError at loader build
+    # (the init-time budget filter fires before packing ever runs)
+    with pytest.raises(BadSampleError, match="budget_overflow"):
+        GraphLoader(
+            gs, 4, spec=spec, pack=True, shuffle=False,
+            validator=SampleValidator("error"),
+        )
+    # warn_skip: dropped-and-counted once, run completes, and the count is
+    # stable across epochs (dedup) — no silent loss, no inflation
+    v = SampleValidator("warn_skip")
+    loader = GraphLoader(
+        gs, 4, spec=spec, pack=True, shuffle=False, validator=v
+    )
+    for epoch in range(2):
+        loader.set_epoch(epoch)
+        assert len(list(loader)) == len(loader)
+    assert v.stats()["skipped"] == {"budget_overflow": n_over}
+
+
+# ---------------------------------------------------------------------------
+# prefetch error propagation (satellite): the ORIGINAL exception type
+# surfaces for prefetch>0 and prefetch=0, and the producer thread is reaped
+@pytest.mark.parametrize("prefetch", [0, 2])
+def pytest_prefetch_propagates_producer_exception(prefetch):
+    class Boom(RuntimeError):
+        pass
+
+    loader = GraphLoader(_graphs(12), 4, prefetch=prefetch, shuffle=False)
+    orig = loader._batches
+
+    def exploding():
+        it = orig()
+        yield next(it)
+        raise Boom("batch build failed")
+
+    loader._batches = exploding
+    with pytest.raises(Boom, match="batch build failed"):
+        list(loader)
+    t = getattr(loader, "_producer_thread", None)
+    if prefetch > 0:
+        assert t is not None
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+
+
+def pytest_abandoned_prefetch_iterator_reaps_producer():
+    loader = GraphLoader(_graphs(20), 4, prefetch=2, shuffle=False)
+    it = iter(loader)
+    next(it)
+    it.close()  # break mid-epoch: the finally must join the producer
+    t = loader._producer_thread
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# stall watchdog
+def pytest_watchdog_raises_on_stalled_producer_and_warns_on_leak():
+    faultinject.configure(loader_stall="1:3")  # wedge before batch 1 for 3s
+    loader = GraphLoader(_graphs(20), 4, prefetch=2, stall_timeout=0.3)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with pytest.raises(LoaderStallError, match="loader_stall_timeout"):
+            list(loader)
+    # the producer is wedged past the bounded teardown join -> leak warning
+    assert any("producer thread still alive" in str(x.message) for x in w)
+
+
+def pytest_watchdog_raises_on_dead_producer():
+    faultinject.configure(loader_die="1")  # exit silently, no sentinel
+    loader = GraphLoader(_graphs(20), 4, prefetch=2, stall_timeout=30)
+    with pytest.raises(LoaderStallError, match="without an end-of-epoch"):
+        list(loader)
+
+
+def pytest_watchdog_zero_timeout_disables_stall_clock():
+    # stall shorter than the producer's fault but timeout disabled: the
+    # liveness check alone must NOT fire for a slow-but-alive producer
+    faultinject.configure(loader_stall="1:0.4")
+    loader = GraphLoader(_graphs(8), 4, prefetch=2, stall_timeout=0)
+    assert len(list(loader)) == len(loader)
+
+
+# ---------------------------------------------------------------------------
+# deterministic mid-epoch resume
+@pytest.mark.parametrize("pack", [False, True])
+def pytest_resume_replays_remaining_batches_in_order(pack):
+    gs = _graphs(24)
+    kw = dict(shuffle=True, seed=5, pack=pack)
+    if pack:
+        kw["spec"] = PadSpec(n_nodes=256, n_edges=4096, n_graphs=9)
+    ref = GraphLoader(gs, 4, **kw)
+    ref.set_epoch(0)
+    full = list(ref)
+    assert len(full) >= 3
+    res = GraphLoader(gs, 4, **kw)
+    res.resume(0, 2)
+    res.set_epoch(0)  # the loop's reseed must keep the armed cursor
+    tail = list(res)
+    assert len(tail) == len(full) - 2
+    for ba, bb in zip(full[2:], tail):
+        np.testing.assert_array_equal(np.asarray(ba.x), np.asarray(bb.x))
+        np.testing.assert_array_equal(
+            np.asarray(ba.node_graph), np.asarray(bb.node_graph)
+        )
+    # one-shot: the next epoch is a normal full epoch, identical to ref's
+    res.set_epoch(1)
+    ref.set_epoch(1)
+    assert res.start_batch == 0
+    a, b = list(res), list(ref)
+    assert len(a) == len(b)
+    np.testing.assert_array_equal(np.asarray(a[0].x), np.asarray(b[0].x))
+
+
+def pytest_pack_resume_len_reflects_armed_epoch():
+    # pack-mode batch counts are epoch-dependent (greedy packing of each
+    # epoch's permutation); the api resume guard compares the sidecar's
+    # num_batches against len() AFTER arming, so it must see the count of
+    # the interrupted epoch, not epoch 0's
+    gs = _graphs(30)
+    kw = dict(
+        shuffle=True, seed=5, pack=True,
+        # near-critical node budget: greedy bin counts depend on the order
+        # sizes 2/4/8 arrive, i.e. on the epoch permutation
+        spec=PadSpec(n_nodes=24, n_edges=1024, n_graphs=4),
+    )
+    ref = GraphLoader(gs, 4, **kw)
+    lens = {}
+    for e in range(20):
+        ref.set_epoch(e)
+        lens[e] = len(ref)
+    other = next((e for e in lens if lens[e] != lens[0]), None)
+    if other is None:
+        pytest.skip("packing happened to yield equal counts for all epochs")
+    res = GraphLoader(gs, 4, **kw)
+    assert len(res) == lens[0]
+    res.resume(other, 1)
+    assert len(res) == lens[other]  # the guard comparison sees this
+    res.resume(0, 0)  # disarm path: back to a normal epoch-0 start
+    res.set_epoch(0)
+    assert res.start_batch == 0 and len(res) == lens[0]
+
+
+def pytest_loader_state_sidecar_roundtrip(tmp_path):
+    from hydragnn_tpu.train import (
+        LoaderState,
+        clear_loader_state,
+        load_loader_state,
+        save_loader_state,
+    )
+
+    st = LoaderState(epoch=4, next_batch=3, seed=7, num_batches=9)
+    save_loader_state(st, "runA", path=str(tmp_path))
+    got = load_loader_state("runA", path=str(tmp_path))
+    assert got == st
+    # malformed sidecar degrades to None with a warning, never raises
+    with open(tmp_path / "runA" / "loader_state.json", "w") as f:
+        f.write("{not json")
+    with pytest.warns(UserWarning, match="loader-state sidecar"):
+        assert load_loader_state("runA", path=str(tmp_path)) is None
+    # valid JSON with a null field (truncated/hand-edited) degrades too
+    with open(tmp_path / "runA" / "loader_state.json", "w") as f:
+        f.write('{"epoch": null, "next_batch": 0}')
+    with pytest.warns(UserWarning, match="loader-state sidecar"):
+        assert load_loader_state("runA", path=str(tmp_path)) is None
+    clear_loader_state("runA", path=str(tmp_path))
+    assert load_loader_state("runA", path=str(tmp_path)) is None
+    clear_loader_state("runA", path=str(tmp_path))  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# train_epoch: the preemption cursor and the generic start_batch offset
+def _fake_step(order):
+    import jax.numpy as jnp
+
+    def step(state, batch, rng):
+        order.append(int(np.asarray(batch.node_mask).sum()))
+        return state, jnp.float32(0.1), {}
+
+    return step
+
+
+def pytest_train_epoch_preemption_cursor_and_resume():
+    import jax
+
+    from hydragnn_tpu.train.loop import train_epoch
+    from hydragnn_tpu.utils import preemption
+
+    loader = GraphLoader(_graphs(24), 4, shuffle=True, seed=3)
+    loader.set_epoch(0)
+    ref_order = []
+    _, _, _, _, cursor = train_epoch(
+        loader, _fake_step(ref_order), None, jax.random.PRNGKey(0)
+    )
+    assert cursor is None and len(ref_order) == len(loader)
+
+    # SIGTERM after step 2 -> cursor 2, only 2 steps taken
+    preemption.install()
+    try:
+        order = []
+        seen = _fake_step(order)
+
+        def killing_step(state, batch, rng):
+            out = seen(state, batch, rng)
+            if len(order) == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return out
+
+        loader.set_epoch(0)
+        _, _, _, _, cursor = train_epoch(
+            loader, killing_step, None, jax.random.PRNGKey(0)
+        )
+        assert cursor == 2
+        assert order == ref_order[:2]
+    finally:
+        preemption.uninstall()
+        preemption.reset()
+
+    # resuming at the cursor replays exactly the rest, in order
+    res = GraphLoader(_graphs(24), 4, shuffle=True, seed=3)
+    res.resume(0, cursor)
+    res.set_epoch(0)
+    order = []
+    _, _, _, _, c2 = train_epoch(
+        res, _fake_step(order), None, jax.random.PRNGKey(0)
+    )
+    assert c2 is None
+    assert order == ref_order[cursor:]
+
+    # the generic start_batch path (loaders without native resume) agrees
+    plain = GraphLoader(_graphs(24), 4, shuffle=True, seed=3)
+    plain.set_epoch(0)
+    order = []
+    train_epoch(
+        plain, _fake_step(order), None, jax.random.PRNGKey(0), start_batch=2
+    )
+    assert order == ref_order[2:]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: SIGTERM between steps -> mid-epoch checkpoint + sidecar ->
+# Training.continue-style resume replays the remaining batches in the same
+# order an unkilled run produces (driven through train_validate_test
+# directly, the test_faults.py pattern)
+def _e2e_setup(tmp_path, num=24, batch_size=4):
+    import jax
+
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer
+
+    raw = deterministic_graph_dataset(num, seed=97)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "num_epoch": 2,
+                "Optimizer": {"type": "AdamW", "learning_rate": 1e-3},
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+    config = update_config(config, tr, va, te)
+    model = create_model(config)
+    mk = lambda graphs, shuffle, seed=0: GraphLoader(
+        graphs, batch_size, shuffle=shuffle, seed=seed
+    )
+    train_loader = mk(tr, True)
+    variables = init_model(model, next(iter(train_loader)), seed=0)
+    tx = make_optimizer({"type": "AdamW", "learning_rate": 1e-3})
+    state = TrainState.create(variables, tx)
+    return config, model, state, tx, (tr, va, te), mk
+
+
+def pytest_sigterm_mid_epoch_checkpoint_and_same_order_resume(tmp_path):
+    import jax
+
+    from hydragnn_tpu.train import (
+        LoaderState,
+        load_existing_model,
+        load_loader_state,
+        make_train_step,
+        save_loader_state,
+        save_model,
+        train_validate_test,
+    )
+    from hydragnn_tpu.train.loop import train_epoch
+
+    os.environ["HYDRAGNN_VALTEST"] = "0"
+    try:
+        config, model, state, tx, (tr, va, te), mk = _e2e_setup(tmp_path)
+        logdir = str(tmp_path)
+
+        # reference: the unkilled epoch-0 batch fingerprints
+        ref_loader = mk(tr, True)
+        ref_loader.set_epoch(0)
+        ref_order = [int(np.asarray(b.node_mask).sum()) for b in ref_loader]
+
+        order = []
+        base_step = make_train_step(model, tx)
+
+        def killing_step(s, b, r):
+            order.append(int(np.asarray(b.node_mask).sum()))
+            if len(order) == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+            return base_step(s, b, r)
+
+        train_loader = mk(tr, True)
+        state2, hist = train_validate_test(
+            model, state, tx, train_loader, mk(va, False), mk(te, False),
+            config, log_name="midkill", seed=0,
+            save_fn=lambda s, e=None: save_model(s, "midkill", path=logdir, epoch=e),
+            step_fn=killing_step,
+            loader_state_fn=lambda d: save_loader_state(
+                LoaderState.from_dict(d), "midkill", path=logdir
+            ),
+        )
+        # stopped mid-epoch 0 after 2 steps, checkpoint + sidecar written
+        assert len(hist["train"]) == 1
+        assert order == ref_order[:2]
+        ls = load_loader_state("midkill", path=logdir)
+        assert ls is not None and (ls.epoch, ls.next_batch) == (0, 2)
+        assert ls.num_batches == len(train_loader)
+
+        # resume: restore state + arm the loader; the replayed epoch must be
+        # exactly the unkilled epoch's remaining batches, then a normal epoch
+        from hydragnn_tpu.train import TrainState
+        from hydragnn_tpu.utils import preemption
+
+        preemption.reset()
+        template = state2  # same structure
+        restored = load_existing_model(template, "midkill", path=logdir)
+        res_loader = mk(tr, True)
+        res_loader.resume(ls.epoch, ls.next_batch)
+        order2 = []
+
+        def recording_step(s, b, r):
+            order2.append(int(np.asarray(b.node_mask).sum()))
+            return base_step(s, b, r)
+
+        _, hist2 = train_validate_test(
+            model, restored, tx, res_loader, mk(va, False), mk(te, False),
+            config, log_name="midkill_resume", seed=0,
+            step_fn=recording_step,
+        )
+        assert len(hist2["train"]) == 2  # replayed tail + one full epoch
+        assert order2[: len(ref_order) - 2] == ref_order[2:]
+    finally:
+        os.environ.pop("HYDRAGNN_VALTEST", None)
+
+
+# ---------------------------------------------------------------------------
+# raw-file parse robustness (satellite of the ingest gate)
+def pytest_raw_loader_skips_unparseable_files(tmp_path):
+    from hydragnn_tpu.data import load_raw_dataset
+
+    good = "2\n1.0\nH 0.0 0.0 0.0\nH 0.0 0.0 0.74\n"
+    (tmp_path / "a.xyz").write_text(good)
+    (tmp_path / "b.xyz").write_text("garbage that is not xyz\n")
+    with pytest.raises(Exception):
+        load_raw_dataset(str(tmp_path), "XYZ")
+    with pytest.warns(UserWarning, match="failed to parse"):
+        graphs = load_raw_dataset(str(tmp_path), "XYZ", on_error="skip")
+    assert len(graphs) == 1 and graphs[0].num_nodes == 2
+
+
+# ---------------------------------------------------------------------------
+# config surface
+def pytest_config_completion_validates_data_plane_keys():
+    raw = deterministic_graph_dataset(8, seed=97)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in MinMax.fit(raw).apply(raw)]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    base = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "hidden_dim": 4, "num_conv_layers": 1,
+                "output_heads": {"graph": {"num_sharedlayers": 1,
+                                           "dim_sharedlayers": 4,
+                                           "num_headlayers": 1,
+                                           "dim_headlayers": [4]}},
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0], "output_names": ["sum_x_x2_x3"],
+                "output_index": [0], "type": ["graph"],
+            },
+            "Training": {"batch_size": 4},
+        },
+        "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                    "graph_features": {"dim": [1]}},
+    }
+    done = update_config(base, tr, va, te)
+    assert done["Dataset"]["bad_sample_policy"] == "warn_skip"
+    assert done["NeuralNetwork"]["Training"]["loader_stall_timeout"] == 600.0
+
+    import copy
+
+    bad = copy.deepcopy(base)
+    bad["Dataset"]["bad_sample_policy"] = "explode"
+    with pytest.raises(ValueError, match="bad_sample_policy"):
+        update_config(bad, tr, va, te)
+    bad = copy.deepcopy(base)
+    bad["NeuralNetwork"]["Training"]["loader_stall_timeout"] = -1
+    with pytest.raises(ValueError, match="loader_stall_timeout"):
+        update_config(bad, tr, va, te)
+
+    # lint knows the new keys
+    from hydragnn_tpu.config.lint import lint_config
+
+    findings = lint_config(
+        {"Dataset": {"bad_sample_policy": "warn_skip"},
+         "NeuralNetwork": {"Training": {"loader_stall_timeout": 60}}}
+    )
+    assert all(f.status == "handled" for f in findings), findings

@@ -1,29 +1,61 @@
-"""Cross-host DDStore fetch plane: the TCP serve/fetch protocol and the
+"""Cross-host DDStore fetch plane: the TCP serve/fetch protocol, the
 block-partitioned MultiHostDistDataset (reference: DDStore MPI one-sided
-gets, hydragnn/utils/datasets/distdataset.py:26-183)."""
+gets, hydragnn/utils/datasets/distdataset.py:26-183), and the hardened
+client — reconnect with bounded backoff, socket timeouts, typed
+corrupt-sample errors (docs/ROBUSTNESS.md "Data plane")."""
 
 import os
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
 
 from hydragnn_tpu.data import (
+    CorruptSampleError,
     DDStore,
+    DistDataset,
     MultiHostDistDataset,
     RemoteStoreClient,
     deterministic_graph_dataset,
 )
+from hydragnn_tpu.utils import faultinject
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
 
 
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _client(port, **kw):
+    kw.setdefault("retry_base", 0.0)  # no wall-clock sleeps in CI
+    kw.setdefault("timeout_s", 10.0)
+    return RemoteStoreClient("127.0.0.1", port, **kw)
+
+
+def pytest_env_knobs_tolerate_malformed_values(monkeypatch):
+    # a robustness knob must not itself be a run-killer: malformed env
+    # values fall back to the defaults instead of crashing client __init__
+    from hydragnn_tpu.data.ddstore import _env_float, _env_int
+
+    monkeypatch.setenv("HYDRAGNN_DDSTORE_RETRIES", "four")
+    monkeypatch.setenv("HYDRAGNN_DDSTORE_TIMEOUT", "soon")
+    assert _env_int("HYDRAGNN_DDSTORE_RETRIES", 4) == 4
+    assert _env_float("HYDRAGNN_DDSTORE_TIMEOUT", 30.0) == 30.0
+    monkeypatch.setenv("HYDRAGNN_DDSTORE_RETRIES", "7")
+    assert _env_int("HYDRAGNN_DDSTORE_RETRIES", 4) == 7
 
 
 def pytest_remote_fetch_roundtrip():
@@ -48,6 +80,114 @@ def pytest_remote_fetch_roundtrip():
         client.close()
     finally:
         store.close(unlink=True)
+
+
+def pytest_client_survives_injected_socket_drop_with_zero_loss():
+    """An injected mid-stream connection drop (the transient-reset model)
+    is absorbed by reconnect + bounded retries: every blob still arrives
+    intact — zero sample loss."""
+    port = _free_port()
+    store = DDStore("/ddsr_drop", max_items=8, create=True, overwrite=True)
+    try:
+        blobs = [bytes([i]) * (100 * (i + 1)) for i in range(4)]
+        for i, b in enumerate(blobs):
+            store.put(i, b)
+        store.serve(port)
+        client = _client(port)
+        faultinject.configure(socket_drop="2,5")  # drop two of the fetches
+        got = [client.get(i) for i in range(4)] + [client.get(0)]
+        assert got == blobs + [blobs[0]]
+        client.close()
+    finally:
+        store.close(unlink=True)
+
+
+def pytest_client_survives_server_restart():
+    """A serving peer that restarts (process bounce) is a reconnect, not a
+    run killer — and the serve loop itself survives an abruptly dropped
+    client connection."""
+    port = _free_port()
+    store = DDStore("/ddsr_restart", max_items=4, create=True, overwrite=True)
+    try:
+        store.put(0, b"alpha")
+        store.serve(port)
+        c1 = _client(port)
+        assert c1.get(0) == b"alpha"
+        # abrupt client teardown must not wedge the server's accept loop
+        c1._drop()
+        c2 = _client(port)
+        assert c2.get(0) == b"alpha"
+        # bounce the server; the persistent client reconnects transparently
+        store.stop_serving()
+        store.serve(port)
+        assert c2.get(0) == b"alpha"
+        c2.close()
+    finally:
+        store.close(unlink=True)
+
+
+def pytest_client_terminal_error_names_host_port_id_and_is_bounded():
+    """With the peer gone for good, the client fails after exactly its
+    retry budget with an error naming host, port and global id — and a
+    missing id (the server ANSWERED) is authoritative: no retries."""
+    port = _free_port()
+    store = DDStore("/ddsr_dead", max_items=4, create=True, overwrite=True)
+    try:
+        store.put(0, b"alpha")
+        store.serve(port)
+        client = _client(port, retries=3)
+        assert client.get(0) == b"alpha"
+        with pytest.raises(KeyError):
+            client.get(3)  # empty slot: authoritative, not a retry case
+        store.stop_serving()
+        with pytest.raises(
+            ConnectionError,
+            match=rf"127\.0\.0\.1:{port} unreachable.*global_id 0.*3 attempts",
+        ):
+            client.get(0)
+        client.close()
+    finally:
+        store.close(unlink=True)
+
+
+def pytest_client_read_timeout_bounds_unresponsive_server():
+    """A server that ACCEPTS but never responds used to hang the client
+    forever on a blocking read; the creation-time socket timeout turns it
+    into a bounded, retried, terminal ConnectionError."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(2)
+    _, port = srv.getsockname()
+    t0 = time.monotonic()
+    try:
+        client = RemoteStoreClient(
+            "127.0.0.1", port, timeout_s=0.2, retries=2, retry_base=0.0
+        )
+        with pytest.raises(ConnectionError, match="unreachable|lost"):
+            client.get(0)
+        assert time.monotonic() - t0 < 5.0  # 2 attempts x 0.2s + slack
+        client.close()
+    finally:
+        srv.close()
+
+
+def pytest_corrupt_sample_bytes_raise_typed_error():
+    """Corrupt stored bytes (bit rot / wire damage) surface as a
+    CorruptSampleError naming the sample — attributable and skippable —
+    instead of an anonymous UnpicklingError."""
+    graphs = deterministic_graph_dataset(3, seed=3)
+    ds = DistDataset(graphs, name="/ddsr_corrupt", overwrite=True)
+    try:
+        np.testing.assert_array_equal(ds.get(1).x, graphs[1].x)
+        faultinject.configure(corrupt_sample="1")
+        with pytest.raises(CorruptSampleError, match="sample 1 .*corrupt"):
+            ds.get(1)
+        # other samples unaffected
+        np.testing.assert_array_equal(ds.get(0).x, graphs[0].x)
+        faultinject.reset()
+        np.testing.assert_array_equal(ds.get(1).x, graphs[1].x)
+    finally:
+        ds.close(unlink=True)
 
 
 def pytest_multihost_dist_dataset_two_ranks_one_process():
