@@ -452,6 +452,20 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
     )
     rng = jax.random.PRNGKey(0)
 
+    # compile observability (hydragnn_tpu/train/compile_plane.py): cache
+    # hit/miss + backend-compile seconds attributed to THIS cell, and
+    # time-to-first-step banked separately from the steady-state step time
+    # (the old first-step pass conflated trace+compile+execute into the
+    # warmup)
+    from hydragnn_tpu.train import compile_plane as _cp
+
+    _cp.install_metrics_listeners()
+    m0 = _cp.compile_metrics()
+    t0 = time.perf_counter()
+    state, tot, _ = step(state, batches[0], rng)
+    jax.block_until_ready(tot)
+    time_to_first_step = time.perf_counter() - t0
+
     # FLOPs per distinct batch shape, from the compiled executables
     flops_by_shape = {}
     for b in batches:
@@ -463,14 +477,18 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
     counts = [int(np.asarray(b.graph_mask).sum()) for b in batches]
     rngs = [jax.random.fold_in(rng, i) for i in range(len(batches))]
 
-    # warmup: compile every specialization, then one full extra pass — the
-    # first post-compile pass through the axon tunnel runs ~5x slower than
-    # steady state (queue/transfer warmup) and must not pollute the timing
-    for b in batches:
+    # warmup: compile every remaining specialization, then one full extra
+    # pass — the first post-compile pass through the axon tunnel runs ~5x
+    # slower than steady state (queue/transfer warmup) and must not pollute
+    # the timing
+    for b in batches[1:]:
         state, tot, _ = step(state, b, rng)
     for b, r in zip(batches, rngs):
         state, tot, _ = step(state, b, r)
     jax.block_until_ready(tot)
+    mdelta = {
+        k: v - m0[k] for k, v in _cp.compile_metrics().items()
+    }
 
     # BENCH_PROFILE=1: one xprof trace of a few steady-state steps into
     # logs/bench_profile (drives the MFU work — find the top non-matmul op)
@@ -516,6 +534,13 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
         "device": jax.devices()[0].device_kind,
         "peak_flops_assumed": peak,
         "loss": float(tot),
+        # compile plane: first-step latency and this cell's XLA compile
+        # bill (backend-compile seconds incl. cache retrievals) + the
+        # persistent-cache hit/miss counts the BENCH_COMPILE A/B banks
+        "time_to_first_step": time_to_first_step,
+        "compile_time_s": mdelta["backend_compile_s"],
+        "cache_hits": int(mdelta["cache_hits"]),
+        "cache_misses": int(mdelta["cache_misses"]),
         # the route that can actually engage, not the raw flag: the fused
         # path needs sorted receivers + a degree bound AND an EGNN stack
         # (models/egnn.py is the only consumer — a MACE/DimeNet cell with
@@ -712,6 +737,23 @@ def main_ab():
             {"mp": True, "sorted": False, "model": "GPS_performer",
              "tag": "gps_performer"},
         ]
+    if os.getenv("BENCH_COMPILE", "0") == "1":
+        # cold-vs-warm persistent-cache A/B (the r8 compile-plane tentpole):
+        # the SAME production-shaped cell twice — first against a scrubbed
+        # cache directory, then against the directory the cold cell just
+        # filled. Each cell builds fresh step objects, so both re-trace;
+        # the warm cell's XLA compiles collapse into cache retrievals
+        # (banked: cache_hits > 0, reduced compile_time_s and
+        # time_to_first_step). Appended LAST so the cache-dir flip cannot
+        # perturb the historical cells.
+        cells += [
+            {"mp": True, "sorted": False, "tag": "compile_cold",
+             "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0"},
+             "compile_cache": "cold"},
+            {"mp": True, "sorted": False, "tag": "compile_warm",
+             "env": {"BENCH_PACK": "0", "BENCH_FUSED": "0"},
+             "compile_cache": "warm"},
+        ]
     n_done = 0
     for cell in cells:
         mp, sorted_agg = cell["mp"], cell["sorted"]
@@ -725,6 +767,20 @@ def main_ab():
             sorted_agg = cell.get("env", {}).get(
                 "BENCH_CELL_SORTED", os.environ.get("BENCH_CELL_SORTED", "0")
             ) == "1"
+        cc = cell.get("compile_cache")
+        if cc:
+            # cold: scrub the A/B cache dir; warm: reuse what cold wrote.
+            # min_compile_secs=0 so every specialization is cached even on
+            # fast-compiling backends (jax's default 1s floor would skip
+            # CPU-sized programs and the warm cell would bank zero hits)
+            import shutil
+
+            from hydragnn_tpu.train import compile_plane as _cp
+
+            cache_ab_dir = os.path.join("logs", "xla_cache_compile_ab")
+            if cc == "cold":
+                shutil.rmtree(cache_ab_dir, ignore_errors=True)
+            _cp.set_cache_dir(cache_ab_dir, min_compile_secs=0.0)
         try:
             prod = _bench_production(
                 mixed_precision=mp,
@@ -770,6 +826,11 @@ def main_ab():
                 "equivariance": prod["equivariance"],
                 "step_guard": prod["step_guard"],
                 "flash_attention": prod["flash_attention"],
+                "time_to_first_step": round(prod["time_to_first_step"], 3),
+                "compile_time_s": round(prod["compile_time_s"], 3),
+                **({"compile_cache": cc,
+                    "cache_hits": prod["cache_hits"],
+                    "cache_misses": prod["cache_misses"]} if cc else {}),
                 **({"global_attn_type": prod["global_attn_type"]}
                    if prod["global_attn_type"] else {}),
                 **({"variant": cell["tag"]} if "tag" in cell else {}),
@@ -1038,6 +1099,8 @@ def main():
                 "vs_baseline": round(syn / RECORDED_BASELINE, 3),
                 "mfu": round(prod["mfu"], 4),
                 "flops_per_graph": round(prod["flops_per_graph"]),
+                "time_to_first_step": round(prod["time_to_first_step"], 3),
+                "compile_time_s": round(prod["compile_time_s"], 3),
                 "device": prod["device"],
                 "peak_flops_assumed": prod["peak_flops_assumed"],
                 "synthetic_pna_graphs_per_sec": round(syn, 2),

@@ -229,7 +229,14 @@ def prepare_data(
             # serialized_dataset_loader.py:89-94,182-189)
             from .data.lappe import add_dataset_pe
 
-            ready = add_dataset_pe(ready, int(arch.get("pe_dim") or 1))
+            # eigendecomposition results ride a topology-keyed disk cache
+            # (Dataset.lappe_cache, default on) so re-runs and resumes skip
+            # the O(N^3) per-graph eigh sweep (data/lappe.py)
+            ready = add_dataset_pe(
+                ready,
+                int(arch.get("pe_dim") or 1),
+                cache=ds_cfg.get("lappe_cache", True),
+            )
         trainset, valset, testset = split_dataset(
             ready,
             perc_train=config["NeuralNetwork"]["Training"].get("perc_train", 0.7),
@@ -462,6 +469,14 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         # rank-0 config dump (reference: save_config, config_utils.py:352-358)
         save_config(config, log_name)
 
+    # persistent XLA compilation cache (train/compile_plane.py): activated
+    # BEFORE the first jit touch (model init below compiles too), so
+    # restarts/rollbacks/resumes deserialize executables instead of
+    # recompiling. Training.compile_cache_dir / HYDRAGNN_COMPILE_CACHE.
+    from .train.compile_plane import setup_compile_cache
+
+    setup_compile_cache(config["NeuralNetwork"]["Training"], log_name)
+
     multihost = jax.process_count() > 1
     training = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
@@ -650,9 +665,22 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 zero2=zero_stage >= 2, zero3=zero_stage >= 3,
             )
             _peval = make_parallel_eval_step(model, mesh, cge, mp)
-        step_fn = lambda s, b, r: _pstep(s, promote_batch(b, mesh), r)
+        # the wrappers hide the jit objects from the compile plane —
+        # attach_lower_fn re-exposes them (same jit object + same batch
+        # transform the loop uses) so warm-up lands the identical executable
+        from .train.compile_plane import attach_lower_fn
+
+        step_fn = attach_lower_fn(
+            lambda s, b, r: _pstep(s, promote_batch(b, mesh), r),
+            _pstep,
+            lambda b: promote_batch(b, mesh),
+        )
         # evaluate() expects (tot, tasks, aux) like make_eval_step
-        eval_fn = lambda s, b: _peval(s, promote_batch(b, mesh)) + (None,)
+        eval_fn = attach_lower_fn(
+            lambda s, b: _peval(s, promote_batch(b, mesh)) + (None,),
+            _peval,
+            lambda b: promote_batch(b, mesh),
+        )
 
     writer = MetricsWriter(log_name)
 

@@ -397,6 +397,26 @@ def update_config(
     # 0 = keep every per-epoch checkpoint (historical behavior); N > 0
     # prunes to the newest N, bounding disk and the corruption-fallback walk
     training.setdefault("checkpoint_retention", 0)
+    # ---- compile plane (docs/PERFORMANCE.md "Compile plane"): persistent
+    # XLA compilation cache (None = ./logs/<run>/xla_cache; false disables;
+    # HYDRAGNN_COMPILE_CACHE overrides), AOT warm-up of the pad-bucket
+    # ladder, and the retrace sentinel's response to a trace outside the
+    # warmed specialization budget
+    training.setdefault("compile_cache_dir", None)
+    training.setdefault("precompile", "background")
+    from ..train.compile_plane import PRECOMPILE_MODES, RETRACE_POLICIES
+
+    if training["precompile"] not in PRECOMPILE_MODES:
+        raise ValueError(
+            f"Training.precompile {training['precompile']!r} must be one of "
+            f"{PRECOMPILE_MODES}"
+        )
+    training.setdefault("retrace_policy", "warn")
+    if training["retrace_policy"] not in RETRACE_POLICIES:
+        raise ValueError(
+            f"Training.retrace_policy {training['retrace_policy']!r} must "
+            f"be one of {RETRACE_POLICIES}"
+        )
     # ---- data plane (docs/ROBUSTNESS.md "Data plane"): what a sample that
     # fails validation (non-finite features, degenerate edges, budget
     # overflow, corrupt bytes) means, and how long the loader's prefetch
@@ -404,6 +424,10 @@ def update_config(
     # (0 disables the stall clock; producer DEATH is always detected)
     ds_cfg = config.setdefault("Dataset", {})
     ds_cfg.setdefault("bad_sample_policy", "warn_skip")
+    # LapPE eigendecomposition disk cache (data/lappe.py): true (default,
+    # ./logs/lappe_cache), false, or an explicit directory;
+    # HYDRAGNN_LAPPE_CACHE overrides
+    ds_cfg.setdefault("lappe_cache", True)
     from ..data.validate import POLICIES
 
     if ds_cfg["bad_sample_policy"] not in POLICIES:

@@ -52,6 +52,7 @@ _HANDLED = {
     "Dataset.synthetic",
     "Dataset.lennard_jones",
     "Dataset.bad_sample_policy",
+    "Dataset.lappe_cache",
     "NeuralNetwork.Profile",
     "NeuralNetwork.Profile.enable",
     "NeuralNetwork.Profile.target_epoch",
@@ -130,6 +131,9 @@ _HANDLED = {
     "NeuralNetwork.Training.non_finite_lr_backoff",
     "NeuralNetwork.Training.non_finite_max_rollbacks",
     "NeuralNetwork.Training.loader_stall_timeout",
+    "NeuralNetwork.Training.compile_cache_dir",
+    "NeuralNetwork.Training.precompile",
+    "NeuralNetwork.Training.retrace_policy",
     "NeuralNetwork.Training.compute_grad_energy",
     "NeuralNetwork.Training.conv_checkpointing",
     "NeuralNetwork.Training.Optimizer",

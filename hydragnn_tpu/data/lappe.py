@@ -7,22 +7,104 @@
 Host-side preprocessing with numpy/scipy: eigenvectors of the symmetric
 normalized Laplacian L = I - D^-1/2 A D^-1/2, skipping the trivial constant
 mode, sign-fixed for determinism.
+
+Disk cache: ``np.linalg.eigh`` is O(N^3) per graph and the result depends
+only on the graph's topology (the symmetrized adjacency) and ``k`` — so
+re-runs, resumes, and repeated experiments over the same dataset can skip
+the whole sweep. Results are cached per graph under a sha256 of
+``(n, k, senders, receivers)`` (``Dataset.lappe_cache``: true = the default
+``./logs/lappe_cache``, false = off, or an explicit directory;
+``HYDRAGNN_LAPPE_CACHE`` env overrides — ``0``/``off`` disables, a path
+redirects). Writes are atomic (tmp + ``os.replace``); a corrupt or
+wrong-shape entry silently recomputes.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import List
+import hashlib
+import os
+from typing import List, Optional
 
 import numpy as np
 
 from .graph import Graph
 
+_CACHE_ENV = "HYDRAGNN_LAPPE_CACHE"
+_DEFAULT_CACHE_DIR = os.path.join("logs", "lappe_cache")
+
+
+def resolve_cache_dir(cache=True) -> Optional[str]:
+    """Cache directory from the config knob + env override. ``cache`` is
+    ``Dataset.lappe_cache``: True (default dir), False/None (off), or a
+    path. The env always wins: ``0``/``off``/``false`` disables, ``1``
+    keeps the config resolution, anything else is the directory."""
+    env = os.getenv(_CACHE_ENV)
+    if env is not None:
+        s = env.strip()
+        if s.lower() in ("0", "off", "false", "none", ""):
+            return None
+        if s != "1":
+            return s
+        if cache is False or cache is None:
+            cache = True  # env "1": force-on; a config-provided dir still wins
+    if cache is False or cache is None:
+        return None
+    if isinstance(cache, str):
+        return cache
+    return _DEFAULT_CACHE_DIR
+
+
+def _topology_key(
+    n: int, senders: np.ndarray, receivers: np.ndarray, k: int
+) -> str:
+    h = hashlib.sha256()
+    h.update(np.int64(n).tobytes())
+    h.update(np.int64(k).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(senders, np.int64)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(receivers, np.int64)).tobytes())
+    return h.hexdigest()
+
+
+def _cache_load(path: str, n: int, k: int) -> Optional[np.ndarray]:
+    try:
+        pe = np.load(path)
+    except Exception:  # missing/corrupt entry: recompute
+        return None
+    if pe.shape != (n, k) or not np.all(np.isfinite(pe)):
+        return None
+    return pe.astype(np.float32)
+
+
+def _cache_store(path: str, pe: np.ndarray) -> None:
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            np.save(f, pe)
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache is best-effort; the computed result still returns
+
 
 def laplacian_pe(
-    n: int, senders: np.ndarray, receivers: np.ndarray, k: int
+    n: int,
+    senders: np.ndarray,
+    receivers: np.ndarray,
+    k: int,
+    cache_dir: Optional[str] = None,
 ) -> np.ndarray:
     """[n, k] eigenvectors for the k smallest non-trivial eigenvalues."""
+    path = None
+    if cache_dir:
+        key = _topology_key(n, senders, receivers, k)
+        # shard by hash prefix: GFM-scale datasets are millions of graphs,
+        # and a single flat directory with millions of entries degrades
+        # lookups on common filesystems (ext4 large-dir scans, NFS)
+        path = os.path.join(cache_dir, key[:2], key + ".npy")
+        hit = _cache_load(path, n, k)
+        if hit is not None:
+            return hit
     A = np.zeros((n, n), np.float64)
     A[receivers, senders] = 1.0
     A = np.maximum(A, A.T)  # symmetrize
@@ -40,15 +122,24 @@ def laplacian_pe(
         nz = np.flatnonzero(np.abs(col) > 1e-8)
         if nz.size and col[nz[0]] < 0:
             pe[:, c] = -col
-    return pe.astype(np.float32)
+    pe = pe.astype(np.float32)
+    if path is not None:
+        _cache_store(path, pe)
+    return pe
 
 
-def add_graph_pe(graph: Graph, pe_dim: int) -> Graph:
+def add_graph_pe(
+    graph: Graph, pe_dim: int, cache_dir: Optional[str] = None
+) -> Graph:
     """Attach ``pe`` [n, pe_dim] and ``rel_pe`` [e, pe_dim] to a graph."""
-    pe = laplacian_pe(graph.num_nodes, graph.senders, graph.receivers, pe_dim)
+    pe = laplacian_pe(
+        graph.num_nodes, graph.senders, graph.receivers, pe_dim,
+        cache_dir=cache_dir,
+    )
     rel_pe = np.abs(pe[graph.senders] - pe[graph.receivers])
     return dataclasses.replace(graph, pe=pe, rel_pe=rel_pe)
 
 
-def add_dataset_pe(graphs: List[Graph], pe_dim: int) -> List[Graph]:
-    return [add_graph_pe(g, pe_dim) for g in graphs]
+def add_dataset_pe(graphs: List[Graph], pe_dim: int, cache=True) -> List[Graph]:
+    cache_dir = resolve_cache_dir(cache)
+    return [add_graph_pe(g, pe_dim, cache_dir=cache_dir) for g in graphs]

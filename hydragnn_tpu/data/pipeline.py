@@ -810,6 +810,41 @@ class GraphLoader:
                     stacklevel=2,
                 )
 
+    def spec_template_batches(self) -> List[Tuple[PadSpec, GraphBatch]]:
+        """One template ``GraphBatch`` per ladder level this loader can emit
+        — the compile plane's warm-up inputs (train/compile_plane.py).
+
+        Batch array SHAPES are fully determined by the pad spec plus the
+        dataset's feature widths, so a single fitting graph padded to the
+        level is abstractly identical to any real batch at that level. A
+        level no single dataset graph fits can never be selected by
+        ``SpecLadder.select`` either (every batch total is >= its smallest
+        member) and is skipped — warm-up covers exactly the specializations
+        the loader can produce, no more."""
+        out: List[Tuple[PadSpec, GraphBatch]] = []
+        for spec in self.ladder.specs:
+            need_t = bool(spec.n_triplets)
+            g = next(
+                (
+                    c
+                    for c in self.graphs
+                    if c.num_nodes <= spec.n_nodes - 1
+                    and c.num_edges <= spec.n_edges
+                    and (not need_t or self._trip_count_of(c) <= spec.n_triplets)
+                ),
+                None,
+            )
+            if g is None:
+                continue
+            if self.num_shards == 1:
+                out.append(
+                    (spec, batch_graphs([g], spec, sort_edges=self.sort_edges))
+                )
+            else:
+                shards = [[g]] + [[] for _ in range(self.num_shards - 1)]
+                out.append((spec, self._make_stacked(shards, spec)))
+        return out
+
     def _make(self, graphs: List[Graph]) -> GraphBatch:
         with_trip = bool(self.spec.n_triplets)
         if with_trip:

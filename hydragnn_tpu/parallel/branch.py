@@ -244,7 +244,11 @@ def make_branch_parallel_train_step(
     def _specs_like(tree):
         return branch_specs(tree)
 
+    from ..train.compile_plane import note_trace
+
     def step(state: TrainState, batch, rng):
+        # retrace sentinel: one execution per jit trace (compile_plane.py)
+        note_trace("branch_train_step", (state, batch, rng))
         grad_map = shard_map(
             sharded_grads,
             mesh=mesh,
@@ -342,8 +346,10 @@ def make_branch_parallel_eval_step(
         return tot, tasks
 
     rep = P()
+    from ..train.compile_plane import note_trace
 
     def evalf(state: TrainState, batch):
+        note_trace("branch_eval_step", (state, batch))
         mapped = shard_map(
             sharded_eval,
             mesh=mesh,
@@ -504,6 +510,13 @@ class BranchRoutedLoader:
             stacked = {k: np.stack([v] * rows_b) for k, v in z.items()}
             self._templates[rows_b] = graph_batch_from_np(stacked)
         return self._templates[rows_b]
+
+    def spec_template_batches(self):
+        """Compile-plane warm-up template (train/compile_plane.py): one
+        shared worst-case spec means ONE stacked specialization; the
+        all-padding row template has exactly the shapes/dtypes of a real
+        branch-routed batch."""
+        return [(self.spec, self._empty_rows(self.num_shards))]
 
     def set_epoch(self, epoch: int) -> None:
         for l in self.loaders:

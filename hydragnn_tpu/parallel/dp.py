@@ -124,7 +124,11 @@ def make_parallel_train_step(
         check_vma=False,
     )
 
+    from ..train.compile_plane import note_trace
+
     def step(state: TrainState, batch, rng):
+        # retrace sentinel: one execution per jit trace (compile_plane.py)
+        note_trace("parallel_train_step", (state, batch, rng))
         grads, tot, tasks, new_stats = grad_map(
             state.params, state.batch_stats, batch, rng
         )
@@ -248,4 +252,10 @@ def make_parallel_eval_step(
         out_specs=(rep, rep),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    from ..train.compile_plane import note_trace
+
+    def eval_step(state: TrainState, batch):
+        note_trace("parallel_eval_step", (state, batch))
+        return mapped(state, batch)
+
+    return jax.jit(eval_step)

@@ -1,0 +1,675 @@
+"""Compile plane: the zero-recompile steady state.
+
+On TPU the dominant non-step cost of this framework is XLA compilation: a
+``num_pad_buckets=4`` SpecLadder (config/config.py) means up to 4 train + 4
+eval step specializations per run, each one stalling the step loop mid-epoch
+on its first visit — and the fault-tolerance work (rollback, SIGTERM resume,
+preemption; docs/ROBUSTNESS.md) made restarts routine, so every recovery
+used to repay the full compile bill from zero. Three mechanisms close that:
+
+1. **Persistent compilation cache** (``setup_compile_cache``): jax's
+   disk-backed executable cache wired from config
+   (``Training.compile_cache_dir``, default under the run's log dir;
+   ``HYDRAGNN_COMPILE_CACHE`` overrides, ``0``/``off`` disables). Restarts,
+   rollbacks, and mid-epoch resumes deserialize compiled executables
+   instead of recompiling them.
+
+2. **Background AOT warm-up** (``CompilePlane``): the loaders' SpecLadder
+   pad shapes are enumerated up front (``GraphLoader.spec_template_batches``
+   — shapes are fully determined by the ladder, no epoch needs to run), and
+   every (train, eval) x bucket specialization is ``lower().compile()``d in
+   a worker thread while epoch 0 runs (``Training.precompile:
+   off | blocking | background``). The AOT compile lands in the persistent
+   cache, so the step loop's first organic visit to each bucket pays a
+   cache *retrieval* (tens of ms) instead of a full XLA compile (tens of
+   seconds through a tunnel). Lowering shares jax's trace cache with the
+   call path, so warm-up also absorbs the Python tracing cost. Without a
+   persistent cache directory the warm-up executables would be unreachable
+   from the call path — the plane then degrades to ``off`` (AOT work whose
+   results nothing can reuse is pure waste).
+
+3. **Retrace sentinel**: every step builder's traced body calls
+   ``note_trace(name, args)``, which records the call's abstract signature
+   (shape/dtype/weak_type per leaf) — executed once per trace, by
+   construction. Once warm-up has covered the ladder the sentinel is
+   *armed*: any later trace whose signature is not among the known
+   specializations is a silent-retrace bug (the PR 3 incident — one
+   int32/weak-type flip on a counter silently doubled every
+   specialization's compile bill), reported with the aval diff against the
+   nearest known signature and handled per ``Training.retrace_policy:
+   warn (default) | error``.
+
+Observability: per-specialization compile seconds, cache hit/miss counts
+(via ``jax.monitoring``), and time-to-first-step land in ``utils.Timer`` /
+``utils.tracer`` and in the plane's ``report()``; bench.py banks them
+(``time_to_first_step`` / ``compile_time_s`` / ``BENCH_COMPILE`` cells).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+PRECOMPILE_MODES = ("off", "blocking", "background")
+RETRACE_POLICIES = ("warn", "error")
+
+# how long finish() waits for a still-running warm-up worker before leaking
+# the daemon thread with a warning (a wedged XLA compile must not hang run
+# teardown); module-level so tests can pin it
+_WORKER_JOIN_TIMEOUT_S = 30.0
+
+
+class RetraceError(RuntimeError):
+    """An armed retrace sentinel saw a trace outside the known
+    specialization set — a silent-recompile bug (``Training.retrace_policy:
+    error``). The message carries the aval diff against the nearest known
+    specialization."""
+
+
+# ---------------------------------------------------------------------------
+# compile metrics: process-wide counters fed by jax.monitoring events
+# ---------------------------------------------------------------------------
+
+_METRICS_LOCK = threading.Lock()
+_METRICS = {
+    "cache_hits": 0,
+    "cache_misses": 0,
+    "backend_compile_s": 0.0,
+    "cache_retrieval_s": 0.0,
+}
+_LISTENERS_INSTALLED = False
+
+
+def _on_event(name: str, **kw) -> None:
+    if name == "/jax/compilation_cache/cache_hits":
+        with _METRICS_LOCK:
+            _METRICS["cache_hits"] += 1
+    elif name == "/jax/compilation_cache/cache_misses":
+        with _METRICS_LOCK:
+            _METRICS["cache_misses"] += 1
+
+
+def _on_duration(name: str, secs: float, **kw) -> None:
+    if name == "/jax/core/compile/backend_compile_duration":
+        with _METRICS_LOCK:
+            _METRICS["backend_compile_s"] += float(secs)
+    elif name == "/jax/compilation_cache/cache_retrieval_time_sec":
+        with _METRICS_LOCK:
+            _METRICS["cache_retrieval_s"] += float(secs)
+
+
+def install_metrics_listeners() -> None:
+    """Idempotently subscribe the counters to jax.monitoring. Must run
+    before the compiles it should observe; listeners cannot be removed, so
+    there is exactly one registration per process."""
+    global _LISTENERS_INSTALLED
+    with _METRICS_LOCK:
+        if _LISTENERS_INSTALLED:
+            return
+        _LISTENERS_INSTALLED = True
+    import jax
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def compile_metrics() -> Dict[str, float]:
+    """Snapshot of the process-wide compile counters (cache hits/misses,
+    cumulative backend-compile and cache-retrieval seconds)."""
+    with _METRICS_LOCK:
+        return dict(_METRICS)
+
+
+def _metrics_delta(before: Dict[str, float]) -> Dict[str, float]:
+    now = compile_metrics()
+    return {k: now[k] - before.get(k, 0) for k in now}
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache wiring
+# ---------------------------------------------------------------------------
+
+
+def cache_dir_active() -> Optional[str]:
+    """The persistent cache directory jax currently writes to, or None."""
+    import jax
+
+    try:
+        return jax.config.jax_compilation_cache_dir or None
+    except AttributeError:  # pragma: no cover - ancient jax
+        return None
+
+
+def _reset_jax_cache_object() -> None:
+    """jax materializes its persistent-cache object at most once per
+    process (``compilation_cache._get_cache``), silently ignoring later
+    ``jax_compilation_cache_dir`` changes — reset it so a re-pointed
+    directory actually takes effect (tests, the BENCH_COMPILE cold/warm
+    A/B)."""
+    try:
+        from jax.experimental.compilation_cache import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:  # pragma: no cover - private-API drift tolerance
+        pass
+
+
+def set_cache_dir(
+    path: Optional[str], min_compile_secs: Optional[float] = None
+) -> Optional[str]:
+    """Point jax's persistent compilation cache at ``path`` (abspath'd,
+    created). ``min_compile_secs`` lowers the write threshold (jax default:
+    1s — CPU test compiles would never be cached without 0). ``None`` path
+    disables the cache."""
+    import jax
+
+    if path is None:
+        if cache_dir_active() is not None:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _reset_jax_cache_object()
+        return None
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    if cache_dir_active() != path:
+        jax.config.update("jax_compilation_cache_dir", path)
+        _reset_jax_cache_object()
+    if min_compile_secs is not None:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_secs)
+        )
+        # a 0-second threshold means "cache everything" — drop the entry-size
+        # floor too, or trivial test-sized executables still skip the disk
+        if float(min_compile_secs) <= 0:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    install_metrics_listeners()
+    return path
+
+
+def setup_compile_cache(
+    training: Dict[str, Any], log_name: Optional[str] = None
+) -> Optional[str]:
+    """Resolve and activate the run's persistent compilation cache.
+
+    Resolution order: ``HYDRAGNN_COMPILE_CACHE`` env (``0``/``off``/``none``
+    disables, ``1`` forces the config/default resolution back on, a path
+    overrides), then ``Training.compile_cache_dir`` (``false`` disables, a
+    path overrides), else the default ``./logs/<run>/xla_cache``. The
+    disable paths also DEACTIVATE a cache directory a previous run in this
+    process pointed jax at. ``HYDRAGNN_COMPILE_CACHE_MIN_SECS`` lowers
+    jax's min-compile-time write threshold (the smokes pin 0 so CPU-sized
+    compiles are cached too). Returns the active directory, or None.
+    """
+    env = os.getenv("HYDRAGNN_COMPILE_CACHE")
+    cfg = training.get("compile_cache_dir")
+    if env is not None:
+        s = env.strip()
+        if s.lower() in ("0", "off", "none", "false", ""):
+            # deactivate any directory a previous run in this process set
+            return set_cache_dir(None)
+        if s != "1":
+            cfg = s  # an explicit path beats the config
+        elif cfg is False or (
+            isinstance(cfg, str) and cfg.strip().lower() in ("off", "none")
+        ):
+            # "1": force-on with the config/default resolution (the same
+            # semantics as HYDRAGNN_LAPPE_CACHE=1)
+            cfg = None
+    if cfg is False or (isinstance(cfg, str) and cfg.strip().lower() in ("off", "none")):
+        return set_cache_dir(None)
+    if isinstance(cfg, str) and cfg:
+        path = cfg
+    else:
+        path = os.path.join("./logs", log_name or "run", "xla_cache")
+    min_secs = os.getenv("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
+    return set_cache_dir(
+        path, float(min_secs) if min_secs is not None else None
+    )
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel
+# ---------------------------------------------------------------------------
+
+# one leaf of a trace signature: (tree path, shape, dtype, weak_type)
+_Leaf = Tuple[str, Tuple[int, ...], str, bool]
+_Sig = Tuple[_Leaf, ...]
+
+
+def _signature_of(args) -> _Sig:
+    """Abstract signature of a (pytree of) traced argument(s): per-leaf
+    (path, shape, dtype, weak_type). Called from inside traced function
+    bodies, where leaves are tracers carrying ``.aval``."""
+    import jax
+
+    leaves = []
+    for path, x in jax.tree_util.tree_flatten_with_path(args)[0]:
+        aval = getattr(x, "aval", None)
+        if aval is not None:
+            shape = tuple(getattr(aval, "shape", ()))
+            dtype = str(getattr(aval, "dtype", type(x).__name__))
+            weak = bool(getattr(aval, "weak_type", False))
+        else:  # non-array leaf (should not happen under jit; be tolerant)
+            shape = tuple(np.shape(x))
+            dtype = str(np.asarray(x).dtype) if np.ndim(x) else type(x).__name__
+            weak = isinstance(x, (int, float, complex, bool))
+        leaves.append((jax.tree_util.keystr(path), shape, dtype, weak))
+    return tuple(leaves)
+
+
+def _diff_sigs(got: _Sig, ref: _Sig, limit: int = 8) -> List[str]:
+    """Human-readable per-leaf diff of two signatures (by tree path)."""
+    ref_by_path = {p: (s, d, w) for p, s, d, w in ref}
+    got_paths = {p for p, *_ in got}
+    out = []
+    for p, s, d, w in got:
+        have = ref_by_path.get(p)
+        if have is None:
+            out.append(f"  {p}: NEW leaf {d}{list(s)}{' weak' if w else ''}")
+        elif have != (s, d, w):
+            rs, rd, rw = have
+            out.append(
+                f"  {p}: {rd}{list(rs)}{' weak' if rw else ''} -> "
+                f"{d}{list(s)}{' weak' if w else ''}"
+            )
+    for p, s, d, w in ref:
+        if p not in got_paths:
+            out.append(f"  {p}: leaf DROPPED ({d}{list(s)})")
+    if len(out) > limit:
+        out = out[:limit] + [f"  ... {len(out) - limit} more differing leaves"]
+    return out
+
+
+class _TraceSentinel:
+    """Process-wide trace counter per step builder, armable against a known
+    specialization set. ``note`` is called from traced function bodies —
+    i.e. exactly once per jit trace — so its counts ARE the retrace
+    census."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sigs: Dict[str, List[_Sig]] = {}
+        self._armed = False
+        self._policy = "warn"
+        self._known: Dict[str, set] = {}
+        self._violations: List[str] = []
+
+    def note(self, name: str, args) -> None:
+        sig = _signature_of(args)
+        with self._lock:
+            self._sigs.setdefault(name, []).append(sig)
+            if not self._armed:
+                return
+            known = self._known.get(name, set())
+            if sig in known:
+                # a re-trace of a known specialization: jit caches make this
+                # impossible for a live builder — it means a builder was
+                # rebuilt or a cache was invalidated mid-run. Beyond the
+                # ladder budget either way.
+                msg = (
+                    f"retrace sentinel: {name} re-traced an already-known "
+                    "specialization after warm-up (rebuilt step function or "
+                    "invalidated jit cache?) — one extra XLA compile"
+                )
+            else:
+                msg = self._unknown_sig_message(name, sig, known)
+            # number the message: a recurring violation (step rebuilt every
+            # epoch) would otherwise emit byte-identical warnings that
+            # Python's default filter dedups down to ONE — silencing every
+            # repeat of an each-time-paid recompile
+            msg = f"{msg} [violation #{len(self._violations) + 1}]"
+            self._violations.append(msg)
+            policy = self._policy
+        if policy == "error":
+            raise RetraceError(msg)
+        warnings.warn(msg, RuntimeWarning, stacklevel=3)
+
+    @staticmethod
+    def _unknown_sig_message(name: str, sig: _Sig, known: set) -> str:
+        nearest = None
+        best = None
+        for k in known:
+            d = len(_diff_sigs(sig, k, limit=10 ** 6))
+            if best is None or d < best:
+                best, nearest = d, k
+        lines = [
+            f"retrace sentinel: {name} traced a specialization outside the "
+            "warmed ladder budget after warm-up completed — a silent "
+            "recompile (one full XLA compile per occurrence)."
+        ]
+        if nearest is not None:
+            lines.append(
+                f"aval diff vs the nearest known specialization "
+                f"({best} differing leaves):"
+            )
+            lines.extend(_diff_sigs(sig, nearest))
+        else:
+            lines.append(f"no known specializations recorded for {name!r}")
+        return "\n".join(lines)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {k: len(v) for k, v in self._sigs.items()}
+
+    def arm(self, policy: str) -> None:
+        """Freeze every signature seen so far as the known set; later traces
+        are violations handled per ``policy``."""
+        with self._lock:
+            self._known = {k: set(v) for k, v in self._sigs.items()}
+            self._policy = policy
+            self._armed = True
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._armed = False
+
+    @property
+    def armed(self) -> bool:
+        return self._armed
+
+    def violations(self) -> List[str]:
+        with self._lock:
+            return list(self._violations)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sigs.clear()
+            self._known.clear()
+            self._violations.clear()
+            self._armed = False
+            self._policy = "warn"
+
+
+_SENTINEL = _TraceSentinel()
+
+
+def sentinel() -> _TraceSentinel:
+    return _SENTINEL
+
+
+def note_trace(name: str, args) -> None:
+    """Record one trace of step builder ``name`` (call from the traced
+    function body — it executes exactly once per trace). No-op cost at run
+    time: the call does not appear in the jaxpr."""
+    _SENTINEL.note(name, args)
+
+
+def attach_lower_fn(fn, jitted, batch_transform: Optional[Callable] = None,
+                    batch_argnum: int = 1):
+    """Mark a step-fn *wrapper* as AOT-lowerable: ``fn`` is what the loop
+    calls (e.g. the mesh path's ``lambda s, b, r: _pstep(s, promote_batch(b,
+    mesh), r)``), ``jitted`` the underlying jit object, ``batch_transform``
+    the wrapper's batch preprocessing. The compile plane lowers through the
+    SAME jit object and transform the loop uses, so the warmed executable is
+    byte-identical to the organic one."""
+
+    def _lower(*args):
+        if batch_transform is not None:
+            args = list(args)
+            args[batch_argnum] = batch_transform(args[batch_argnum])
+        return jitted.lower(*args)
+
+    fn._compile_plane_lower = _lower
+    return fn
+
+
+def _lower_fn_of(fn) -> Optional[Callable]:
+    lower = getattr(fn, "_compile_plane_lower", None)
+    if lower is not None:
+        return lower
+    return getattr(fn, "lower", None)
+
+
+# ---------------------------------------------------------------------------
+# the plane
+# ---------------------------------------------------------------------------
+
+
+class CompilePlane:
+    """Per-run orchestrator: collect the ladder's warm-up jobs, run them
+    (inline or in a worker thread), arm the sentinel when coverage is
+    complete, and report compile observability at run end."""
+
+    def __init__(
+        self,
+        mode: str = "background",
+        retrace_policy: str = "warn",
+        log_name: str = "run",
+    ):
+        if mode not in PRECOMPILE_MODES:
+            raise ValueError(
+                f"precompile mode {mode!r} must be one of {PRECOMPILE_MODES}"
+            )
+        if retrace_policy not in RETRACE_POLICIES:
+            raise ValueError(
+                f"retrace_policy {retrace_policy!r} must be one of "
+                f"{RETRACE_POLICIES}"
+            )
+        self.mode = mode
+        self.retrace_policy = retrace_policy
+        self.log_name = log_name
+        self.cache_dir: Optional[str] = None
+        self.jobs: List[Tuple[str, Callable]] = []
+        self.compiled: List[Tuple[str, float]] = []  # (label, secs)
+        self.errors: List[Tuple[str, str]] = []
+        self.time_to_first_step: Optional[float] = None
+        self._t0: Optional[float] = None
+        self._m0: Dict[str, float] = {}
+        self._counts0: Dict[str, int] = {}
+        self._viol0 = 0
+        self._worker: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- job collection ----------------------------------------------------
+
+    def _collect_jobs(self, step_fn, eval_fn, state, train_loader,
+                      val_loader, test_loader, rng) -> None:
+        lower_step = _lower_fn_of(step_fn) if step_fn is not None else None
+        lower_eval = _lower_fn_of(eval_fn) if eval_fn is not None else None
+
+        def template_list(loader):
+            fn = getattr(loader, "spec_template_batches", None)
+            return fn() if fn is not None else []
+
+        if lower_step is not None and train_loader is not None:
+            for spec, tmpl in template_list(train_loader):
+                self.jobs.append(
+                    (
+                        f"train:{spec.n_nodes}n/{spec.n_edges}e",
+                        lambda t=tmpl: lower_step(state, t, rng),
+                    )
+                )
+        if lower_eval is not None:
+            seen = set()
+            for loader in (val_loader, test_loader):
+                if loader is None:
+                    continue
+                for spec, tmpl in template_list(loader):
+                    if spec in seen:
+                        continue  # val/test share the ladder (api.py)
+                    seen.add(spec)
+                    self.jobs.append(
+                        (
+                            f"eval:{spec.n_nodes}n/{spec.n_edges}e",
+                            lambda t=tmpl: lower_eval(state, t),
+                        )
+                    )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def launch(self, step_fn, eval_fn, state, train_loader,
+               val_loader=None, test_loader=None, rng=None, skip_eval=False):
+        """Start the plane for one run. Returns ``step_fn`` instrumented
+        with a first-step timer; warm-up runs per ``self.mode``. Without an
+        active persistent cache directory the mode degrades to ``off``: the
+        call path could never reuse the AOT executables, so warm-up would
+        burn a core for nothing."""
+        from ..utils import tracer as tr
+        from ..utils.timers import Timer
+
+        install_metrics_listeners()
+        self.cache_dir = cache_dir_active()
+        self._t0 = time.perf_counter()
+        # started HERE so blocking-mode warm-up is inside the span, exactly
+        # like the report's time_to_first_step field (both measure launch ->
+        # first completed step)
+        ttfs_timer = Timer("time_to_first_step").start()
+        self._m0 = compile_metrics()
+        self._counts0 = _SENTINEL.counts()
+        # the sentinel is process-global; baseline its violation count so
+        # this plane's report never attributes an earlier run's retraces
+        # to itself (in-process HPO trials, repeated run_training)
+        self._viol0 = len(_SENTINEL.violations())
+        if self.mode != "off" and self.cache_dir is None:
+            self.mode = "off"
+        if self.mode != "off":
+            import jax
+
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            self._collect_jobs(
+                step_fn, None if skip_eval else eval_fn, state,
+                train_loader, val_loader, test_loader, rng,
+            )
+            if self.mode == "blocking":
+                with Timer("compile_plane_warmup"):
+                    self._run_jobs()
+                self._maybe_arm()
+            elif self.jobs:
+                self._worker = threading.Thread(
+                    target=self._worker_main, daemon=True,
+                    name="compile-plane-warmup",
+                )
+                self._worker.start()
+
+        # first-step timer: time from plane launch to the first completed
+        # optimizer step (the restart-latency metric the cache is buying
+        # down); one flag check per call afterwards. The Timer entry
+        # "time_to_first_step" records the same launch-to-done span as the
+        # report field (started at launch above, stopped after the first
+        # step; never stopped — so never recorded — if no step runs); the
+        # tracer region "first_step" covers only the step call itself (a
+        # launch-scoped xprof annotation would span half of epoch 0 and
+        # break the tracer's LIFO unwind for regions opened in between).
+        done = {"first": True}
+        plane = self
+
+        def instrumented(st, batch, step_rng, _fn=step_fn):
+            if not done["first"]:
+                return _fn(st, batch, step_rng)
+            import jax
+
+            tr.start("first_step")
+            out = _fn(st, batch, step_rng)
+            jax.block_until_ready(out[1])
+            tr.stop("first_step")
+            done["first"] = False
+            plane.time_to_first_step = time.perf_counter() - plane._t0
+            ttfs_timer.stop()
+            return out
+
+        return instrumented
+
+    def _run_jobs(self) -> None:
+        for label, thunk in self.jobs:
+            if self._stop.is_set():
+                return
+            t0 = time.perf_counter()
+            try:
+                thunk().compile()
+            except Exception as e:  # warm-up must never kill training
+                self.errors.append((label, f"{type(e).__name__}: {e}"))
+                continue
+            self.compiled.append((label, time.perf_counter() - t0))
+
+    def _worker_main(self) -> None:
+        from ..utils.timers import Timer
+
+        with Timer("compile_plane_warmup"):
+            self._run_jobs()
+        self._maybe_arm()
+
+    def _maybe_arm(self) -> None:
+        # arm only on FULL coverage: a failed warm-up job means its organic
+        # visit will legitimately trace later — flagging it would turn a
+        # warm-up hiccup into a spurious (possibly fatal) sentinel report
+        if self.jobs and not self.errors and not self._stop.is_set():
+            _SENTINEL.arm(self.retrace_policy)
+
+    def finish(self, verbosity: int = 0) -> Dict[str, Any]:
+        """End the run: stop/join the worker, disarm the sentinel, return
+        (and at verbosity > 0 print) the report."""
+        if self._worker is not None and self._worker.is_alive():
+            # a still-compiling worker gets the FULL grace to drain the
+            # queue — the remaining AOT compiles populate the persistent
+            # cache for the next restart, which is the whole point (the
+            # compile smoke's cold leg asserts full ladder coverage on a
+            # run shorter than its warm-up). Only after the grace expires
+            # is the stop flag set: the leaked daemon thread then exits at
+            # its next job boundary instead of hanging teardown on a
+            # wedged XLA compile.
+            self._worker.join(timeout=_WORKER_JOIN_TIMEOUT_S)
+            if self._worker.is_alive():
+                self._stop.set()
+                warnings.warn(
+                    "compile-plane warm-up worker still compiling "
+                    f"{_WORKER_JOIN_TIMEOUT_S}s after the run ended; "
+                    "leaking the daemon thread",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        rep = self.report()
+        _SENTINEL.disarm()
+        if verbosity > 0:
+            print(f"[{self.log_name}] {format_report(rep)}", file=sys.stderr)
+        return rep
+
+    def report(self) -> Dict[str, Any]:
+        delta = _metrics_delta(self._m0) if self._m0 else compile_metrics()
+        counts = _SENTINEL.counts()
+        traces = {
+            k: v - self._counts0.get(k, 0)
+            for k, v in counts.items()
+            if v - self._counts0.get(k, 0)
+        }
+        return {
+            "mode": self.mode,
+            "cache_dir": self.cache_dir,
+            "specializations": len(self.jobs),
+            "precompiled": len(self.compiled),
+            "compile_time_s": round(
+                sum(s for _, s in self.compiled) or delta["backend_compile_s"], 3
+            ),
+            "backend_compile_s": round(delta["backend_compile_s"], 3),
+            "cache_hits": int(delta["cache_hits"]),
+            "cache_misses": int(delta["cache_misses"]),
+            "time_to_first_step": (
+                round(self.time_to_first_step, 3)
+                if self.time_to_first_step is not None
+                else None
+            ),
+            "traces": traces,
+            "violations": len(_SENTINEL.violations()) - self._viol0,
+            "warmup_errors": list(self.errors),
+        }
+
+
+def format_report(rep: Dict[str, Any]) -> str:
+    """One grep-able line (the chaos/compile smokes parse these fields)."""
+    ttfs = rep.get("time_to_first_step")
+    return (
+        f"compile plane: mode={rep['mode']} "
+        f"precompiled={rep['precompiled']}/{rep['specializations']} "
+        f"compile_time_s={rep['compile_time_s']} "
+        f"cache_hits={rep['cache_hits']} cache_misses={rep['cache_misses']} "
+        f"time_to_first_step={ttfs if ttfs is not None else 'n/a'}s "
+        f"traces={sum(rep['traces'].values())} "
+        f"violations={rep['violations']}"
+        + (f" warmup_errors={len(rep['warmup_errors'])}"
+           if rep["warmup_errors"] else "")
+    )

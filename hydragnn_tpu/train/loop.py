@@ -137,8 +137,13 @@ def make_train_step(
         # checkpoint, Base.py:459-465; jax.checkpoint trades FLOPs for HBM)
         loss_fn = jax.checkpoint(loss_fn)
 
+    from .compile_plane import note_trace
+
     @partial(jax.jit, donate_argnums=0)
     def train_step(state: TrainState, batch: GraphBatch, rng):
+        # retrace sentinel: the body runs once per jit trace, so this call
+        # IS the trace census (train/compile_plane.py)
+        note_trace("train_step", (state, batch, rng))
         (tot, (tasks, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state.params, state.batch_stats, batch, rng
         )
@@ -178,9 +183,11 @@ def make_eval_step(
     mixed_precision: bool = False,
 ):
     cfg = model.cfg
+    from .compile_plane import note_trace
 
     @jax.jit
     def eval_step(state: TrainState, batch: GraphBatch):
+        note_trace("eval_step", (state, batch))
         variables = state.variables()
         if mixed_precision:
             variables, batch = mp_cast_eval(
@@ -464,6 +471,29 @@ def train_validate_test(
     preemption.install()
     tr.enable()
 
+    # compile plane (train/compile_plane.py): AOT warm-up of every
+    # (train, eval) x pad-bucket specialization against the persistent
+    # compilation cache, plus the retrace sentinel. Degrades to off when no
+    # cache directory is active (api.run_training wires one by default;
+    # direct callers opt in via setup_compile_cache).
+    from .compile_plane import CompilePlane
+
+    plane = CompilePlane(
+        mode=str(training.get("precompile", "background")),
+        retrace_policy=str(training.get("retrace_policy", "warn")),
+        log_name=log_name,
+    )
+    step_fn = plane.launch(
+        step_fn,
+        eval_fn,
+        state,
+        train_loader,
+        val_loader,
+        test_loader,
+        rng=jax.random.PRNGKey(seed),
+        skip_eval=not do_valtest,
+    )
+
     rng = jax.random.PRNGKey(seed)
     hist: Dict[str, List[float]] = {"train": [], "val": [], "test": [], "lr": []}
     # Early stopping / best-val checkpointing RETURN THE BEST STATE, not
@@ -626,6 +656,9 @@ def train_validate_test(
     finally:
         profiler.close()
         preemption.uninstall()
+        # join the warm-up worker, disarm the sentinel, and (verbosity > 0)
+        # print the one-line compile report the smokes parse
+        plane.finish(verbosity)
     if best_state is not None:
         state = best_state
     return state, hist

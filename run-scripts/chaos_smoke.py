@@ -69,9 +69,13 @@ print("CLEAN_EXIT epochs=%d" % len(hist["train"]), flush=True)
 """
 
 _EPOCH_RE = re.compile(r"epoch (\d+): train ([0-9.eE+-]+)")
+_PLANE_RE = re.compile(
+    r"compile plane: .*cache_hits=(\d+) cache_misses=(\d+) "
+    r"time_to_first_step=([0-9.]+|n/a)s traces=\d+ violations=(\d+)"
+)
 
 
-def _env():
+def _env(workdir=None):
     env = {
         k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
     }
@@ -81,7 +85,27 @@ def _env():
         for p in [_REPO] + env.get("PYTHONPATH", "").split(":")
         if p and ".axon_site" not in p
     )
+    if workdir is not None:
+        # ONE persistent compilation cache shared by both legs (the resume
+        # leg's run name differs — num_epoch is part of it — so the
+        # per-run default dir would never warm across the kill): the warm
+        # path of the round-trip is part of what this smoke asserts.
+        # min secs 0: CPU-sized compiles must be cached too.
+        env["HYDRAGNN_COMPILE_CACHE"] = os.path.join(workdir, "xla_cache")
+        env["HYDRAGNN_COMPILE_CACHE_MIN_SECS"] = "0"
     return env
+
+
+def _plane_stats(text):
+    """(cache_hits, time_to_first_step, violations) from the compile-plane
+    report line, or None."""
+    m = None
+    for m in _PLANE_RE.finditer(text):
+        pass  # last line wins (a leg runs one training)
+    if m is None:
+        return None
+    ttfs = None if m.group(3) == "n/a" else float(m.group(3))
+    return int(m.group(1)), ttfs, int(m.group(4))
 
 
 def _losses(text):
@@ -96,7 +120,7 @@ def main() -> int:
     with open(script, "w") as f:
         f.write(_CHILD.format(repo=_REPO, num_epoch=10000, extra=""))
     proc = subprocess.Popen(
-        [sys.executable, script], cwd=workdir, env=_env(),
+        [sys.executable, script], cwd=workdir, env=_env(workdir),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     lines, deadline = [], time.time() + 300
@@ -148,7 +172,7 @@ def main() -> int:
             )
         )
     proc2 = subprocess.run(
-        [sys.executable, script2], cwd=workdir, env=_env(),
+        [sys.executable, script2], cwd=workdir, env=_env(workdir),
         capture_output=True, text=True, timeout=600,
     )
     if proc2.returncode != 0 or "CLEAN_EXIT" not in proc2.stdout:
@@ -167,18 +191,54 @@ def main() -> int:
     floor, cold = min(pre_kill), pre_kill[0]
     ok_continues = resumed[0] <= floor * 1.30
     ok_not_restart = resumed[0] < (cold + floor) / 2
+
+    # compile-plane warm path (docs/PERFORMANCE.md "Compile plane"): the
+    # resumed child shares the parent's persistent compilation cache, so it
+    # must report cache hits > 0 and a time-to-first-step bounded by the
+    # cold parent's (slack for CPU timing noise on tiny compiles)
+    cold_plane = _plane_stats(leg1)
+    warm_plane = _plane_stats(proc2.stdout + proc2.stderr)
+    if cold_plane is None or warm_plane is None:
+        print("chaos_smoke FAIL: compile-plane report line missing "
+              f"(cold={cold_plane}, warm={warm_plane})")
+        return 1
+    warm_hits, warm_ttfs, warm_viol = warm_plane
+    _, cold_ttfs, cold_viol = cold_plane
+    ok_warm_hits = warm_hits > 0
+    ok_ttfs = (
+        warm_ttfs is not None
+        and cold_ttfs is not None
+        and warm_ttfs <= cold_ttfs * 1.25 + 1.0
+    )
+    ok_no_retrace = cold_viol == 0 and warm_viol == 0
     verdict = {
         "metric": "chaos resume smoke (SIGTERM -> Training.continue)",
         "pre_kill": [round(l, 6) for l in pre_kill],
         "resumed": [round(l, 6) for l in resumed],
         "resumed_first_vs_floor": round(resumed[0] / max(floor, 1e-12), 4),
-        "ok": bool(ok_continues and ok_not_restart),
+        "compile_cache_hits_warm": warm_hits,
+        "time_to_first_step_cold": cold_ttfs,
+        "time_to_first_step_warm": warm_ttfs,
+        "ok": bool(ok_continues and ok_not_restart and ok_warm_hits
+                   and ok_ttfs and ok_no_retrace),
     }
     print(json.dumps(verdict))
-    if not verdict["ok"]:
+    if not (ok_continues and ok_not_restart):
         print("chaos_smoke FAIL: resumed loss does not continue the "
               f"pre-kill trend (floor={floor}, cold={cold}, "
               f"resumed_first={resumed[0]})")
+        return 1
+    if not ok_warm_hits:
+        print("chaos_smoke FAIL: resumed child reported zero compilation-"
+              "cache hits — the warm restart path recompiled from scratch")
+        return 1
+    if not ok_ttfs:
+        print("chaos_smoke FAIL: resumed child's time-to-first-step "
+              f"{warm_ttfs}s not bounded by the cold parent's {cold_ttfs}s")
+        return 1
+    if not ok_no_retrace:
+        print("chaos_smoke FAIL: retrace sentinel reported violations "
+              f"(cold={cold_viol}, warm={warm_viol})")
         return 1
     return 0
 

@@ -37,7 +37,10 @@ BENCH_GPS_SMOKE=1 python bench.py
 echo "== BENCH_GUARD smoke (guarded==unguarded loss, f32+bf16; step-time A/B shape) =="
 BENCH_GUARD_SMOKE=1 python bench.py
 
-echo "== chaos resume smoke (SIGTERM mid-run -> Training.continue round-trip) =="
+echo "== compile-plane smoke (background precompile + error-mode retrace sentinel; cold -> warm cache) =="
+python run-scripts/compile_smoke.py
+
+echo "== chaos resume smoke (SIGTERM mid-run -> Training.continue round-trip; warm-cache resume) =="
 python run-scripts/chaos_smoke.py
 
 echo "== data-plane chaos smoke (NaN samples/skip tally, error policy, socket drops, mid-epoch kill+resume order) =="
