@@ -995,12 +995,250 @@ def smoke_guard():
     }))
 
 
+def _serve_world():
+    """Small synthetic serve deployment for the BENCH_SERVE cells: model +
+    optimizer-free inference state + the dataset's SpecLadder, shapes via
+    BENCH_SERVE_* envs (defaults CPU-runnable for the ci.sh smoke;
+    hardware rounds raise them to the production shape)."""
+    from hydragnn_tpu.config import update_config, voi_from_config
+    from hydragnn_tpu.data import deterministic_graph_dataset, split_dataset
+    from hydragnn_tpu.data.graph import SpecLadder
+    from hydragnn_tpu.data.pipeline import extract_variables, spec_template_batches
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train.state import InferenceState
+
+    hidden = int(os.getenv("BENCH_SERVE_HIDDEN", "16"))
+    num_configs = int(os.getenv("BENCH_SERVE_NUM_CONFIGS", "96"))
+    batch = int(os.getenv("BENCH_SERVE_BATCH", "8"))
+    cfg = {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "bench_serve",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": num_configs},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1]},
+            "graph_features": {"name": ["s"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": hidden,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": hidden,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [hidden, hidden],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["s"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 1,
+                "batch_size": batch,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+    raw = deterministic_graph_dataset(
+        num_configs, seed=7, radius=2.0, max_neighbours=100
+    )
+    tr, va, te = split_dataset(raw, 0.7, seed=0)
+    cfg = update_config(cfg, tr, va, te)
+    ready = [extract_variables(g, voi_from_config(cfg)) for g in raw]
+    ladder = SpecLadder.for_dataset(ready, batch, num_buckets=2)
+    model = create_model(cfg)
+    tmpl = spec_template_batches(ready, ladder)[0][1]
+    state = InferenceState.create(init_model(model, tmpl, seed=0))
+    return model, state, ladder, ready
+
+
+def _serve_load_cell(server, graphs, offered_gps, duration_s):
+    """Open-loop load: submit at ``offered_gps`` for ``duration_s``; returns
+    latency percentiles over completed requests plus the shed/backpressure
+    tally. Latency = submit -> outcome via the handle's ``done_at`` stamp (no
+    waiter thread per request)."""
+    import numpy as np
+
+    from hydragnn_tpu.serve import RequestError
+
+    t_start = time.perf_counter()
+    handles, t0s = [], []
+    rejected = {}
+    i = 0
+    while True:
+        target = t_start + i / offered_gps
+        now = time.perf_counter()
+        if now - t_start >= duration_s:
+            break
+        if target > now:
+            time.sleep(target - now)
+        t0 = time.perf_counter()
+        try:
+            handles.append(server.submit(graphs[i % len(graphs)]))
+            t0s.append(t0)
+        except RequestError as e:
+            rejected[e.code] = rejected.get(e.code, 0) + 1
+        i += 1
+    for h in handles:
+        h.wait(120)
+    elapsed = time.perf_counter() - t_start
+    lats = np.array(
+        [h.done_at - t0 for h, t0 in zip(handles, t0s)
+         if h.done_at is not None and h.error(0) is None]
+    )
+    submitted = i
+    completed = len(lats)
+    shed = rejected.get("shed", 0) + rejected.get("queue_full", 0)
+    return {
+        "offered_gps": round(offered_gps, 1),
+        "achieved_gps": round(completed / elapsed, 1),
+        "submitted": submitted,
+        "completed": completed,
+        "shed": shed,
+        "shed_rate": round(shed / max(submitted, 1), 4),
+        "deadline_expired": rejected.get("deadline_exceeded", 0)
+        + sum(1 for h in handles if h.error(0) is not None),
+        "p50_ms": round(float(np.percentile(lats, 50)) * 1e3, 3) if completed else None,
+        "p99_ms": round(float(np.percentile(lats, 99)) * 1e3, 3) if completed else None,
+    }
+
+
+def main_serve():
+    """BENCH_SERVE=1: serving-plane cells — p50/p99 latency and achieved
+    throughput vs offered load, and shed rate under overload at a p99 SLO
+    (the r6 serving tentpole; docs/SERVING.md "Benchmarks").
+
+    Three open-loop cells against a live ``GraphServer`` with the sentinel
+    in error mode (any retrace mid-cell aborts the bench — serving latency
+    measured across a recompile would be a lie): ``light`` (0.5x measured
+    capacity) and ``at_slo`` (0.9x) must not shed; ``overload`` (3x) runs
+    with ``slo_p99_s`` armed and MUST shed rather than queue without bound.
+    CPU-runnable at the default tiny shapes (run-scripts/ci.sh invokes it
+    as a smoke); hardware rounds raise BENCH_SERVE_HIDDEN / _NUM_CONFIGS /
+    _BATCH / _SECS to the production shape. Cells append to
+    logs/serve_cells.jsonl as they complete."""
+    from hydragnn_tpu.serve import GraphServer, ServeConfig
+
+    duration = float(os.getenv("BENCH_SERVE_SECS", "3"))
+    model, state, ladder, graphs = _serve_world()
+    os.makedirs("logs", exist_ok=True)
+    out_path = os.path.join("logs", "serve_cells.jsonl")
+
+    def _bank(line):
+        print(line, flush=True)
+        with open(out_path, "a") as fh:
+            fh.write(line + "\n")
+
+    # calibration server: measure closed-loop capacity (no SLO, no shedding)
+    server = GraphServer(
+        model, state, ladder,
+        ServeConfig(micro_batch_graphs=int(os.getenv("BENCH_SERVE_BATCH", "8")),
+                    batch_window_s=0.002, retrace_policy="error",
+                    max_queue_requests=0),
+        template_graphs=graphs,
+    ).start()
+    try:
+        assert server.wait_ready(600), f"serve warm-up failed: {server.failed}"
+        t0 = time.perf_counter()
+        n_cal = min(len(graphs) * 4, 256)
+        out = server.predict(
+            [graphs[j % len(graphs)] for j in range(n_cal)], timeout=120
+        )
+        assert all(isinstance(o, dict) for o in out), "calibration failed"
+        capacity = n_cal / (time.perf_counter() - t0)
+    finally:
+        server.close(drain=False)
+
+    per_graph_s = 1.0 / capacity
+    slo_p99_s = float(os.getenv("BENCH_SERVE_SLO_S", str(20 * per_graph_s)))
+    cells = [
+        ("light", 0.5, 0.0),  # headroom: latency floor, zero shed
+        ("at_slo", 0.9, slo_p99_s),  # throughput at the p99 SLO
+        ("overload", 3.0, slo_p99_s),  # must shed, not queue unboundedly
+    ]
+    results = {}
+    for tag, factor, slo in cells:
+        server = GraphServer(
+            model, state, ladder,
+            ServeConfig(
+                micro_batch_graphs=int(os.getenv("BENCH_SERVE_BATCH", "8")),
+                batch_window_s=0.002,
+                retrace_policy="error",
+                slo_p99_s=slo,
+                expected_latency_per_graph_s=per_graph_s,
+                max_queue_requests=1024,
+            ),
+            template_graphs=graphs,
+        ).start()
+        try:
+            assert server.wait_ready(600), server.failed
+            cell = _serve_load_cell(
+                server, graphs, max(capacity * factor, 1.0), duration
+            )
+            stats = server.stats()
+        finally:
+            server.close(drain=False)
+        assert stats["retrace_violations"] == 0, (
+            f"cell {tag}: retraces under sustained load: "
+            f"{stats['retrace_violations']}"
+        )
+        cell.update(
+            variant=tag,
+            slo_p99_s=round(slo, 6),
+            batches=stats["batches"],
+            metric="serve load cell (GraphServer, error-mode sentinel)",
+            unit="graphs/sec",
+            value=cell["achieved_gps"],
+            capacity_gps=round(capacity, 1),
+            device_kind=_device_kind(),
+        )
+        results[tag] = cell
+        _bank(json.dumps(cell))
+    # structural sanity — the cells' claims, enforced where they're made
+    assert results["overload"]["shed"] > 0, (
+        "overload cell did not shed with the SLO armed: "
+        f"{results['overload']}"
+    )
+    for tag in ("light", "at_slo"):
+        c = results[tag]
+        assert c["completed"] > 0 and c["p50_ms"] <= c["p99_ms"], (tag, c)
+    _bank(json.dumps({
+        "metric": "serve_cells_done",
+        "cells": len(results),
+        "capacity_gps": round(capacity, 1),
+        "slo_p99_s": round(slo_p99_s, 6),
+        "throughput_at_slo_gps": results["at_slo"]["achieved_gps"],
+        "overload_shed_rate": results["overload"]["shed_rate"],
+        "ok": True,
+    }))
+
+
+def _device_kind() -> str:
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
 def main():
     if os.getenv("BENCH_GPS_SMOKE", "0") == "1":
         smoke_gps()
         return
     if os.getenv("BENCH_GUARD_SMOKE", "0") == "1":
         smoke_guard()
+        return
+    if os.getenv("BENCH_SERVE", "0") == "1":
+        main_serve()
         return
     if os.getenv("BENCH_AB", "0") == "1":
         main_ab()

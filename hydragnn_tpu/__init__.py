@@ -2,7 +2,9 @@
 network framework with the capability surface of HydraGNN (+GPS support).
 
 Public API mirrors the reference (hydragnn/__init__.py:1-3):
-``run_training(config)`` / ``run_prediction(config)`` plus model IO helpers.
+``run_training(config)`` / ``run_prediction(config)`` plus model IO helpers,
+and ``run_server(config)`` — the fault-tolerant micro-batched serving plane
+built on top of ``run_prediction``'s machinery (docs/SERVING.md).
 """
 
 __version__ = "0.1.0"
@@ -10,7 +12,7 @@ __version__ = "0.1.0"
 
 def __getattr__(name):
     # Lazy imports keep `import hydragnn_tpu` light (no jax init on import).
-    if name in ("run_training", "run_prediction"):
+    if name in ("run_training", "run_prediction", "run_server"):
         from . import api
 
         return getattr(api, name)

@@ -819,6 +819,30 @@ def _(config: str, model_state=None, datasets=None):
     return run_prediction(load_config(config), model_state, datasets)
 
 
+def _restore_for_inference(config, variables):
+    """Restore the run's newest verified checkpoint for inference into the
+    pre-initialized ``variables``: an optimizer-free ``InferenceState``
+    template through the msgpack chain (no AdamW moments allocated — 2x
+    params of dead memory on large models), falling back to the full
+    ``TrainState`` template only for orbax-backed runs (their
+    shard-parallel restore needs it). Returns ``(state, loaded_entry)`` —
+    the entry ACTUALLY restored, which the verified walk-back chain may
+    have taken PAST a corrupt ``latest``."""
+    from .train.checkpoint import latest_checkpoint_entry, load_inference_state
+    from .train.state import InferenceState
+
+    log_name = get_log_name_config(config)
+    entry = latest_checkpoint_entry(log_name)
+    if entry and entry.startswith("orbax/"):
+        tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+        loaded: list = []
+        state = load_existing_model(
+            TrainState.create(variables, tx), log_name, loaded_entry=loaded
+        )
+        return state, (loaded[0] if loaded else entry)
+    return load_inference_state(InferenceState.create(variables), log_name)
+
+
 @run_prediction.register
 def _(config: dict, model_state=None, datasets=None):
     """(reference: run_prediction.py:49-107): rebuild model, restore latest
@@ -830,13 +854,18 @@ def _(config: dict, model_state=None, datasets=None):
     _, _, test_loader = loaders
     # prediction is per-host (plain jitted eval): drop any device stacking
     test_loader = _localize_loader(test_loader)
+    # persistent compilation cache, same wiring as run_training: a serving/
+    # prediction restart must deserialize its eval executables instead of
+    # repaying the full compile bill (train/compile_plane.py)
+    from .train.compile_plane import setup_compile_cache
+
+    setup_compile_cache(
+        config["NeuralNetwork"]["Training"], get_log_name_config(config)
+    )
     model = create_model(config)
     if model_state is None:
         variables = init_model(model, next(iter(test_loader)), seed=0)
-        tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
-        template = TrainState.create(variables, tx)
-        log_name = get_log_name_config(config)
-        model_state = load_existing_model(template, log_name)
+        model_state, _ = _restore_for_inference(config, variables)
     tot, tasks, preds, trues = test_model(
         model,
         model_state,
@@ -892,3 +921,81 @@ def _(config: dict, model_state=None, datasets=None):
                 preds[name] = mm.denormalize_node(preds[name], sl)
                 trues[name] = mm.denormalize_node(trues[name], sl)
     return tot, tasks, preds, trues
+
+
+@functools.singledispatch
+def run_server(config, datasets=None, install_sigterm: bool = False):
+    raise TypeError(f"config must be a dict or str path, got {type(config)}")
+
+
+@run_server.register
+def _(config: str, datasets=None, install_sigterm: bool = False):
+    return run_server(load_config(config), datasets, install_sigterm)
+
+
+@run_server.register
+def _(config: dict, datasets=None, install_sigterm: bool = False):
+    """Config-driven serving entry point (docs/SERVING.md): complete the
+    config from data, restore the run's newest verified checkpoint into an
+    optimizer-free inference state, and start a ``GraphServer`` whose
+    micro-batcher packs requests into the run's SpecLadder pad buckets —
+    every servable shape AOT-warmed before readiness flips, the retrace
+    sentinel armed per ``Serving.retrace_policy`` (default ``error``).
+
+    Returns the STARTED server; callers submit requests and ``close()`` it
+    (it is also a context manager). ``install_sigterm=True`` wires SIGTERM
+    to a graceful drain. With no checkpoint on disk the server serves the
+    fresh initialization (warned — useful for smokes only).
+    """
+    import warnings as _warnings
+
+    from .parallel import setup_distributed
+    from .serve import CheckpointWatcher, GraphServer, ServeConfig
+    from .train.state import InferenceState
+
+    setup_distributed()
+    config, loaders, mm = prepare_data(config, datasets)
+    _, _, test_loader = loaders
+    test_loader = _localize_loader(test_loader)
+    log_name = get_log_name_config(config)
+    # persistent compilation cache BEFORE any jit touch, like run_training:
+    # a server restart deserializes the warmed ladder instead of recompiling
+    from .train.compile_plane import setup_compile_cache
+
+    setup_compile_cache(config["NeuralNetwork"]["Training"], log_name)
+    model = create_model(config)
+    variables = init_model(model, next(iter(test_loader)), seed=0)
+    try:
+        state, entry = _restore_for_inference(config, variables)
+    except FileNotFoundError:
+        _warnings.warn(
+            f"run {log_name!r} has no checkpoint on disk; serving the fresh "
+            "model initialization (train first for real predictions)",
+            stacklevel=2,
+        )
+        state = InferenceState.create(variables)
+        entry = None
+    training = config["NeuralNetwork"]["Training"]
+    arch = config["NeuralNetwork"]["Architecture"]
+    serve_cfg = ServeConfig.from_config(config)
+    server = GraphServer(
+        model,
+        state,
+        test_loader.ladder,
+        serve_cfg,
+        template_graphs=test_loader.graphs,
+        mixed_precision=bool(training.get("mixed_precision", False)),
+        sort_edges=bool(arch.get("use_sorted_aggregation", False)),
+        log_name=log_name,
+        checkpoint_label=entry,
+    )
+    server.start(install_sigterm=install_sigterm)
+    if serve_cfg.hot_reload:
+        watcher = CheckpointWatcher(
+            server,
+            log_name,
+            poll_s=serve_cfg.reload_poll_s,
+            initial_entry=entry,
+        ).start()
+        server.attach_watcher(watcher)
+    return server

@@ -460,6 +460,15 @@ def update_config(
         f"task_weights {arch['task_weights']} must match number of heads {len(output_dim)}"
     )
 
+    # ---- serving plane (docs/SERVING.md): validate the ``Serving`` section
+    # eagerly when present so a typo'd policy fails at load time, not when
+    # the server comes up under traffic. The section is optional — absent
+    # means "all defaults" and nothing is added to the saved config.
+    if config.get("Serving"):
+        from ..serve.config import ServeConfig
+
+        ServeConfig.from_config(config)
+
     config.setdefault("Verbosity", {"level": 0})
     config.setdefault("Visualization", {})
     return config

@@ -145,6 +145,17 @@ _HANDLED = {
     "NeuralNetwork.Training.warmup_epochs",
     "NeuralNetwork.Training.walltime_minutes",
     "Visualization.create_plots",
+    "Serving.max_queue_requests",
+    "Serving.micro_batch_graphs",
+    "Serving.batch_window_s",
+    "Serving.default_deadline_s",
+    "Serving.slo_p99_s",
+    "Serving.expected_latency_per_graph_s",
+    "Serving.step_timeout_s",
+    "Serving.retrace_policy",
+    "Serving.hot_reload",
+    "Serving.reload_poll_s",
+    "Serving.drain_timeout_s",
 }
 
 # reference keys that are intentionally NOT consumed here, with the
@@ -193,8 +204,11 @@ _LEGACY = {
 }
 
 # top-level Dataset/Architecture synonyms appearing in some reference
-# example configs at non-standard paths
-_TOPLEVEL_SECTIONS = ("Verbosity", "Dataset", "NeuralNetwork", "Visualization")
+# example configs at non-standard paths ("Serving" is this framework's own
+# section — no reference analog; docs/SERVING.md)
+_TOPLEVEL_SECTIONS = (
+    "Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving",
+)
 
 
 @dataclasses.dataclass(frozen=True)

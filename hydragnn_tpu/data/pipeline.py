@@ -68,6 +68,44 @@ def _pack_spec(
     )
 
 
+def spec_template_batches(
+    graphs: Sequence[Graph],
+    ladder: SpecLadder,
+    sort_edges: bool = False,
+    trip_count_of=None,
+) -> List[Tuple[PadSpec, GraphBatch]]:
+    """One template ``GraphBatch`` per ladder level the dataset can emit —
+    the warm-up inputs of both the training compile plane
+    (train/compile_plane.py) and the serving plane (serve/server.py).
+
+    Batch array SHAPES are fully determined by the pad spec plus the
+    dataset's feature widths, so a single fitting graph padded to the level
+    is abstractly identical to any real batch at that level. A level no
+    single dataset graph fits can never be selected by ``SpecLadder.select``
+    either (every batch total is >= its smallest member) and is skipped —
+    warm-up covers exactly the specializations batching can produce, no
+    more. ``trip_count_of`` overrides the per-graph triplet counter (the
+    loader passes its memoized table)."""
+    tcf = trip_count_of if trip_count_of is not None else _triplet_count
+    out: List[Tuple[PadSpec, GraphBatch]] = []
+    for spec in ladder.specs:
+        need_t = bool(spec.n_triplets)
+        g = next(
+            (
+                c
+                for c in graphs
+                if c.num_nodes <= spec.n_nodes - 1
+                and c.num_edges <= spec.n_edges
+                and (not need_t or tcf(c) <= spec.n_triplets)
+            ),
+            None,
+        )
+        if g is None:
+            continue
+        out.append((spec, batch_graphs([g], spec, sort_edges=sort_edges)))
+    return out
+
+
 @dataclasses.dataclass
 class VariablesOfInterest:
     """Selection of model inputs and per-head targets from raw feature tables.
@@ -812,15 +850,16 @@ class GraphLoader:
 
     def spec_template_batches(self) -> List[Tuple[PadSpec, GraphBatch]]:
         """One template ``GraphBatch`` per ladder level this loader can emit
-        — the compile plane's warm-up inputs (train/compile_plane.py).
-
-        Batch array SHAPES are fully determined by the pad spec plus the
-        dataset's feature widths, so a single fitting graph padded to the
-        level is abstractly identical to any real batch at that level. A
-        level no single dataset graph fits can never be selected by
-        ``SpecLadder.select`` either (every batch total is >= its smallest
-        member) and is skipped — warm-up covers exactly the specializations
-        the loader can produce, no more."""
+        — the compile plane's warm-up inputs (see the module-level
+        ``spec_template_batches`` for the shape argument). Stacked
+        (multi-shard) loaders pad the extra shard rows."""
+        if self.num_shards == 1:
+            return spec_template_batches(
+                self.graphs,
+                self.ladder,
+                sort_edges=self.sort_edges,
+                trip_count_of=self._trip_count_of,
+            )
         out: List[Tuple[PadSpec, GraphBatch]] = []
         for spec in self.ladder.specs:
             need_t = bool(spec.n_triplets)
@@ -836,13 +875,8 @@ class GraphLoader:
             )
             if g is None:
                 continue
-            if self.num_shards == 1:
-                out.append(
-                    (spec, batch_graphs([g], spec, sort_edges=self.sort_edges))
-                )
-            else:
-                shards = [[g]] + [[] for _ in range(self.num_shards - 1)]
-                out.append((spec, self._make_stacked(shards, spec)))
+            shards = [[g]] + [[] for _ in range(self.num_shards - 1)]
+            out.append((spec, self._make_stacked(shards, spec)))
         return out
 
     def _make(self, graphs: List[Graph]) -> GraphBatch:

@@ -51,6 +51,29 @@ R_SELF_LOOP = "self_loop_only"  # every edge is a self loop
 R_EMPTY = "empty_graph"  # zero nodes
 R_BUDGET = "budget_overflow"  # exceeds the pad/pack budget
 R_CORRUPT = "corrupt_sample"  # bytes failed to deserialize
+R_CHANNELS = "channel_mismatch"  # feature channel layout != the served model's
+
+# human-readable expansion of each rejection reason — shared by the data
+# plane's skip log and the serving plane's typed per-request errors
+# (serve/errors.InvalidRequestError), so both surfaces describe a bad
+# sample in the same words
+REASON_MESSAGES = {
+    R_NONFINITE: "a numeric channel contains NaN/Inf values",
+    R_BAD_EDGE: "edge sender/receiver indices fall outside [0, num_nodes)",
+    R_SELF_LOOP: "every edge is a self loop (degenerate connectivity)",
+    R_EMPTY: "the graph has zero nodes",
+    R_BUDGET: "the graph exceeds the pad/pack budget (nodes or edges)",
+    R_CORRUPT: "stored sample bytes failed to deserialize",
+    R_CHANNELS: (
+        "the feature channels present (or their widths) do not match the "
+        "layout the model was trained and warmed with"
+    ),
+}
+
+
+def describe_reason(reason: str) -> str:
+    """Human-readable expansion of a rejection-reason key."""
+    return REASON_MESSAGES.get(reason, reason)
 
 
 class BadSampleError(ValueError):

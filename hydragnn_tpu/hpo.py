@@ -315,7 +315,24 @@ def launch_hpo_workers(
         for log in logs:
             log.close()
     if failures:
+        # surface the failure, not just the fact of it: each failed worker's
+        # log tail rides the exception, so the jax.distributed/env class of
+        # errors that previously hid in hpo_workers/*.log (a file the parent
+        # never read) is in the parent's traceback directly
+        detail = []
+        for i, reason in failures:
+            log_path = os.path.join(workdir, f"worker{i}.log")
+            try:
+                with open(log_path, encoding="utf-8", errors="replace") as fh:
+                    tail = fh.read()[-2000:].strip()
+            except OSError as e:
+                tail = f"<log unreadable: {e}>"
+            detail.append(
+                f"--- worker {i} (reason: {reason}; log: {log_path}) ---\n"
+                + (tail or "<empty log>")
+            )
         raise RuntimeError(
-            f"HPO workers failed (worker, reason): {failures}; logs in {workdir}"
+            f"HPO workers failed (worker, reason): {failures}; log tails:\n"
+            + "\n".join(detail)
         )
     return merge_hpo_results(results)

@@ -1,0 +1,34 @@
+"""Serving plane: fault-tolerant micro-batched graph inference
+(docs/SERVING.md). ``api.run_server`` is the config-driven entry point;
+``GraphServer`` the direct constructor."""
+
+from .config import ServeConfig
+from .errors import (
+    DeadlineExceededError,
+    InvalidRequestError,
+    QueueFullError,
+    RequestError,
+    ServeError,
+    ServerClosedError,
+    ServerDrainingError,
+    SheddedError,
+    WedgedStepError,
+)
+from .reload import CheckpointWatcher
+from .server import GraphServer, PredictionHandle
+
+__all__ = [
+    "CheckpointWatcher",
+    "DeadlineExceededError",
+    "GraphServer",
+    "InvalidRequestError",
+    "PredictionHandle",
+    "QueueFullError",
+    "RequestError",
+    "ServeConfig",
+    "ServeError",
+    "ServerClosedError",
+    "ServerDrainingError",
+    "SheddedError",
+    "WedgedStepError",
+]

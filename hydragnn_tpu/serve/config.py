@@ -1,0 +1,115 @@
+"""Serving configuration: the ``Serving`` section of the JSON config.
+
+Same surface philosophy as the rest of the config system (config/config.py):
+a plain JSON section with complete defaults, validated eagerly so a typo'd
+policy fails at load time, not mid-traffic. ``update_config`` validates the
+section when present; ``config.lint`` knows every key. The full key table
+lives in docs/CONFIG.md ("Serving") and the semantics in docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import warnings
+from typing import Any, Dict
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Resolved serving policy knobs (all times in seconds).
+
+    - admission: ``max_queue_requests`` bounds the queue (0/negative =
+      unbounded), ``default_deadline_s`` is the per-request deadline when the
+      client does not set one (0 disables deadlines);
+    - batching: ``micro_batch_graphs`` caps graphs per device batch,
+      ``batch_window_s`` is how long the batcher waits to fill a batch after
+      the first request arrives;
+    - overload: ``slo_p99_s`` > 0 sheds admissions whose projected queue
+      wait exceeds it; ``expected_latency_per_graph_s`` seeds the wait
+      estimator before the first measured batch (0 = no shedding until the
+      warm-up measurement lands);
+    - fault tolerance: ``step_timeout_s`` bounds one device step (0 disables
+      the watchdog), ``retrace_policy`` is the sentinel mode once the warmed
+      ladder is armed (``error`` is the serving default: an unknown
+      specialization in steady state is a correctness bug, not a warning);
+    - lifecycle: ``hot_reload`` watches the run dir's ``latest`` pointer and
+      swaps verified checkpoints in between batches (``reload_poll_s``
+      cadence); ``drain_timeout_s`` bounds how long ``close()`` waits for
+      in-flight work.
+    """
+
+    max_queue_requests: int = 256
+    micro_batch_graphs: int = 32
+    batch_window_s: float = 0.005
+    default_deadline_s: float = 30.0
+    slo_p99_s: float = 0.0
+    expected_latency_per_graph_s: float = 0.0
+    step_timeout_s: float = 60.0
+    retrace_policy: str = "error"
+    hot_reload: bool = False
+    reload_poll_s: float = 2.0
+    drain_timeout_s: float = 30.0
+
+    _KNOWN = (
+        "max_queue_requests",
+        "micro_batch_graphs",
+        "batch_window_s",
+        "default_deadline_s",
+        "slo_p99_s",
+        "expected_latency_per_graph_s",
+        "step_timeout_s",
+        "retrace_policy",
+        "hot_reload",
+        "reload_poll_s",
+        "drain_timeout_s",
+    )
+
+    def __post_init__(self):
+        from ..train.compile_plane import RETRACE_POLICIES
+
+        if self.micro_batch_graphs < 1:
+            raise ValueError(
+                f"Serving.micro_batch_graphs must be >= 1, got "
+                f"{self.micro_batch_graphs}"
+            )
+        if self.retrace_policy not in RETRACE_POLICIES:
+            raise ValueError(
+                f"Serving.retrace_policy {self.retrace_policy!r} must be one "
+                f"of {RETRACE_POLICIES}"
+            )
+        for key in ("batch_window_s", "default_deadline_s", "slo_p99_s",
+                    "expected_latency_per_graph_s", "step_timeout_s",
+                    "reload_poll_s", "drain_timeout_s"):
+            if float(getattr(self, key)) < 0:
+                raise ValueError(
+                    f"Serving.{key} must be >= 0 (seconds; 0 disables), got "
+                    f"{getattr(self, key)!r}"
+                )
+
+    @staticmethod
+    def from_config(config: Dict[str, Any]) -> "ServeConfig":
+        """Resolve from a full run config's ``Serving`` section (missing
+        section = all defaults; ``micro_batch_graphs`` falls back to
+        ``Training.batch_size`` so the served shapes are the trained pad
+        buckets). Unknown keys warn — matching config completion's
+        ignore-unknown behavior — rather than failing the server."""
+        section = dict(config.get("Serving", {}) or {})
+        unknown = sorted(set(section) - set(ServeConfig._KNOWN))
+        if unknown:
+            warnings.warn(
+                f"Serving config keys {unknown} are not consumed (known keys: "
+                f"{list(ServeConfig._KNOWN)}); check docs/CONFIG.md for the "
+                "serving surface",
+                stacklevel=2,
+            )
+            for k in unknown:
+                section.pop(k)
+        if "micro_batch_graphs" not in section:
+            bs = (
+                config.get("NeuralNetwork", {})
+                .get("Training", {})
+                .get("batch_size")
+            )
+            if bs:
+                section["micro_batch_graphs"] = int(bs)
+        return ServeConfig(**section)

@@ -1,0 +1,103 @@
+"""Typed serving-plane errors — the request-lifecycle failure vocabulary.
+
+Every way a request can fail maps to exactly one exception class with a
+stable ``code`` string, so clients (and the chaos smoke) can branch on the
+failure *kind* without parsing messages. Admission-time failures (invalid
+request, queue full, shed, draining) are raised synchronously from
+``GraphServer.submit``; in-flight failures (deadline expiry at dequeue, a
+wedged device step, server shutdown) are delivered through the request's
+``PredictionHandle`` — the handle's ``result()`` re-raises them, ``error()``
+returns them as values.
+
+The failure model + policy matrix lives in docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServeError(RuntimeError):
+    """Base class of every serving-plane error."""
+
+    code = "serve_error"
+
+
+class RequestError(ServeError):
+    """A per-request failure: exactly one request is affected, and its
+    co-batched neighbors (if any) are not. Carries the request id when the
+    request got far enough to have one."""
+
+    code = "request_error"
+
+    def __init__(self, message: str, request_id: Optional[int] = None):
+        super().__init__(message)
+        self.request_id = request_id
+
+
+class InvalidRequestError(RequestError):
+    """The request graph failed the admission validation gate
+    (data/validate.validate_graph + the channel-signature check): NaN/Inf
+    channels, degenerate edge indices, an empty graph, a graph exceeding the
+    worst-case pad budget, or feature channels that do not match the model's
+    warmed batch layout. ``reason`` is the validator's rejection-reason key."""
+
+    code = "invalid_request"
+
+    def __init__(self, message: str, request_id: Optional[int] = None,
+                 reason: Optional[str] = None):
+        super().__init__(message, request_id)
+        self.reason = reason
+
+
+class QueueFullError(RequestError):
+    """The bounded admission queue is at ``Serving.max_queue_requests`` —
+    backpressure, distinct from SLO-based shedding."""
+
+    code = "queue_full"
+
+
+class SheddedError(RequestError):
+    """Load shed: the projected queue wait at admission time exceeded the
+    configured p99 SLO (``Serving.slo_p99_s``), so accepting the request
+    would blow its latency budget anyway. Carries the projection so clients
+    can implement informed backoff."""
+
+    code = "shed"
+
+    def __init__(self, message: str, request_id: Optional[int] = None,
+                 projected_wait_s: float = 0.0, slo_s: float = 0.0):
+        super().__init__(message, request_id)
+        self.projected_wait_s = projected_wait_s
+        self.slo_s = slo_s
+
+
+class DeadlineExceededError(RequestError):
+    """The request's deadline expired while it was still queued — it is
+    failed at dequeue time instead of wasting a batch slot on an answer the
+    client has already given up on."""
+
+    code = "deadline_exceeded"
+
+
+class WedgedStepError(RequestError):
+    """The device step serving this request's batch exceeded
+    ``Serving.step_timeout_s``. The batch's requests are failed with this
+    bounded error and the server recycles its step executor rather than
+    hanging every later request behind a wedged program."""
+
+    code = "wedged_step"
+
+
+class ServerDrainingError(RequestError):
+    """The server is draining (SIGTERM or an explicit ``drain()``): no new
+    admissions; in-flight requests still complete."""
+
+    code = "draining"
+
+
+class ServerClosedError(RequestError):
+    """The server is closed (or its warm-up failed); the request cannot be
+    served by this process."""
+
+    code = "closed"

@@ -1,0 +1,113 @@
+"""Hot checkpoint reload: watch a run dir's ``latest`` pointer and swap
+verified checkpoints into a live server without dropping requests.
+
+A training run (or a continuous-training fleet, ROADMAP item 5) keeps
+publishing checkpoints through the atomic pointer-commit protocol
+(train/checkpoint.py); the watcher polls the pointer and, on change,
+restores the candidate through the digest-verified walk-back chain into a
+standby state (``load_inference_state`` — params/batch-stats only, no
+optimizer allocation). The swap is staged via ``GraphServer._install_state``
+and taken by the serve loop *between* batches, so in-flight batches keep the
+weights they started with.
+
+Failure policy: a corrupt candidate (sha256 mismatch, torn write,
+deserialization failure) is REJECTED and the current weights keep serving —
+the walk-back chain restoring an *older* file than the pointer names is
+treated the same (installing it would silently downgrade the server). Every
+rejection is counted and warned once; the next pointer change triggers a
+fresh attempt. Exercised by tests/test_serve.py and
+run-scripts/serve_chaos_smoke.py (flip_bit on the candidate).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from typing import Optional
+
+
+class CheckpointWatcher:
+    """Daemon poller: ``latest`` pointer -> verified standby restore ->
+    atomic between-batch swap. ``stats`` counts installs and rejections."""
+
+    def __init__(
+        self,
+        server,
+        log_name: str,
+        path: str = "./logs",
+        poll_s: float = 2.0,
+        initial_entry: Optional[str] = None,
+    ):
+        self.server = server
+        self.log_name = log_name
+        self.path = path
+        self.poll_s = max(float(poll_s), 0.05)
+        self._last_entry = initial_entry
+        self._stop = threading.Event()
+        self.installed = 0
+        self.rejected = 0
+        self._thread = threading.Thread(
+            target=self._main, daemon=True, name="serve-ckpt-watch"
+        )
+
+    def start(self) -> "CheckpointWatcher":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def poll_once(self) -> Optional[str]:
+        """One poll step (also the test hook): returns ``installed``,
+        ``rejected``, or None when the pointer is unchanged/absent."""
+        from ..train.checkpoint import latest_checkpoint_entry, load_inference_state
+
+        entry = latest_checkpoint_entry(self.log_name, self.path)
+        if entry is None or entry == self._last_entry:
+            return None
+        # one attempt per pointer value: a corrupt candidate will not heal,
+        # so re-trying it every poll would just spam the log
+        self._last_entry = entry
+        try:
+            state, loaded_from = load_inference_state(
+                self.server._state, self.log_name, self.path
+            )
+        except Exception as e:  # noqa: BLE001 — keep serving current weights
+            self.rejected += 1
+            warnings.warn(
+                f"hot reload: candidate {entry!r} of run {self.log_name!r} "
+                f"failed to restore ({type(e).__name__}: {e}); keeping the "
+                f"current weights ({self.server.current_checkpoint})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return "rejected"
+        if loaded_from != entry:
+            # the verified walk-back chain fell PAST the candidate: the
+            # pointer names a corrupt file. Installing the older file it
+            # found instead would be a silent downgrade — keep current.
+            self.rejected += 1
+            warnings.warn(
+                f"hot reload: candidate {entry!r} failed verification (the "
+                f"restore chain fell back to {loaded_from!r}); keeping the "
+                f"current weights ({self.server.current_checkpoint})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return "rejected"
+        self.server._install_state(state, entry)
+        self.installed += 1
+        return "installed"
+
+    def _main(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the watcher must survive
+                warnings.warn(
+                    f"hot reload watcher error: {type(e).__name__}: {e}",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            self._stop.wait(self.poll_s)

@@ -1,0 +1,797 @@
+"""Fault-tolerant micro-batched graph inference server.
+
+The serving plane the ROADMAP's "millions of users" north star needs, built
+on the robustness substrate of the training side (docs/SERVING.md is the
+operator doc):
+
+- **admission + validation gate**: a bounded request queue with per-request
+  deadlines; every request passes ``data/validate.validate_graph`` plus a
+  channel-signature check at the door, so one malformed/NaN request gets a
+  typed per-request error (serve/errors.py) instead of poisoning the
+  co-batched requests beside it;
+- **micro-batcher**: admitted graphs are packed into the run's existing
+  ``SpecLadder`` pad buckets (``select_for`` picks the smallest warmed
+  level), so the device only ever sees shapes that were AOT-warmed at
+  startup — zero-retrace *and* latency-bounded by construction. Readiness
+  flips only after warm-up covers the whole ladder; the retrace sentinel
+  (train/compile_plane.py) then runs in ``error`` mode as the
+  serving-correctness guard;
+- **overload behavior**: load shedding with a typed ``SheddedError`` when
+  the projected queue wait exceeds the configured p99 SLO, and a
+  device-step watchdog that fails a wedged batch's requests with a bounded
+  ``WedgedStepError`` and recycles the step runner instead of hanging the
+  server;
+- **hot checkpoint reload** (serve/reload.py): the run dir's ``latest``
+  pointer is watched; candidates restore through the digest-verified
+  walk-back chain into a standby state and swap in atomically between
+  batches — a corrupt candidate is rejected and the current weights keep
+  serving;
+- **graceful drain**: ``initiate_drain`` (wired to SIGTERM by
+  ``install_sigterm``) stops admissions with a typed ``ServerDrainingError``
+  while every in-flight request still completes.
+
+Chaos hooks (exact no-ops unarmed) live in utils/faultinject.py:
+``HYDRAGNN_FAULT_SERVE_REQ_NAN`` / ``HYDRAGNN_FAULT_SERVE_WEDGE`` /
+``HYDRAGNN_FAULT_SERVE_SLOW_CLIENT``; tests/test_serve.py and
+run-scripts/serve_chaos_smoke.py drive every path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..data.graph import Graph, SpecLadder, batch_graphs
+from ..data.validate import R_CHANNELS, describe_reason, validate_graph
+from ..utils import faultinject
+from .config import ServeConfig
+from .errors import (
+    DeadlineExceededError,
+    InvalidRequestError,
+    QueueFullError,
+    RequestError,
+    ServerClosedError,
+    ServerDrainingError,
+    SheddedError,
+    WedgedStepError,
+)
+
+# consumer/waiter wake-up cadence (module-level so tests can pin it)
+_TICK_S = 0.02
+_JOIN_TIMEOUT_S = 5.0
+
+
+class PredictionHandle:
+    """Client-side handle for one submitted request. ``result()`` blocks for
+    the outcome and re-raises the request's typed error; ``error()`` returns
+    it as a value instead (the response-object style the chaos smoke and
+    ``GraphServer.predict`` use)."""
+
+    __slots__ = (
+        "request_id", "deadline", "done_at", "_event", "_result", "_error",
+    )
+
+    def __init__(self, request_id: int, deadline: float):
+        self.request_id = request_id
+        self.deadline = deadline
+        # monotonic completion stamp (perf_counter), set with the outcome —
+        # lets latency harnesses (BENCH_SERVE) compute per-request latency
+        # without a waiter thread per request
+        self.done_at: Optional[float] = None
+        self._event = threading.Event()
+        self._result: Optional[Dict[str, np.ndarray]] = None
+        self._error: Optional[RequestError] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._event.wait(timeout)
+
+    def error(self, timeout: Optional[float] = None) -> Optional[RequestError]:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} has no outcome after {timeout}s"
+            )
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, np.ndarray]:
+        err = self.error(timeout)
+        if err is not None:
+            raise err
+        return self._result
+
+    # -- server side --------------------------------------------------------
+    def _resolve(self, result: Dict[str, np.ndarray]) -> None:
+        self._result = result
+        self.done_at = time.perf_counter()
+        self._event.set()
+
+    def _fail(self, err: RequestError) -> None:
+        err.request_id = self.request_id
+        self._error = err
+        self.done_at = time.perf_counter()
+        self._event.set()
+
+
+@dataclasses.dataclass
+class _Request:
+    graph: Graph
+    handle: PredictionHandle
+
+
+def _strip_targets(g: Graph) -> Graph:
+    """Serving inputs carry no supervision: drop target tables (and the raw
+    graph feature table) so request batches share one pytree structure with
+    the warmed templates regardless of where the client got the graph."""
+    if g.graph_targets is None and g.node_targets is None and g.graph_y is None:
+        return g
+    return dataclasses.replace(
+        g, graph_targets=None, node_targets=None, graph_y=None
+    )
+
+
+def _channel_signature(g: Graph) -> Tuple[Tuple[str, int], ...]:
+    """(field, width) census of the channels that shape a batch pytree. Two
+    graphs with equal signatures batch into abstractly identical arrays; a
+    mismatch would force a new jit specialization (or crash batching), so it
+    is rejected at admission instead."""
+    sig: List[Tuple[str, int]] = []
+    for name in ("x", "pos", "edge_attr", "edge_shifts", "pe", "rel_pe", "z"):
+        v = getattr(g, name)
+        if v is None:
+            continue
+        arr = np.asarray(v)
+        sig.append((name, int(arr.shape[1]) if arr.ndim > 1 else 1))
+    return tuple(sig)
+
+
+class _StepTimeout(Exception):
+    """Internal: the step runner exceeded its watchdog budget."""
+
+
+class _StepRunner:
+    """One daemon worker executing device steps, replaceable on a wedge: a
+    step that blows ``step_timeout_s`` leaves its thread abandoned (daemon —
+    it cannot block process exit) and a fresh runner takes over, so the
+    serve loop never queues behind a hung XLA program."""
+
+    def __init__(self, name: str = "serve-step"):
+        self._in: "queue.Queue" = queue.Queue(maxsize=1)
+        self._out: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(target=self._main, daemon=True, name=name)
+        self._thread.start()
+
+    def _main(self) -> None:
+        while True:
+            thunk = self._in.get()
+            if thunk is None:
+                return
+            try:
+                self._out.put(("ok", thunk()))
+            except BaseException as e:  # surfaced in run()
+                self._out.put(("err", e))
+
+    def run(self, thunk, timeout: float):
+        self._in.put(thunk)
+        try:
+            kind, val = self._out.get(timeout=timeout if timeout > 0 else None)
+        except queue.Empty:
+            raise _StepTimeout() from None
+        if kind == "err":
+            raise val
+        return val
+
+    def stop(self) -> None:
+        try:
+            self._in.put_nowait(None)
+        except queue.Full:
+            pass  # wedged mid-step; the daemon thread is simply abandoned
+
+
+class GraphServer:
+    """Micro-batched ``run_prediction`` with a full request lifecycle.
+
+    Construct directly from (model, state, ladder, template graphs) or via
+    ``api.run_server`` (which restores the run's verified checkpoint and
+    reuses the data pipeline's ladder). ``state`` only needs a
+    ``variables()`` method — ``train.state.InferenceState`` is the intended
+    (optimizer-free) carrier, a full ``TrainState`` also works.
+    """
+
+    def __init__(
+        self,
+        model,
+        state,
+        ladder: SpecLadder,
+        serve_config: Optional[ServeConfig] = None,
+        *,
+        template_graphs: Sequence[Graph],
+        mixed_precision: bool = False,
+        sort_edges: bool = False,
+        log_name: str = "serve",
+        checkpoint_label: Optional[str] = None,
+    ):
+        self.model = model
+        self.cfg = serve_config or ServeConfig()
+        self.ladder = ladder
+        self.log_name = log_name
+        self.mixed_precision = mixed_precision
+        self.sort_edges = sort_edges
+        self.current_checkpoint = checkpoint_label
+        self._state = state
+        templates = [_strip_targets(g) for g in template_graphs]
+        clean = [g for g in templates if validate_graph(g) is None]
+        if not clean:
+            raise ValueError(
+                "GraphServer needs at least one valid template graph to warm "
+                "the pad-bucket ladder"
+            )
+        self._template_graphs = clean
+        self._channel_sig = _channel_signature(clean[0])
+        self._worst = ladder.specs[-1]
+        # real-graph slots are bounded by the worst spec too (n_graphs
+        # includes the +1 dummy slot): a Serving.micro_batch_graphs above
+        # the ladder's batch size would make every full batch overflow
+        # batch_graphs, failing its co-batched requests
+        self._batch_cap = min(
+            int(self.cfg.micro_batch_graphs), self._worst.n_graphs - 1
+        )
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=max(int(self.cfg.max_queue_requests), 0)
+        )
+        self._holdover: Optional[_Request] = None
+        self._submit_seq = itertools.count()
+        self._batch_seq = itertools.count()
+        self._inflight_graphs = 0
+        self._per_graph_s = float(self.cfg.expected_latency_per_graph_s)
+        self._swap_lock = threading.Lock()
+        self._pending_state: Optional[Tuple[Any, Optional[str]]] = None
+        self._ready = threading.Event()
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        self._stop = threading.Event()
+        self._closed = False
+        self._armed = False
+        # stats() reports violations as a delta against this launch-time
+        # baseline of the process-global sentinel — a warn-policy training
+        # run earlier in the process must not bleed into this server's count
+        from ..train.compile_plane import sentinel
+
+        self._violations_at_launch = len(sentinel().violations())
+        self.failed: Optional[Exception] = None
+        self.warmup_compiled: List[Tuple[str, float]] = []
+        self._stats_lock = threading.Lock()
+        self._stats: Dict[str, int] = {
+            "submitted": 0,
+            "admitted": 0,
+            "completed": 0,
+            "rejected": 0,
+            "shed": 0,
+            "queue_full": 0,
+            "deadline_expired": 0,
+            "wedged_batches": 0,
+            "failed_batches": 0,
+            "batches": 0,
+            "reloads": 0,
+        }
+        self._predict_fn = self._build_predict_fn()
+        self._runner: Optional[_StepRunner] = None
+        self._serve_thread: Optional[threading.Thread] = None
+        self._warm_thread: Optional[threading.Thread] = None
+        self._watcher = None  # serve/reload.CheckpointWatcher
+        self._prev_sigterm = None
+
+    # -- construction helpers ------------------------------------------------
+
+    def _build_predict_fn(self):
+        import jax
+
+        from ..train.compile_plane import note_trace
+        from ..train.loop import mp_cast_eval
+
+        model = self.model
+        mixed_precision = self.mixed_precision
+
+        @jax.jit
+        def predict_step(state, batch):
+            # retrace sentinel census: runs once per jit trace
+            note_trace("serve_predict", (state, batch))
+            variables = state.variables()
+            if mixed_precision:
+                variables, batch = mp_cast_eval(variables, batch, False)
+            return model.apply(variables, batch, train=False)
+
+        return predict_step
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, install_sigterm: bool = False) -> "GraphServer":
+        """Launch warm-up + the serve loop (and the checkpoint watcher when
+        ``Serving.hot_reload`` and a run dir are configured by the caller via
+        ``attach_watcher``). Admission opens immediately — requests queue
+        while the ladder warms; readiness (``wait_ready``) flips only once
+        every servable specialization is compiled and the sentinel is armed."""
+        if self._closed:
+            raise ServerClosedError("server is closed")
+        if self._serve_thread is not None:
+            return self
+        if install_sigterm:
+            import signal
+
+            def _on_sigterm(signum, frame):
+                # async-signal-safe: only flags; the serve loop finishes
+                # in-flight + queued work and then exits (graceful drain)
+                self.initiate_drain()
+                prev = self._prev_sigterm
+                if callable(prev):
+                    prev(signum, frame)
+
+            try:
+                self._prev_sigterm = signal.signal(signal.SIGTERM, _on_sigterm)
+            except ValueError:
+                pass  # not the main thread; the caller wires drain itself
+        self._warm_thread = threading.Thread(
+            target=self._warmup, daemon=True, name="serve-warmup"
+        )
+        self._warm_thread.start()
+        self._runner = _StepRunner()
+        self._serve_thread = threading.Thread(
+            target=self._serve_loop, daemon=True, name="serve-loop"
+        )
+        self._serve_thread.start()
+        return self
+
+    def attach_watcher(self, watcher) -> None:
+        """Register a started CheckpointWatcher so close() tears it down."""
+        self._watcher = watcher
+
+    def _warmup(self) -> None:
+        from ..data.pipeline import spec_template_batches
+        from ..train.compile_plane import serve_warmup
+
+        try:
+            templates = spec_template_batches(
+                self._template_graphs, self.ladder, sort_edges=self.sort_edges
+            )
+            if not templates:
+                raise ValueError(
+                    "no template graph fits any ladder level — the ladder "
+                    "does not describe the template dataset"
+                )
+            compiled, errors, exec_s = serve_warmup(
+                self._predict_fn,
+                self._state,
+                templates,
+                policy=self.cfg.retrace_policy,
+                label="serve",
+            )
+            self.warmup_compiled = compiled
+            if errors:
+                raise RuntimeError(
+                    f"serve warm-up failed for {len(errors)} specialization(s): "
+                    f"{errors}"
+                )
+            if self._stop.is_set():
+                # close() raced warm-up: it already evaluated (and skipped)
+                # its _armed disarm, so the sentinel serve_warmup just armed
+                # would leak error-mode into the rest of the process
+                from ..train.compile_plane import sentinel
+
+                sentinel().disarm()
+                return
+            self._armed = True
+            if self._per_graph_s <= 0 and exec_s > 0:
+                # seed the shed estimator with the measured worst-level
+                # execution time (one real graph per template batch)
+                self._per_graph_s = exec_s
+        except Exception as e:  # noqa: BLE001 — the server must fail typed
+            self.failed = e
+            self._stop.set()
+            self._drained.set()
+            self._fail_queued(
+                ServerClosedError(f"serve warm-up failed: {e}")
+            )
+            return
+        self._ready.set()
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining.is_set()
+
+    def wait_ready(self, timeout: Optional[float] = None) -> bool:
+        """Block until warm-up completes (True) or fails/times out (False;
+        ``self.failed`` carries the warm-up error)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not self._ready.is_set():
+            if self.failed is not None:
+                return False
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(_TICK_S)
+        return True
+
+    def initiate_drain(self) -> None:
+        """Stop admitting (async-signal-safe: only sets a flag); in-flight
+        and queued requests still complete. The SIGTERM hook."""
+        self._draining.set()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Initiate + wait for the drain to finish. Returns True when every
+        admitted request was answered."""
+        self.initiate_drain()
+        if timeout is None:
+            timeout = self.cfg.drain_timeout_s or None
+        return self._drained.wait(timeout)
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None) -> None:
+        """Shut down: optionally drain, stop every thread, disarm the
+        sentinel, and fail whatever is still queued with a typed error."""
+        if self._closed:
+            return
+        if drain and self._serve_thread is not None and self.failed is None:
+            self.drain(timeout)
+        self._closed = True
+        self._stop.set()
+        if self._watcher is not None:
+            self._watcher.stop()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=_JOIN_TIMEOUT_S)
+            if self._serve_thread.is_alive():
+                warnings.warn(
+                    "serve loop still alive at close(); leaking the daemon "
+                    "thread",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        if self._runner is not None:
+            self._runner.stop()
+        self._fail_queued(ServerClosedError("server closed"))
+        if self._armed:
+            from ..train.compile_plane import sentinel
+
+            sentinel().disarm()
+        if self._prev_sigterm is not None:
+            import signal
+
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+        self._drained.set()
+
+    def __enter__(self) -> "GraphServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(
+        self,
+        graph: Graph,
+        deadline_s: Optional[float] = None,
+        ) -> PredictionHandle:
+        """Admit one request. Admission-time rejections raise the typed
+        error directly (invalid request, queue full, shed, draining/closed);
+        an admitted request's later failures are delivered on the handle."""
+        idx = next(self._submit_seq)
+        self._bump("submitted")
+        # chaos hook: a slow client holding the admission door (no-op unarmed)
+        faultinject.maybe_slow_client(idx)
+        if self._closed or self.failed is not None:
+            self._bump("rejected")
+            raise ServerClosedError(
+                "server is closed"
+                if self.failed is None
+                else f"server failed at warm-up: {self.failed}",
+                request_id=idx,
+            )
+        if self._draining.is_set():
+            self._bump("rejected")
+            raise ServerDrainingError(
+                "server is draining (SIGTERM or drain()); request not admitted",
+                request_id=idx,
+            )
+        g = _strip_targets(graph)
+        # chaos hook: corrupt-request injection by submission index
+        g = faultinject.poison_request(g, idx)
+        if _channel_signature(g) != self._channel_sig:
+            self._bump("rejected")
+            raise InvalidRequestError(
+                f"request {idx} channel layout {_channel_signature(g)} does "
+                f"not match the served model's {self._channel_sig} — "
+                f"{describe_reason(R_CHANNELS)}",
+                request_id=idx,
+                reason=R_CHANNELS,
+            )
+        reason = validate_graph(
+            g, max_nodes=self._worst.n_nodes - 1, max_edges=self._worst.n_edges
+        )
+        if reason is not None:
+            self._bump("rejected")
+            raise InvalidRequestError(
+                f"request {idx} rejected: {reason} ({describe_reason(reason)})",
+                request_id=idx,
+                reason=reason,
+            )
+        # load shedding: admit only what can plausibly meet the p99 SLO
+        if self.cfg.slo_p99_s > 0 and self._per_graph_s > 0:
+            backlog = self._queue.qsize() + self._inflight_graphs + (
+                1 if self._holdover is not None else 0
+            )
+            projected = backlog * self._per_graph_s
+            if projected > self.cfg.slo_p99_s:
+                self._bump("shed")
+                raise SheddedError(
+                    f"request {idx} shed: projected queue wait "
+                    f"{projected:.3f}s exceeds the p99 SLO "
+                    f"{self.cfg.slo_p99_s:.3f}s",
+                    request_id=idx,
+                    projected_wait_s=projected,
+                    slo_s=self.cfg.slo_p99_s,
+                )
+        if deadline_s is None:
+            deadline_s = self.cfg.default_deadline_s
+        deadline = (
+            time.monotonic() + float(deadline_s) if deadline_s else float("inf")
+        )
+        handle = PredictionHandle(idx, deadline)
+        try:
+            self._queue.put_nowait(_Request(g, handle))
+        except queue.Full:
+            self._bump("queue_full")
+            raise QueueFullError(
+                f"request {idx} rejected: admission queue is at its bound "
+                f"({self.cfg.max_queue_requests} requests)",
+                request_id=idx,
+            ) from None
+        self._bump("admitted")
+        return handle
+
+    def predict(
+        self,
+        graphs: Sequence[Graph],
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+    ) -> List[Union[Dict[str, np.ndarray], RequestError]]:
+        """Blocking convenience: one outcome per input graph — a per-head
+        prediction dict, or the request's typed ``RequestError`` as a value
+        (admission rejections included), so one bad request never hides the
+        results of the good ones beside it."""
+        handles: List[Union[PredictionHandle, RequestError]] = []
+        for g in graphs:
+            try:
+                handles.append(self.submit(g, deadline_s=deadline_s))
+            except RequestError as e:
+                handles.append(e)
+        out: List[Union[Dict[str, np.ndarray], RequestError]] = []
+        for h in handles:
+            if isinstance(h, RequestError):
+                out.append(h)
+                continue
+            err = h.error(timeout)
+            out.append(err if err is not None else h.result(0))
+        return out
+
+    # -- serve loop ----------------------------------------------------------
+
+    def _take_request(self, timeout: float) -> Optional[_Request]:
+        """Next admitted request, honoring the holdover slot and failing
+        deadline-expired requests at dequeue (never wasting batch slots on
+        answers nobody is waiting for)."""
+        deadline = time.monotonic() + max(timeout, 0.0)
+        while not self._stop.is_set():
+            if self._holdover is not None:
+                req, self._holdover = self._holdover, None
+            else:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 and timeout > 0:
+                    return None
+                try:
+                    req = self._queue.get(
+                        timeout=min(max(remaining, 0.0), _TICK_S)
+                        if timeout > 0
+                        else _TICK_S
+                    )
+                except queue.Empty:
+                    if timeout > 0:
+                        continue
+                    return None
+            if time.monotonic() > req.handle.deadline:
+                self._bump("deadline_expired")
+                req.handle._fail(
+                    DeadlineExceededError(
+                        "deadline expired while queued (waited past the "
+                        "request's budget)"
+                    )
+                )
+                continue
+            return req
+        return None
+
+    def _collect_batch(self) -> Optional[List[_Request]]:
+        """Form one micro-batch: wait for a first request, then fill from
+        the queue until the graph-count cap, the worst-spec pad budget, or
+        the batch window closes. A request that does not fit is held over to
+        lead the next batch."""
+        first = self._take_request(timeout=0.0)
+        if first is None:
+            return None
+        reqs = [first]
+        n = first.graph.num_nodes
+        e = first.graph.num_edges
+        window_ends = time.monotonic() + self.cfg.batch_window_s
+        while len(reqs) < self._batch_cap:
+            remaining = window_ends - time.monotonic()
+            if remaining <= 0 and self._queue.qsize() == 0 and self._holdover is None:
+                break
+            req = self._take_request(timeout=max(remaining, _TICK_S / 10))
+            if req is None:
+                break
+            gn, ge = req.graph.num_nodes, req.graph.num_edges
+            if n + gn > self._worst.n_nodes - 1 or e + ge > self._worst.n_edges:
+                self._holdover = req
+                break
+            reqs.append(req)
+            n, e = n + gn, e + ge
+        return reqs
+
+    def _serve_loop(self) -> None:
+        import jax
+
+        # process nothing before the ladder is warm: the first organic batch
+        # must already be a cache hit (readiness == zero-retrace)
+        while not self._ready.is_set():
+            if self._stop.is_set():
+                return
+            time.sleep(_TICK_S)
+        while not self._stop.is_set():
+            reqs = self._collect_batch()
+            # hot-reload swap point: AFTER batch formation, before dispatch —
+            # between batches, never mid-flight, and a state installed while
+            # the loop was blocked waiting for requests is guaranteed to
+            # serve the very next batch (not the one after)
+            with self._swap_lock:
+                if self._pending_state is not None:
+                    self._state, self.current_checkpoint = self._pending_state
+                    self._pending_state = None
+                    self._bump("reloads")
+            if reqs is None:
+                if self._draining.is_set() and self._queue.qsize() == 0 and (
+                    self._holdover is None
+                ):
+                    break
+                continue
+            self._inflight_graphs = len(reqs)
+            batch_index = next(self._batch_seq)
+            state = self._state
+            graphs = [r.graph for r in reqs]
+            t0 = time.perf_counter()
+            try:
+                spec = self.ladder.select_for(graphs)
+                batch = batch_graphs(graphs, spec, sort_edges=self.sort_edges)
+
+                def step(_state=state, _batch=batch, _bi=batch_index):
+                    # chaos hook: a wedged device step (no-op unarmed)
+                    faultinject.maybe_serve_wedge(_bi)
+                    return jax.device_get(self._predict_fn(_state, _batch))
+
+                outputs = self._runner.run(step, self.cfg.step_timeout_s)
+            except _StepTimeout:
+                self._bump("wedged_batches")
+                # the wedged runner thread is abandoned (daemon); recycle
+                self._runner = _StepRunner()
+                for r in reqs:
+                    r.handle._fail(
+                        WedgedStepError(
+                            f"device step for batch {batch_index} exceeded "
+                            f"step_timeout_s={self.cfg.step_timeout_s}s; the "
+                            "batch was abandoned and the step runner recycled"
+                        )
+                    )
+                self._inflight_graphs = 0
+                continue
+            except Exception as e:  # noqa: BLE001 — batch-level failure
+                self._bump("failed_batches")
+                for r in reqs:
+                    r.handle._fail(
+                        RequestError(
+                            f"batch {batch_index} failed: "
+                            f"{type(e).__name__}: {e}"
+                        )
+                    )
+                self._inflight_graphs = 0
+                continue
+            dt = time.perf_counter() - t0
+            self._deliver(reqs, batch, outputs)
+            self._bump("batches")
+            self._bump("completed", len(reqs))
+            # EMA service-time estimate drives the shed projection
+            per_graph = dt / len(reqs)
+            self._per_graph_s = (
+                per_graph
+                if self._per_graph_s <= 0
+                else 0.8 * self._per_graph_s + 0.2 * per_graph
+            )
+            self._inflight_graphs = 0
+        self._drained.set()
+
+    def _deliver(self, reqs: List[_Request], batch, outputs: Dict[str, Any]) -> None:
+        """Slice the padded batch outputs back into per-request, per-head
+        host arrays: graph-level heads by graph row, node-level heads by the
+        request's node span."""
+        node_offsets = np.cumsum([0] + [r.graph.num_nodes for r in reqs])
+        n_graphs = batch.num_graphs
+        n_nodes = batch.num_nodes
+        for i, r in enumerate(reqs):
+            result: Dict[str, np.ndarray] = {}
+            for name, arr in outputs.items():
+                a = np.asarray(arr)
+                if a.ndim and a.shape[0] == n_graphs:
+                    result[name] = a[i]
+                elif a.ndim and a.shape[0] == n_nodes:
+                    result[name] = a[node_offsets[i] : node_offsets[i + 1]]
+                else:  # scalar/aux output: handed through as-is
+                    result[name] = a
+            r.handle._resolve(result)
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    def _fail_queued(self, err: RequestError) -> None:
+        if self._holdover is not None:
+            self._holdover.handle._fail(err)
+            self._holdover = None
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.handle._fail(err)
+
+    def _install_state(self, state, label: Optional[str]) -> None:
+        """Stage a reloaded state; the serve loop swaps it in at the next
+        batch boundary (in-flight batches keep the weights they started
+        with)."""
+        with self._swap_lock:
+            self._pending_state = (state, label)
+
+    def _bump(self, key: str, by: int = 1) -> None:
+        with self._stats_lock:
+            self._stats[key] = self._stats.get(key, 0) + by
+
+    def stats(self) -> Dict[str, Any]:
+        """Structured serving counters + the current policy/observability
+        snapshot (the chaos smoke and BENCH_SERVE parse this)."""
+        from ..train.compile_plane import sentinel
+
+        with self._stats_lock:
+            out: Dict[str, Any] = dict(self._stats)
+        out.update(
+            ready=self.ready,
+            draining=self.draining,
+            closed=self._closed,
+            queued=self._queue.qsize(),
+            per_graph_latency_s=round(self._per_graph_s, 6),
+            ladder_levels=len(self.ladder.specs),
+            warmed_specializations=len(self.warmup_compiled),
+            retrace_violations=max(
+                len(sentinel().violations()) - self._violations_at_launch, 0
+            ),
+            current_checkpoint=self.current_checkpoint,
+        )
+        return out

@@ -38,10 +38,11 @@ import time
 import warnings
 from typing import List, Optional
 
+import numpy as np
 from flax import serialization
 
 from ..utils import faultinject
-from .state import LoaderState, TrainState
+from .state import InferenceState, LoaderState, TrainState
 
 _EPOCH_RE = re.compile(r"_epoch(\d+)\.msgpack$")
 _LOADER_STATE_FILE = "loader_state.json"
@@ -354,21 +355,25 @@ def _msgpack_candidates(d: str, entry: Optional[str]) -> List[str]:
     return out
 
 
-def load_existing_model(
-    template_state: TrainState, log_name: str, path: str = "./logs"
-) -> TrainState:
-    """Restore into a template with identical pytree structure
-    (reference: load_existing_model, model.py:128-149). The ``latest``
-    pointer selects the backend: an ``orbax/<step>`` entry restores through
-    orbax, a ``*.msgpack`` entry through flax serialization.
+def latest_checkpoint_entry(
+    log_name: str, path: str = "./logs"
+) -> Optional[str]:
+    """Raw content of a run's ``latest`` pointer (e.g. ``run_epoch3.msgpack``
+    or ``orbax/3``), or None when the pointer is missing/unreadable. The
+    hot-reload watcher (serve/reload.py) polls this, and prediction uses it
+    to pick the restore backend without touching the payloads."""
+    fname = os.path.join(path, log_name, "latest")
+    try:
+        with open(fname) as f:
+            return f.read().strip() or None
+    except OSError:
+        return None
 
-    Every msgpack candidate is digest-verified against its sha256 sidecar;
-    on corruption (or a failed orbax restore) the walk falls back through
-    older retained epochs, newest first. Total failure raises a
-    FileNotFoundError that lists the run dir's files and every candidate
-    tried with the reason it was rejected."""
+
+def _resolve_restore_dir(log_name: str, path: str, tried: List[str]):
+    """Shared restore preamble: the run dir (must exist) and the ``latest``
+    entry (with the missing-pointer fallback recorded in ``tried``)."""
     d = os.path.join(path, log_name)
-    tried: List[str] = []
     if not os.path.isdir(d):
         raise FileNotFoundError(
             f"no checkpoint for run {log_name!r}: directory {d!r} does not "
@@ -386,31 +391,24 @@ def load_existing_model(
     else:
         entry = f"{log_name}.msgpack"
         tried.append("latest: missing (trying the default msgpack name)")
-    if entry and entry.startswith("orbax/"):
-        try:
-            import orbax.checkpoint as ocp
+    return d, entry
 
-            step = int(entry.split("/", 1)[1])
-            with ocp.CheckpointManager(
-                os.path.abspath(os.path.join(d, "orbax"))
-            ) as mgr:
-                return mgr.restore(
-                    step, args=ocp.args.StandardRestore(template_state)
-                )
-        except Exception as e:  # noqa: BLE001 — fall back to the msgpack chain
-            tried.append(f"{entry}: orbax restore failed ({e})")
+
+def _verified_candidate_blobs(d: str, entry: Optional[str], tried: List[str]):
+    """Yield ``(filename, verified bytes)`` for every restorable msgpack
+    candidate, newest first — the digest-verified walk-back chain shared by
+    the full and the inference-only restore."""
     for fn in _msgpack_candidates(d, entry):
         full = os.path.join(d, fn)
         if not os.path.exists(full):
             tried.append(f"{fn}: missing")
             continue
         blob = _verified_read(full, tried)
-        if blob is None:
-            continue
-        try:
-            return serialization.from_bytes(template_state, blob)
-        except Exception as e:  # noqa: BLE001 — structure drift / truncation
-            tried.append(f"{fn}: deserialization failed ({e})")
+        if blob is not None:
+            yield fn, blob
+
+
+def _raise_no_checkpoint(log_name: str, d: str, tried: List[str]):
     try:
         files = sorted(os.listdir(d))
     except OSError:
@@ -423,3 +421,93 @@ def load_existing_model(
         "mismatch means the file is corrupt — delete it to silence the "
         "fallback, or restore an older epoch by editing 'latest'."
     )
+
+
+def load_inference_state(
+    template, log_name: str, path: str = "./logs"
+) -> "tuple[InferenceState, str]":
+    """Restore ONLY the params/batch-stats/step subtrees of a run's newest
+    verified checkpoint into an inference template — no optimizer state is
+    deserialized or allocated (AdamW moments are 2x params of dead memory on
+    a serving host). ``template`` is an ``InferenceState`` (or anything with
+    ``.params``/``.batch_stats``/``.replace``, e.g. a live server state).
+
+    Walks the same digest-verified candidate chain as
+    ``load_existing_model`` and returns ``(state, loaded_filename)`` — the
+    filename lets hot reload distinguish "the candidate restored" from "the
+    chain fell back past a corrupt candidate" (serve/reload.py keeps the
+    current weights in the latter case). Orbax-backed runs raise ValueError:
+    their shard-parallel restore needs the full-template path."""
+    tried: List[str] = []
+    d, entry = _resolve_restore_dir(log_name, path, tried)
+    if entry and entry.startswith("orbax/"):
+        raise ValueError(
+            f"run {log_name!r} checkpoints through orbax ({entry!r}); the "
+            "inference-only restore covers the msgpack chain — restore "
+            "through load_existing_model with a full TrainState template "
+            "instead"
+        )
+    for fn, blob in _verified_candidate_blobs(d, entry, tried):
+        try:
+            raw = serialization.msgpack_restore(blob)
+            restored = template.replace(
+                params=serialization.from_state_dict(
+                    template.params, raw["params"]
+                ),
+                batch_stats=serialization.from_state_dict(
+                    template.batch_stats, raw.get("batch_stats", {})
+                ),
+                step=int(np.asarray(raw.get("step", 0))),
+            )
+            return restored, fn
+        except Exception as e:  # noqa: BLE001 — structure drift / truncation
+            tried.append(f"{fn}: inference deserialization failed ({e})")
+    _raise_no_checkpoint(log_name, d, tried)
+
+
+def load_existing_model(
+    template_state: TrainState,
+    log_name: str,
+    path: str = "./logs",
+    loaded_entry: Optional[List[str]] = None,
+) -> TrainState:
+    """Restore into a template with identical pytree structure
+    (reference: load_existing_model, model.py:128-149). The ``latest``
+    pointer selects the backend: an ``orbax/<step>`` entry restores through
+    orbax, a ``*.msgpack`` entry through flax serialization.
+
+    Every msgpack candidate is digest-verified against its sha256 sidecar;
+    on corruption (or a failed orbax restore) the walk falls back through
+    older retained epochs, newest first — pass a list as ``loaded_entry``
+    to receive the entry ACTUALLY restored (it may be older than the
+    pointer names). Total failure raises a FileNotFoundError that lists the
+    run dir's files and every candidate tried with the reason it was
+    rejected."""
+    tried: List[str] = []
+    d, entry = _resolve_restore_dir(log_name, path, tried)
+    if entry and entry.startswith("orbax/"):
+        try:
+            import orbax.checkpoint as ocp
+
+            step = int(entry.split("/", 1)[1])
+            with ocp.CheckpointManager(
+                os.path.abspath(os.path.join(d, "orbax"))
+            ) as mgr:
+                restored = mgr.restore(
+                    step, args=ocp.args.StandardRestore(template_state)
+                )
+            if loaded_entry is not None:
+                loaded_entry.append(entry)
+            return restored
+        except Exception as e:  # noqa: BLE001 — fall back to the msgpack chain
+            tried.append(f"{entry}: orbax restore failed ({e})")
+    for fn, blob in _verified_candidate_blobs(d, entry, tried):
+        try:
+            restored = serialization.from_bytes(template_state, blob)
+        except Exception as e:  # noqa: BLE001 — structure drift / truncation
+            tried.append(f"{fn}: deserialization failed ({e})")
+            continue
+        if loaded_entry is not None:
+            loaded_entry.append(fn)
+        return restored
+    _raise_no_checkpoint(log_name, d, tried)

@@ -399,6 +399,63 @@ def note_trace(name: str, args) -> None:
     _SENTINEL.note(name, args)
 
 
+def serve_warmup(
+    fn,
+    state,
+    templates,
+    policy: str = "error",
+    label: str = "serve",
+) -> Tuple[List[Tuple[str, float]], List[Tuple[str, str]], float]:
+    """Serving-side blocking warm-up: CALL the jit object on one template
+    batch per ladder level and block until each executes.
+
+    Unlike the training plane's ``lower().compile()`` jobs (whose AOT
+    executables are only reachable from the call path through the persistent
+    cache), calling the jit object directly lands every specialization in
+    its OWN executable cache — so once this returns, the serve loop's first
+    organic visit to any level is a pure cache hit regardless of persistent-
+    cache configuration (the persistent cache still buys down *restarts*).
+    Readiness == zero-retrace steady state by construction.
+
+    On full coverage the retrace sentinel is armed at ``policy`` (serving
+    default ``error``: an unknown specialization under live traffic is a
+    correctness bug). Returns ``(compiled, errors, last_exec_s)`` where
+    ``compiled`` is [(label, seconds)] per level, ``errors`` the failures
+    (arming is skipped if any), and ``last_exec_s`` the warm re-execution
+    time of the final (worst-case) level — the serving-latency seed for the
+    shed estimator."""
+    if policy not in RETRACE_POLICIES:
+        raise ValueError(
+            f"retrace policy {policy!r} must be one of {RETRACE_POLICIES}"
+        )
+    import jax
+
+    install_metrics_listeners()
+    compiled: List[Tuple[str, float]] = []
+    errors: List[Tuple[str, str]] = []
+    last_exec_s = 0.0
+    for spec, tmpl in templates:
+        name = f"{label}:{spec.n_nodes}n/{spec.n_edges}e"
+        t0 = time.perf_counter()
+        try:
+            jax.block_until_ready(fn(state, tmpl))
+        except Exception as e:  # noqa: BLE001 — reported, never raised here
+            errors.append((name, f"{type(e).__name__}: {e}"))
+            continue
+        compiled.append((name, time.perf_counter() - t0))
+    if templates and not errors:
+        # warm re-execution of the worst level: compile excluded, pure step
+        spec, tmpl = templates[-1]
+        t0 = time.perf_counter()
+        try:
+            jax.block_until_ready(fn(state, tmpl))
+            last_exec_s = time.perf_counter() - t0
+        except Exception:  # pragma: no cover - first call succeeded above
+            pass
+        _SENTINEL.arm(policy)
+    return compiled, errors, last_exec_s
+
+
 def attach_lower_fn(fn, jitted, batch_transform: Optional[Callable] = None,
                     batch_argnum: int = 1):
     """Mark a step-fn *wrapper* as AOT-lowerable: ``fn`` is what the loop

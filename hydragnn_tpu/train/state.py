@@ -51,6 +51,37 @@ class TrainState:
         return self.replace(opt_state=self.opt_state._replace(hyperparams=hp))
 
 
+@struct.dataclass
+class InferenceState:
+    """Params + batch stats only — the optimizer-free restore target for
+    prediction and serving.
+
+    ``run_prediction``/``run_server`` used to build a full ``TrainState``
+    (AdamW moments = 2x params of dead memory on large models) just to have
+    a restore template; checkpoints now restore their params/batch-stats
+    subtrees into this instead (train/checkpoint.py
+    ``load_inference_state``). Mirrors ``TrainState.variables()`` so every
+    eval/predict step accepts either state."""
+
+    params: Any
+    batch_stats: Any
+    step: Any = 0
+
+    @staticmethod
+    def create(variables: Dict[str, Any]) -> "InferenceState":
+        return InferenceState(
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            step=0,
+        )
+
+    def variables(self) -> Dict[str, Any]:
+        v = {"params": self.params}
+        if self.batch_stats:
+            v["batch_stats"] = self.batch_stats
+        return v
+
+
 @dataclasses.dataclass(frozen=True)
 class LoaderState:
     """Sampler/loader position serialized beside the TrainState checkpoint

@@ -46,9 +46,27 @@ Data-plane points (docs/ROBUSTNESS.md "Data plane"):
   sentinel — the wedged/dead-worker models the loader watchdog turns into
   an actionable LoaderStallError.
 
+Serve-plane points (docs/SERVING.md "Failure model"):
+
+- ``HYDRAGNN_FAULT_SERVE_REQ_NAN``: ``poison_request`` NaNs the first
+  feature of the listed *submission indices* (``"3"`` / ``"3,7"``) right
+  after the client hands the graph over — the corrupt-request model the
+  admission gate must turn into a typed per-request error while the
+  co-batched requests beside it succeed.
+- ``HYDRAGNN_FAULT_SERVE_WEDGE`` (``"k"`` or ``"k:secs"``):
+  ``maybe_serve_wedge`` sleeps inside the device-step runner before batch
+  k's dispatch (default 60s — longer than any sane step watchdog) — the
+  wedged-step model the serving watchdog must bound with a typed error and
+  a recycled runner instead of hanging the server.
+- ``HYDRAGNN_FAULT_SERVE_SLOW_CLIENT`` (``"i"`` or ``"i:secs"``):
+  ``maybe_slow_client`` sleeps at the listed submissions' admission call —
+  the slow-client model (admission must not be wedged by one caller; other
+  threads keep being served).
+
 ``flip_bit`` is the host-side corruption tool for the torn/rotted-checkpoint
 tests: flip one bit of a saved file and assert restore falls back to the
-previous verified epoch.
+previous verified epoch (the serve chaos smoke also uses it to corrupt a
+hot-reload candidate).
 """
 
 from __future__ import annotations
@@ -80,6 +98,9 @@ def configure(**kwargs: Optional[str]) -> None:
         "socket_drop": "HYDRAGNN_FAULT_SOCKET_DROP",
         "loader_stall": "HYDRAGNN_FAULT_LOADER_STALL",
         "loader_die": "HYDRAGNN_FAULT_LOADER_DIE",
+        "serve_req_nan": "HYDRAGNN_FAULT_SERVE_REQ_NAN",
+        "serve_wedge": "HYDRAGNN_FAULT_SERVE_WEDGE",
+        "serve_slow_client": "HYDRAGNN_FAULT_SERVE_SLOW_CLIENT",
     }
     for k, v in kwargs.items():
         if k not in keymap:
@@ -256,6 +277,49 @@ def maybe_loader_fault(batch_index: int) -> Optional[str]:
 
             time.sleep(float(secs) if secs else 60.0)
     return None
+
+
+def poison_request(graph, idx: int):
+    """Serve-plane ingest corruption: when submission index ``idx`` is armed
+    (HYDRAGNN_FAULT_SERVE_REQ_NAN), return ``graph`` with its first feature
+    NaN'd; the same graph object otherwise (exact no-op unarmed). The
+    corrupt-request model the admission validation gate must catch as a
+    typed per-request error."""
+    if idx not in _index_set(_get("HYDRAGNN_FAULT_SERVE_REQ_NAN")):
+        return graph
+    import dataclasses
+
+    import numpy as np
+
+    x = np.array(graph.x, dtype=np.float32, copy=True)
+    x.flat[0] = np.nan
+    return dataclasses.replace(graph, x=x)
+
+
+def _indexed_sleep(spec: Optional[str], index: int, default_secs: float) -> None:
+    if spec is None:
+        return
+    k, _, secs = spec.partition(":")
+    if index in _index_set(k):
+        import time
+
+        time.sleep(float(secs) if secs else default_secs)
+
+
+def maybe_serve_wedge(batch_index: int) -> None:
+    """Sleep inside the serving step runner before dispatching batch
+    ``batch_index`` when armed (HYDRAGNN_FAULT_SERVE_WEDGE = ``"k"`` or
+    ``"k:secs"``, default 60s) — the wedged-device-step model the serve
+    watchdog must turn into a bounded WedgedStepError + runner recycle."""
+    _indexed_sleep(_get("HYDRAGNN_FAULT_SERVE_WEDGE"), batch_index, 60.0)
+
+
+def maybe_slow_client(request_index: int) -> None:
+    """Sleep at submission ``request_index``'s admission call when armed
+    (HYDRAGNN_FAULT_SERVE_SLOW_CLIENT = ``"i"`` or ``"i:secs"``, default
+    1s) — the slow-client model: one dawdling caller must only delay
+    itself, never the serve loop or other submitters."""
+    _indexed_sleep(_get("HYDRAGNN_FAULT_SERVE_SLOW_CLIENT"), request_index, 1.0)
 
 
 def flip_bit(path: str, byte_offset: Optional[int] = None, bit: int = 0) -> int:

@@ -46,6 +46,12 @@ python run-scripts/chaos_smoke.py
 echo "== data-plane chaos smoke (NaN samples/skip tally, error policy, socket drops, mid-epoch kill+resume order) =="
 python run-scripts/data_chaos_smoke.py
 
+echo "== serve-plane chaos smoke (zero-retrace load, corrupt-request isolation, wedged step, hot reload, SIGTERM drain) =="
+python run-scripts/serve_chaos_smoke.py
+
+echo "== BENCH_SERVE cells (p50/p99 latency vs offered load, throughput at SLO, shed rate) =="
+BENCH_SERVE=1 BENCH_SERVE_SECS=2 python bench.py
+
 echo "== multichip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

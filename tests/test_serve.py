@@ -1,0 +1,575 @@
+"""Fault-tolerant serving plane (docs/SERVING.md): admission/deadline/shed
+policies, corrupt-request isolation (co-batched requests succeed), wedged-
+step watchdog + recycle, hot checkpoint reload (swap + corrupt-candidate
+rejection), graceful drain, zero retraces under error-mode sentinel, and the
+optimizer-free inference restore — every fault path driven through the
+deterministic injection points of utils/faultinject.py, the way
+tests/test_faults.py exercises the step guard."""
+
+import dataclasses
+import os
+import signal
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config, voi_from_config
+from hydragnn_tpu.data import deterministic_graph_dataset, split_dataset
+from hydragnn_tpu.data.graph import SpecLadder, batch_graphs
+from hydragnn_tpu.data.pipeline import extract_variables, spec_template_batches
+from hydragnn_tpu.models.create import create_model, init_model
+from hydragnn_tpu.serve import (
+    CheckpointWatcher,
+    DeadlineExceededError,
+    GraphServer,
+    InvalidRequestError,
+    QueueFullError,
+    ServeConfig,
+    ServerClosedError,
+    ServerDrainingError,
+    SheddedError,
+    WedgedStepError,
+)
+from hydragnn_tpu.train.compile_plane import sentinel
+from hydragnn_tpu.train.state import InferenceState
+from hydragnn_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+def _config():
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "serve_test",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 60},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1]},
+            "graph_features": {"name": ["s"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["s"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 1,
+                "batch_size": 8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+
+
+@pytest.fixture(scope="module")
+def serve_world():
+    """One completed config + model + inference state + ladder + clean
+    graphs, shared across the module (model init compiles once)."""
+    raw = deterministic_graph_dataset(60, seed=7, radius=2.0, max_neighbours=100)
+    cfg = _config()
+    tr, va, te = split_dataset(raw, 0.7, seed=0)
+    cfg = update_config(cfg, tr, va, te)
+    voi = voi_from_config(cfg)
+    ready = [extract_variables(g, voi) for g in raw]
+    ladder = SpecLadder.for_dataset(ready, 8, num_buckets=2)
+    model = create_model(cfg)
+    tmpl = spec_template_batches(ready, ladder)[0][1]
+    variables = init_model(model, tmpl, seed=0)
+    state = InferenceState.create(variables)
+    return cfg, model, state, ladder, ready
+
+
+def _server(serve_world, serve_config=None, **kw):
+    cfg, model, state, ladder, ready = serve_world
+    return GraphServer(
+        model,
+        state,
+        ladder,
+        serve_config
+        or ServeConfig(
+            micro_batch_graphs=8, batch_window_s=0.005, step_timeout_s=20.0
+        ),
+        template_graphs=ready,
+        log_name="serve_test",
+        **kw,
+    )
+
+
+@pytest.fixture()
+def started(serve_world):
+    server = _server(serve_world).start()
+    assert server.wait_ready(120), f"warm-up failed: {server.failed}"
+    yield server
+    server.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# request lifecycle: predictions, validation gate, isolation
+# ---------------------------------------------------------------------------
+
+
+def pytest_predictions_match_direct_eval(serve_world, started):
+    import jax
+
+    cfg, model, state, ladder, ready = serve_world
+    g = ready[3]
+    result = started.submit(g).result(30)
+    spec = ladder.select_for([g])
+    batch = batch_graphs([dataclasses.replace(
+        g, graph_targets=None, node_targets=None, graph_y=None)], spec)
+    direct = jax.device_get(model.apply(state.variables(), batch, train=False))
+    np.testing.assert_allclose(
+        result["s"], np.asarray(direct["s"])[0], rtol=1e-5, atol=1e-6
+    )
+
+
+def pytest_invalid_requests_rejected_typed(serve_world, started):
+    _, _, _, _, ready = serve_world
+    nan_g = dataclasses.replace(
+        ready[0], x=np.full_like(np.asarray(ready[0].x), np.nan)
+    )
+    with pytest.raises(InvalidRequestError) as e:
+        started.submit(nan_g)
+    assert e.value.reason == "nonfinite_features"
+    assert e.value.code == "invalid_request"
+
+    bad_edges = dataclasses.replace(
+        ready[0], senders=np.asarray(ready[0].senders) + 10_000
+    )
+    with pytest.raises(InvalidRequestError) as e:
+        started.submit(bad_edges)
+    assert e.value.reason == "bad_edge_index"
+
+    # channel layout drift (an extra edge channel the model never saw)
+    extra = dataclasses.replace(
+        ready[0],
+        edge_attr=np.zeros((ready[0].num_edges, 2), np.float32),
+    )
+    with pytest.raises(InvalidRequestError) as e:
+        started.submit(extra)
+    assert e.value.reason == "channel_mismatch"
+
+
+def pytest_corrupt_request_fails_alone_cobatch_succeeds(serve_world, started):
+    """The tentpole isolation property: an injected corrupt request gets a
+    typed per-request error while the requests batched beside it succeed."""
+    _, _, _, _, ready = serve_world
+    # poison the SECOND submission of this test by submission index
+    base = started.stats()["submitted"]
+    faultinject.configure(serve_req_nan=str(base + 1))
+    out = started.predict([ready[0], ready[1], ready[2]])
+    assert isinstance(out[0], dict) and isinstance(out[2], dict)
+    assert isinstance(out[1], InvalidRequestError)
+    assert out[1].reason == "nonfinite_features"
+    assert np.isfinite(out[0]["s"]).all() and np.isfinite(out[2]["s"]).all()
+
+
+def pytest_zero_retraces_under_sustained_load_error_mode(serve_world, started):
+    """Sustained load over every ladder level with the sentinel armed in
+    error mode: every shape the micro-batcher can emit was AOT-warmed, so
+    the violation count must not move."""
+    _, _, _, _, ready = serve_world
+    before = len(sentinel().violations())
+    assert started.stats()["warmed_specializations"] == len(started.ladder.specs)
+    for rounds in range(4):
+        out = started.predict(ready[: 24])
+        assert all(isinstance(o, dict) for o in out)
+    assert len(sentinel().violations()) == before
+    # stats() reports the delta against the server's launch-time baseline
+    assert started.stats()["retrace_violations"] == 0
+
+
+# ---------------------------------------------------------------------------
+# admission control: deadlines, shedding, queue bound
+# ---------------------------------------------------------------------------
+
+
+def pytest_deadline_expired_at_dequeue(serve_world):
+    server = _server(serve_world)  # not started: requests sit queued
+    _, _, _, _, ready = serve_world
+    h = server.submit(ready[0], deadline_s=0.01)
+    time.sleep(0.05)
+    assert server._take_request(timeout=0.0) is None  # expired, not served
+    assert isinstance(h.error(1), DeadlineExceededError)
+    assert server.stats()["deadline_expired"] == 1
+    server.close(drain=False)
+
+
+def pytest_shed_on_projected_wait_beyond_slo(serve_world):
+    server = _server(
+        serve_world,
+        serve_config=ServeConfig(
+            micro_batch_graphs=8,
+            slo_p99_s=0.5,
+            expected_latency_per_graph_s=10.0,
+        ),
+    )
+    _, _, _, _, ready = serve_world
+    server.submit(ready[0])  # empty backlog: projected 0s, admitted
+    with pytest.raises(SheddedError) as e:
+        server.submit(ready[1])  # backlog 1 * 10s/graph >> 0.5s SLO
+    assert e.value.code == "shed"
+    assert e.value.projected_wait_s > e.value.slo_s
+    assert server.stats()["shed"] == 1
+    server.close(drain=False)
+
+
+def pytest_micro_batch_capped_to_ladder_slots(serve_world):
+    """Serving.micro_batch_graphs above the ladder's graph slots must not
+    overflow batch_graphs (which would fail every full batch's co-batched
+    requests): the batcher caps at the worst spec's real-graph slots."""
+    server = _server(
+        serve_world,
+        serve_config=ServeConfig(
+            micro_batch_graphs=64, batch_window_s=0.02, step_timeout_s=20.0
+        ),
+    ).start()
+    try:
+        assert server.wait_ready(120), server.failed
+        _, _, _, _, ready = serve_world
+        out = server.predict(ready[:24], timeout=60)
+        assert all(isinstance(o, dict) for o in out), out
+        assert server.stats()["failed_batches"] == 0
+    finally:
+        server.close(drain=False)
+
+
+def pytest_queue_full_is_typed_backpressure(serve_world):
+    server = _server(
+        serve_world, serve_config=ServeConfig(max_queue_requests=2)
+    )
+    _, _, _, _, ready = serve_world
+    server.submit(ready[0])
+    server.submit(ready[1])
+    with pytest.raises(QueueFullError):
+        server.submit(ready[2])
+    assert server.stats()["queue_full"] == 1
+    server.close(drain=False)
+
+
+def pytest_slow_client_only_delays_itself(serve_world, started):
+    _, _, _, _, ready = serve_world
+    base = started.stats()["submitted"]
+    faultinject.configure(serve_slow_client=f"{base}:0.3")
+    t0 = time.monotonic()
+    h = started.submit(ready[0])  # this submission sleeps 0.3s at the door
+    assert time.monotonic() - t0 >= 0.25
+    assert isinstance(h.result(30), dict)
+
+
+# ---------------------------------------------------------------------------
+# overload/fault behavior: wedged step watchdog
+# ---------------------------------------------------------------------------
+
+
+def pytest_wedged_step_bounded_error_and_recycle(serve_world):
+    server = _server(
+        serve_world,
+        serve_config=ServeConfig(
+            micro_batch_graphs=8, batch_window_s=0.005, step_timeout_s=0.25
+        ),
+    ).start()
+    try:
+        assert server.wait_ready(120), server.failed
+        _, _, _, _, ready = serve_world
+        nxt = server.stats()["batches"] + server.stats()["wedged_batches"]
+        faultinject.configure(serve_wedge=f"{nxt}:1.5")
+        h = server.submit(ready[0])
+        err = h.error(30)
+        assert isinstance(err, WedgedStepError), err
+        assert server.stats()["wedged_batches"] == 1
+        # the recycled runner serves the next request normally
+        faultinject.reset()
+        h2 = server.submit(ready[1])
+        assert isinstance(h2.result(30), dict)
+    finally:
+        server.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# graceful drain + SIGTERM
+# ---------------------------------------------------------------------------
+
+
+def pytest_drain_finishes_inflight_then_rejects(serve_world, started):
+    _, _, _, _, ready = serve_world
+    handles = [started.submit(g) for g in ready[:12]]
+    started.initiate_drain()
+    with pytest.raises(ServerDrainingError):
+        started.submit(ready[0])
+    assert started.drain(60)
+    for h in handles:
+        assert isinstance(h.result(0), dict)  # zero dropped in-flight
+    assert started.stats()["completed"] >= 12
+
+
+def pytest_sigterm_initiates_drain(serve_world):
+    server = _server(serve_world).start(install_sigterm=True)
+    try:
+        assert server.wait_ready(120), server.failed
+        _, _, _, _, ready = serve_world
+        handles = [server.submit(g) for g in ready[:4]]
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not server.draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert server.draining
+        assert server.drain(60)
+        for h in handles:
+            assert isinstance(h.result(0), dict)
+        with pytest.raises(ServerDrainingError):
+            server.submit(ready[0])
+    finally:
+        server.close(drain=False)
+    # the previous SIGTERM disposition is restored at close
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL,
+        signal.default_int_handler,
+        signal.getsignal(signal.SIGTERM),
+    )
+
+
+def pytest_closed_server_rejects(serve_world):
+    server = _server(serve_world)
+    server.close(drain=False)
+    _, _, _, _, ready = serve_world
+    with pytest.raises(ServerClosedError):
+        server.submit(ready[0])
+
+
+# ---------------------------------------------------------------------------
+# hot checkpoint reload
+# ---------------------------------------------------------------------------
+
+
+def _save_scaled(serve_world, run_dir, log_name, scale, epoch):
+    """Save a TrainState whose params are the fixture's scaled by ``scale``
+    (a full optimizer-bearing state, like a real training run writes)."""
+    import jax
+
+    from hydragnn_tpu.train.checkpoint import save_model
+    from hydragnn_tpu.train.optimizer import make_optimizer
+    from hydragnn_tpu.train.state import TrainState
+
+    cfg, model, state, ladder, ready = serve_world
+    tx = make_optimizer({"type": "AdamW", "learning_rate": 0.01})
+    scaled = jax.tree_util.tree_map(lambda p: p * scale, state.params)
+    ts = TrainState.create(
+        {"params": scaled, "batch_stats": state.batch_stats}, tx
+    )
+    return save_model(ts, log_name, path=run_dir, epoch=epoch)
+
+
+def pytest_hot_reload_swaps_and_rejects_corrupt(serve_world, tmp_path):
+    run_dir = str(tmp_path)
+    log_name = "serve_reload"
+    _save_scaled(serve_world, run_dir, log_name, 1.0, epoch=1)
+    server = _server(serve_world).start()
+    try:
+        assert server.wait_ready(120), server.failed
+        _, _, _, _, ready = serve_world
+        watcher = CheckpointWatcher(
+            server, log_name, path=run_dir, initial_entry=None
+        )
+        # adopt the on-disk epoch-1 weights first (identical params)
+        assert watcher.poll_once() == "installed"
+        r1 = server.submit(ready[0]).result(30)
+        assert server.stats()["reloads"] == 1
+        assert server.current_checkpoint == f"{log_name}_epoch1.msgpack"
+
+        # a NEW verified candidate swaps in without dropping requests
+        _save_scaled(serve_world, run_dir, log_name, 2.0, epoch=2)
+        assert watcher.poll_once() == "installed"
+        r2 = server.submit(ready[0]).result(30)
+        assert server.stats()["reloads"] == 2
+        assert server.current_checkpoint == f"{log_name}_epoch2.msgpack"
+        assert not np.allclose(r1["s"], r2["s"])  # the weights really moved
+
+        # a corrupt candidate is rejected; current weights keep serving
+        fname = _save_scaled(serve_world, run_dir, log_name, 3.0, epoch=3)
+        faultinject.flip_bit(fname)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            assert watcher.poll_once() == "rejected"
+        assert watcher.rejected == 1
+        r3 = server.submit(ready[0]).result(30)
+        np.testing.assert_allclose(r3["s"], r2["s"])  # still epoch-2 weights
+        assert server.current_checkpoint == f"{log_name}_epoch2.msgpack"
+        # unchanged pointer: no re-attempt spam
+        assert watcher.poll_once() is None
+    finally:
+        server.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# inference-only restore (the optimizer-memory satellite)
+# ---------------------------------------------------------------------------
+
+
+def pytest_inference_restore_matches_full_and_skips_optimizer(
+    serve_world, tmp_path
+):
+    import jax
+
+    from hydragnn_tpu.train.checkpoint import (
+        latest_checkpoint_entry,
+        load_existing_model,
+        load_inference_state,
+    )
+    from hydragnn_tpu.train.optimizer import make_optimizer
+    from hydragnn_tpu.train.state import TrainState
+
+    run_dir = str(tmp_path)
+    fname = _save_scaled(serve_world, run_dir, "inf", 1.5, epoch=4)
+    cfg, model, state, ladder, ready = serve_world
+    assert latest_checkpoint_entry("inf", run_dir) == os.path.basename(fname)
+
+    inf, loaded_from = load_inference_state(
+        InferenceState.create(
+            {"params": state.params, "batch_stats": state.batch_stats}
+        ),
+        "inf",
+        path=run_dir,
+    )
+    assert loaded_from == os.path.basename(fname)
+    assert not hasattr(inf, "opt_state")  # no optimizer memory allocated
+
+    tx = make_optimizer({"type": "AdamW", "learning_rate": 0.01})
+    full = load_existing_model(
+        TrainState.create(
+            {"params": state.params, "batch_stats": state.batch_stats}, tx
+        ),
+        "inf",
+        path=run_dir,
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(inf.params),
+        jax.tree_util.tree_leaves(full.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(inf.step) == int(full.step)
+
+
+def pytest_inference_restore_refuses_orbax_entry(serve_world, tmp_path):
+    from hydragnn_tpu.train.checkpoint import load_inference_state
+
+    d = tmp_path / "orb"
+    d.mkdir()
+    (d / "latest").write_text("orbax/3")
+    cfg, model, state, ladder, ready = serve_world
+    with pytest.raises(ValueError, match="orbax"):
+        load_inference_state(
+            InferenceState.create(
+                {"params": state.params, "batch_stats": state.batch_stats}
+            ),
+            "orb",
+            path=str(tmp_path),
+        )
+
+
+def pytest_inference_restore_walks_back_past_corruption(serve_world, tmp_path):
+    run_dir = str(tmp_path)
+    _save_scaled(serve_world, run_dir, "walk", 1.0, epoch=1)
+    f2 = _save_scaled(serve_world, run_dir, "walk", 2.0, epoch=2)
+    faultinject.flip_bit(f2)
+    cfg, model, state, ladder, ready = serve_world
+    from hydragnn_tpu.train.checkpoint import load_inference_state
+
+    inf, loaded_from = load_inference_state(
+        InferenceState.create(
+            {"params": state.params, "batch_stats": state.batch_stats}
+        ),
+        "walk",
+        path=run_dir,
+    )
+    assert loaded_from == "walk_epoch1.msgpack"
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def pytest_serve_config_validation():
+    with pytest.raises(ValueError, match="retrace_policy"):
+        ServeConfig(retrace_policy="explode")
+    with pytest.raises(ValueError, match="slo_p99_s"):
+        ServeConfig(slo_p99_s=-1.0)
+    with pytest.raises(ValueError, match="micro_batch_graphs"):
+        ServeConfig(micro_batch_graphs=0)
+    # micro-batch falls back to the training batch size
+    cfg = {"NeuralNetwork": {"Training": {"batch_size": 12}}}
+    assert ServeConfig.from_config(cfg).micro_batch_graphs == 12
+    with pytest.warns(UserWarning, match="not consumed"):
+        ServeConfig.from_config({"Serving": {"no_such_knob": 1}})
+
+
+def pytest_update_config_validates_serving_section():
+    cfg = _config()
+    cfg["Serving"] = {"retrace_policy": "bogus"}
+    raw = deterministic_graph_dataset(20, seed=1)
+    tr, va, te = split_dataset(raw, 0.7, seed=0)
+    with pytest.raises(ValueError, match="retrace_policy"):
+        update_config(cfg, tr, va, te)
+
+
+def pytest_config_lint_knows_serving_keys():
+    from hydragnn_tpu.config.lint import lint_config
+
+    findings = lint_config(
+        {"Serving": {"slo_p99_s": 0.2, "hot_reload": True, "typo_key": 1}}
+    )
+    by_path = {f.path: f.status for f in findings}
+    assert by_path["Serving"] == "handled"
+    assert by_path["Serving.slo_p99_s"] == "handled"
+    assert by_path["Serving.hot_reload"] == "handled"
+    assert by_path["Serving.typo_key"] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# HPO worker-log surfacing (satellite)
+# ---------------------------------------------------------------------------
+
+
+def pytest_hpo_worker_failure_surfaces_log_tail(tmp_path):
+    import sys
+
+    from hydragnn_tpu.hpo import launch_hpo_workers
+
+    argv = [
+        sys.executable,
+        "-c",
+        "print('MARKER_jax_distributed_not_initialized'); raise SystemExit(3)",
+    ]
+    with pytest.raises(RuntimeError) as e:
+        launch_hpo_workers(argv, 1, 1, str(tmp_path), timeout=60)
+    msg = str(e.value)
+    # the parent error carries the worker's log tail, not just the rc
+    assert "MARKER_jax_distributed_not_initialized" in msg
+    assert "worker0.log" in msg or "worker 0" in msg
