@@ -121,21 +121,14 @@ def _salvage_json(error):
         }
     )
 
-# peak dense bf16 FLOP/s by TPU generation (public figures)
-_PEAK_FLOPS = {
-    "v6": 918e12,
-    "v5p": 459e12,
-    "v5": 197e12,  # v5e / "TPU v5 lite"
-    "v4": 275e12,
-}
-
-
 def _peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for key, val in _PEAK_FLOPS.items():
-        if key in kind:
-            return val
-    return 197e12
+    """Peak dense bf16 FLOP/s by TPU generation — ONE table shared with the
+    live telemetry plane's MFU gauge (hydragnn_tpu/obs/telemetry.py), so
+    the banked cells and a scraped `hydragnn_mfu_estimate` can never
+    disagree about the denominator."""
+    from hydragnn_tpu.obs.telemetry import peak_flops
+
+    return peak_flops(device_kind)
 
 
 def _flops_of(step, *args) -> float:
@@ -513,6 +506,7 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
         sum(flops_by_shape[(b.num_nodes, b.num_edges)] for b in batches) * n_passes
     )
     best_dt = None
+    trial_dts = []
     for _ in range(n_trials):
         t0 = time.perf_counter()
         for p in range(n_passes):
@@ -520,16 +514,34 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
                 state, tot, _ = step(state, b, r)
         jax.block_until_ready(tot)
         dt = time.perf_counter() - t0
+        trial_dts.append(dt)
         if best_dt is None or dt < best_dt:
             best_dt = dt
 
     gps = graphs_done / best_dt
-    peak = _peak_flops(jax.devices()[0].device_kind)
+    device_kind = jax.devices()[0].device_kind
+    peak = _peak_flops(device_kind)
     mfu = (flops_done / best_dt) / peak
+    # telemetry-comparable fields, banked in EVERY cell so the ROADMAP-3
+    # hardware round gets them for free: padding_waste is the node-slot
+    # occupancy complement over the epoch's batches (nodes dominate
+    # compute; flops_audit.py prints the same census), mfu_est is the
+    # live-telemetry MFU formula over the MEAN trial — what a scrape of
+    # hydragnn_mfu_estimate would show, vs `mfu` which keeps the
+    # best-trial convention of the banked history
+    from hydragnn_tpu.obs.telemetry import mfu_estimate as _mfu_estimate
+
+    nodes_real = sum(int(np.asarray(b.node_mask).sum()) for b in batches)
+    nodes_padded = sum(int(b.num_nodes) for b in batches)
+    padding_waste = 1.0 - nodes_real / max(nodes_padded, 1)
+    mean_dt = sum(trial_dts) / len(trial_dts)
+    mfu_est = _mfu_estimate(flops_done, mean_dt, device_kind)
     arch_done = config["NeuralNetwork"]["Architecture"]
     return {
         "graphs_per_sec": gps,
         "mfu": mfu,
+        "padding_waste": padding_waste,
+        "mfu_est": mfu_est,
         "flops_per_graph": flops_done / max(graphs_done, 1),
         "device": jax.devices()[0].device_kind,
         "peak_flops_assumed": peak,
@@ -818,6 +830,8 @@ def main_ab():
                 "value": round(prod["graphs_per_sec"], 2),
                 "unit": "graphs/sec/chip",
                 "mfu": round(prod["mfu"], 4),
+                "padding_waste": round(prod["padding_waste"], 4),
+                "mfu_est": round(prod["mfu_est"], 4),
                 "flops_per_graph": round(prod["flops_per_graph"]),
                 "train_loss": round(prod["loss"], 5),
                 "mixed_precision": mp,
@@ -1323,6 +1337,8 @@ def main():
         {
             "graphs_per_sec": round(prod["graphs_per_sec"], 2),
             "mfu": round(prod["mfu"], 4),
+            "padding_waste": round(prod["padding_waste"], 4),
+            "mfu_est": round(prod["mfu_est"], 4),
             "flops_per_graph": round(prod["flops_per_graph"]),
         },
     )
@@ -1336,6 +1352,8 @@ def main():
                 "unit": "graphs/sec/chip",
                 "vs_baseline": round(syn / RECORDED_BASELINE, 3),
                 "mfu": round(prod["mfu"], 4),
+                "padding_waste": round(prod["padding_waste"], 4),
+                "mfu_est": round(prod["mfu_est"], 4),
                 "flops_per_graph": round(prod["flops_per_graph"]),
                 "time_to_first_step": round(prod["time_to_first_step"], 3),
                 "compile_time_s": round(prod["compile_time_s"], 3),

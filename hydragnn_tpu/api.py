@@ -746,6 +746,10 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                 eval_fn=eval_fn,
                 restore_fn=restore_fn,
                 loader_state_fn=loader_state_fn,
+                # the loop routes guard/data/compile health counters (and
+                # the Telemetry layer's TB mirror) through the same writer
+                # the epoch scalars use (obs/telemetry.py)
+                writer=writer,
             )
     finally:
         writer.close()

@@ -469,6 +469,18 @@ def update_config(
 
         ServeConfig.from_config(config)
 
+    # ---- telemetry plane (docs/OBSERVABILITY.md): same eager-validation
+    # contract as ``Serving`` — a typo'd Telemetry key/value fails at load
+    # time, not after the first epoch has already run unmeasured. Optional:
+    # absent means disabled and nothing is added to the saved config; a
+    # PRESENT section is completed to its resolved form (defaults filled,
+    # unknown keys warned-and-dropped here, ONCE — the loop's later
+    # resolve of the completed section is then warning-free).
+    if config.get("Telemetry"):
+        from ..obs.telemetry import resolve_telemetry
+
+        config["Telemetry"] = resolve_telemetry(config)
+
     config.setdefault("Verbosity", {"level": 0})
     config.setdefault("Visualization", {})
     return config

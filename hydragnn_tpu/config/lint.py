@@ -156,6 +156,16 @@ _HANDLED = {
     "Serving.hot_reload",
     "Serving.reload_poll_s",
     "Serving.drain_timeout_s",
+    "Serving.http_port",
+    "Serving.http_host",
+    "Telemetry.enabled",
+    "Telemetry.interval_steps",
+    "Telemetry.http_port",
+    "Telemetry.http_host",
+    "Telemetry.mfu",
+    "Telemetry.jsonl",
+    "Telemetry.profile_trigger",
+    "Telemetry.profile_steps",
 }
 
 # reference keys that are intentionally NOT consumed here, with the
@@ -204,10 +214,12 @@ _LEGACY = {
 }
 
 # top-level Dataset/Architecture synonyms appearing in some reference
-# example configs at non-standard paths ("Serving" is this framework's own
-# section — no reference analog; docs/SERVING.md)
+# example configs at non-standard paths ("Serving" and "Telemetry" are this
+# framework's own sections — no reference analog; docs/SERVING.md,
+# docs/OBSERVABILITY.md)
 _TOPLEVEL_SECTIONS = (
     "Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving",
+    "Telemetry",
 )
 
 

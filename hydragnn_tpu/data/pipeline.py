@@ -783,6 +783,23 @@ class GraphLoader:
         t.start()
         # exposed for tests asserting the thread is reaped after errors/break
         self._producer_thread = t
+        # telemetry plane (obs/registry.py): prefetch-queue depth is the
+        # live H2D-pipeline health signal — a depth pinned at 0 means the
+        # device is waiting on host batch-build (the ROADMAP-3 H2D stall
+        # axis); stalls are counted where they are raised
+        from ..obs.registry import registry as _obs_registry
+
+        g_depth = _obs_registry().gauge(
+            "hydragnn_loader_prefetch_depth",
+            "Prefetch queue depth observed at each batch handoff",
+            labelnames=("source",),
+        )
+        c_stall = _obs_registry().counter(
+            "hydragnn_loader_stalls_total",
+            "LoaderStallError raised (dead or wedged prefetch producer)",
+            labelnames=("source",),
+        )
+        c_stall.inc(0, source=self.source)  # materialize the series at 0
         timeout = float(self.stall_timeout or 0.0)
         delivered = 0
         try:
@@ -804,6 +821,7 @@ class GraphLoader:
                                 item = q.get_nowait()
                                 break
                             except queue.Empty:
+                                c_stall.inc(source=self.source)
                                 raise LoaderStallError(
                                     "prefetch producer thread exited without "
                                     "an end-of-epoch sentinel after batch "
@@ -814,6 +832,7 @@ class GraphLoader:
                                 ) from None
                         waited += _WATCHDOG_TICK_S
                         if timeout and waited >= timeout:
+                            c_stall.inc(source=self.source)
                             raise LoaderStallError(
                                 "prefetch producer produced nothing for "
                                 f"{waited:.1f}s (> loader_stall_timeout="
@@ -829,6 +848,7 @@ class GraphLoader:
                 if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
                     raise item[1]
                 delivered += 1
+                g_depth.set(q.qsize(), source=self.source)
                 yield item
         finally:
             # abandoned mid-epoch (break / exception): release the producer

@@ -1,0 +1,42 @@
+"""Unified telemetry plane (docs/OBSERVABILITY.md): the process-wide
+metrics registry every subsystem publishes into, the Prometheus scrape +
+health endpoint, the per-step train instrumentation with its versioned
+``metrics.jsonl`` stream, and the on-demand profiling trigger."""
+
+from .prometheus import TelemetryHTTPServer, render_text, start_endpoint
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from .telemetry import (
+    SCHEMA_VERSION,
+    MetricsStream,
+    ProfileTrigger,
+    StepTelemetry,
+    host_memory_bytes,
+    mfu_estimate,
+    peak_flops,
+    resolve_telemetry,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsStream",
+    "ProfileTrigger",
+    "SCHEMA_VERSION",
+    "StepTelemetry",
+    "TelemetryHTTPServer",
+    "host_memory_bytes",
+    "mfu_estimate",
+    "peak_flops",
+    "registry",
+    "render_text",
+    "resolve_telemetry",
+    "start_endpoint",
+]

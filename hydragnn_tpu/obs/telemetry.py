@@ -1,0 +1,712 @@
+"""Per-step train telemetry: goodput, padding waste, MFU estimate, memory,
+the versioned ``metrics.jsonl`` stream, and the on-demand profiling trigger.
+
+This is the measurement substrate of the next MFU round (ROADMAP item 3 —
+you cannot close a padding-waste or H2D-stall gap you never measure) and of
+the HPO fleet (item 5 — the scheduler consumes the stream instead of
+scraping stdout). Opt-in for training via the top-level ``Telemetry``
+config section (docs/CONFIG.md; ``HYDRAGNN_TELEMETRY=1/0`` overrides);
+publishing is rank-0-gated like ``MetricsWriter``.
+
+What ``StepTelemetry`` measures, per flush window of ``interval_steps``:
+
+- **step time** (host dispatch-to-dispatch wall time per optimizer step;
+  under JAX async dispatch the queue throttles the host to the device
+  rate, so the steady-state mean converges to the device step time without
+  forcing a per-step sync — the same reasoning the epoch loop uses for its
+  loss bookkeeping),
+- **goodput**: real (mask-counted) graphs / nodes / edges per second,
+- **padding-waste fraction** per axis (graphs / nodes / edges): 1 − real
+  slots / padded slots, overall and per pad-bucket label,
+- **MFU estimate**: XLA-counted FLOPs of each visited specialization (the
+  flops-audit recipe, run-scripts/flops_audit.py — cost analysis of the
+  compiled executable, cached by the compile plane's AOT warm-up) divided
+  by elapsed time and the chip's peak (``peak_flops``),
+- **memory**: per-device peak bytes in use + host RSS.
+
+Sinks: (a) ``logs/<run>/metrics.jsonl`` — one JSON record per window /
+epoch / run, every record stamped ``{"v": 1, "ts": ...}``; (b) the
+existing ``MetricsWriter`` (TensorBoard + scalars.jsonl); (c) the
+process-wide registry (obs/registry.py), scrapeable when an endpoint is
+mounted (``Telemetry.http_port`` / ``Serving.http_port``).
+
+On-demand profiling: touching ``logs/<run>/profile_trigger`` (or sending
+``SIGUSR1``) makes the next flush start an xprof capture of the following
+``profile_steps`` steps into ``logs/<run>/profile_on_demand/`` — the
+live-run analog of the epoch-scoped ``Profile`` config section
+(utils/profile.py), for when the slowdown is happening *now*.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import signal
+import threading
+import time
+import warnings
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .registry import registry
+
+SCHEMA_VERSION = 1
+
+# memory gauges are the one flush component with a real price (device
+# memory_stats + /proc reads, ~300us) — refresh at most this often rather
+# than every window, keeping the per-step telemetry bill in microseconds
+_MEMORY_REFRESH_S = 1.0
+
+TELEMETRY_DEFAULTS: Dict[str, Any] = {
+    "enabled": False,
+    "interval_steps": 10,
+    "http_port": None,  # None = no training-side endpoint; 0 = ephemeral
+    "http_host": "127.0.0.1",  # bind interface; "0.0.0.0" for off-host
+    "mfu": True,
+    "jsonl": True,
+    "profile_trigger": True,
+    "profile_steps": 5,
+}
+
+# peak dense bf16 FLOP/s by TPU generation (public figures; bench.py
+# delegates here so the bench cells and the live MFU gauge share one table)
+PEAK_FLOPS = {
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5": 197e12,  # v5e / "TPU v5 lite"
+    "v4": 275e12,
+}
+
+
+def peak_flops(device_kind: str) -> float:
+    kind = str(device_kind).lower()
+    for key, val in PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def mfu_estimate(flops: float, seconds: float, device_kind: str) -> float:
+    """Model FLOPs utilization: achieved FLOP/s over the chip peak."""
+    if seconds <= 0:
+        return 0.0
+    return (float(flops) / float(seconds)) / peak_flops(device_kind)
+
+
+def resolve_telemetry(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Resolve the top-level ``Telemetry`` section to a complete, validated
+    settings dict. Unknown keys warn (matching config completion's
+    ignore-unknown behavior); ``HYDRAGNN_TELEMETRY`` env overrides
+    ``enabled`` (``0``/``off`` forces off, ``1`` forces on)."""
+    section = dict((config or {}).get("Telemetry", {}) or {})
+    unknown = sorted(set(section) - set(TELEMETRY_DEFAULTS))
+    if unknown:
+        warnings.warn(
+            f"Telemetry config keys {unknown} are not consumed (known keys: "
+            f"{sorted(TELEMETRY_DEFAULTS)}); check docs/OBSERVABILITY.md",
+            stacklevel=2,
+        )
+        for k in unknown:
+            section.pop(k)
+    out = dict(TELEMETRY_DEFAULTS)
+    out.update(section)
+    env = os.getenv("HYDRAGNN_TELEMETRY")
+    if env is not None:
+        out["enabled"] = env.strip().lower() not in ("0", "off", "false", "")
+    if int(out["interval_steps"]) < 1:
+        raise ValueError(
+            f"Telemetry.interval_steps must be >= 1, got "
+            f"{out['interval_steps']!r}"
+        )
+    if int(out["profile_steps"]) < 1:
+        raise ValueError(
+            f"Telemetry.profile_steps must be >= 1, got "
+            f"{out['profile_steps']!r}"
+        )
+    if out["http_port"] is not None and not (
+        0 <= int(out["http_port"]) <= 65535
+    ):
+        raise ValueError(
+            "Telemetry.http_port must be null (off), 0 (ephemeral), or a "
+            f"port number <= 65535, got {out['http_port']!r}"
+        )
+    if not isinstance(out["http_host"], str) or not out["http_host"]:
+        raise ValueError(
+            "Telemetry.http_host must be a non-empty bind address, got "
+            f"{out['http_host']!r}"
+        )
+    return out
+
+
+def host_memory_bytes() -> float:
+    """Resident-set size of this process in bytes (stdlib-only: /proc on
+    Linux, ru_maxrss as the portable fallback)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            rss_pages = int(fh.read().split()[1])
+        return float(rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except Exception:
+        try:
+            import resource
+
+            rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+            return float(rss_kb) * 1024.0
+        except Exception:
+            return 0.0
+
+
+class MetricsStream:
+    """The versioned ``metrics.jsonl`` sink: one JSON object per line, every
+    record stamped with the schema version and a wall-clock timestamp.
+    Rank-0-gated like ``MetricsWriter`` — exactly one stream per run."""
+
+    def __init__(self, run_dir: str, rank0: Optional[bool] = None):
+        if rank0 is None:
+            try:
+                import jax
+
+                rank0 = jax.process_index() == 0
+            except Exception:
+                rank0 = True
+        self.path = os.path.join(run_dir, "metrics.jsonl")
+        self._fh = None
+        self._flushed_at = 0.0
+        if rank0:
+            os.makedirs(run_dir, exist_ok=True)
+            self._fh = open(self.path, "a")
+
+    def write(self, kind: str, record: Dict[str, Any]) -> None:
+        if self._fh is None:
+            return
+        line = {"v": SCHEMA_VERSION, "ts": round(time.time(), 3),
+                "kind": kind, **record}
+        try:
+            self._fh.write(json.dumps(line) + "\n")
+            # flush ~1/s, not per record: the file flush is one of the two
+            # syscalls that dominate the per-step telemetry bill (the <=2%
+            # overhead budget of run-scripts/telemetry_smoke.py);
+            # non-window records (epoch/run) are rare and tailed live
+            now = time.monotonic()
+            if kind != "step_window" or now - self._flushed_at >= 1.0:
+                self._fh.flush()
+                self._flushed_at = now
+        except (OSError, ValueError) as e:
+            # a full disk / vanished run dir must not kill the training run
+            # (the plane's contract: observability never takes the owner
+            # down) — drop the stream and keep going
+            self._fh = None
+            warnings.warn(
+                f"metrics.jsonl stream failed ({e}); telemetry records are "
+                "dropped for the rest of this run",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+
+class ProfileTrigger:
+    """On-demand xprof capture: arm via a touch file or ``SIGUSR1``; the
+    next flush starts ``jax.profiler`` for the following ``steps`` steps.
+
+    The touch file (``<run_dir>/profile_trigger``) is polled at most once
+    a second (a ``stat`` costs ~100us on network filesystems — per-window
+    polling alone would blow the <=2% overhead budget) and consumed
+    (unlinked) when the capture starts; the signal flag is checked every
+    step (one attribute read, so SIGUSR1 reacts within a window). Captures
+    land in step-stamped subdirectories of ``<run_dir>/profile_on_demand``
+    so repeated triggers never clobber."""
+
+    def __init__(self, run_dir: str, steps: int = 5,
+                 install_signal: bool = True):
+        self.trigger_path = os.path.join(run_dir, "profile_trigger")
+        self.out_dir = os.path.join(run_dir, "profile_on_demand")
+        self.steps = max(int(steps), 1)
+        self.captures = 0
+        self._signaled = False
+        self._polled_at = 0.0
+        self._active_until: Optional[int] = None
+        self._prev_handler = None
+        if install_signal:
+            try:
+                self._prev_handler = signal.signal(
+                    signal.SIGUSR1, self._on_signal
+                )
+            except ValueError:
+                pass  # not the main thread: touch-file trigger only
+
+    def _on_signal(self, signum, frame) -> None:
+        self._signaled = True  # async-signal-safe: only a flag
+
+    def _consume_trigger(self) -> bool:
+        if self._signaled:
+            self._signaled = False
+            return True
+        now = time.monotonic()
+        if now - self._polled_at < 1.0:
+            return False
+        self._polled_at = now
+        if os.path.exists(self.trigger_path):
+            try:
+                os.unlink(self.trigger_path)
+            except OSError:
+                pass
+            return True
+        return False
+
+    @property
+    def active(self) -> bool:
+        return self._active_until is not None
+
+    def poll(self, global_step: int) -> None:
+        """Flush-cadence check: start a capture if armed."""
+        if self.active or not self._consume_trigger():
+            return
+        try:
+            import jax
+
+            out = os.path.join(self.out_dir, f"step{global_step}")
+            os.makedirs(out, exist_ok=True)
+            jax.profiler.start_trace(out, create_perfetto_trace=True)
+        except Exception as e:  # an epoch-profile may already be tracing
+            warnings.warn(
+                f"on-demand profile trigger could not start a capture: {e}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return
+        self._active_until = int(global_step) + self.steps
+
+    def step(self, global_step: int) -> None:
+        """Per-step check: stop the capture once its window is done."""
+        if self._active_until is not None and global_step >= self._active_until:
+            self._stop()
+
+    def _stop(self) -> None:
+        self._active_until = None
+        try:
+            import jax
+
+            jax.effects_barrier()
+            jax.profiler.stop_trace()
+            self.captures += 1
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        if self.active:
+            self._stop()
+        if self._prev_handler is not None:
+            try:
+                signal.signal(signal.SIGUSR1, self._prev_handler)
+            except ValueError:
+                pass
+            self._prev_handler = None
+
+
+# whether mask readback should batch both masks into one device_get round
+# trip: True on accelerator backends (a remote-tunneled TPU pays per-call
+# LATENCY, so one round trip beats two) and False on the CPU backend
+# (np.asarray is a ~1us zero-copy view there, device_get ~7x slower).
+# Resolved once, at the first non-numpy batch.
+_BATCH_MASK_READBACK: Optional[bool] = None
+
+
+def _mask_arrays(nm, em):
+    global _BATCH_MASK_READBACK
+    if isinstance(nm, np.ndarray):
+        return nm, np.asarray(em)
+    if _BATCH_MASK_READBACK is None:
+        import jax
+
+        _BATCH_MASK_READBACK = jax.default_backend() != "cpu"
+    if _BATCH_MASK_READBACK:
+        import jax
+
+        return jax.device_get((nm, em))
+    return np.asarray(nm), np.asarray(em)
+
+
+def _batch_census(batch, real_graphs: Optional[int] = None):
+    """(real, padded) counts per axis for a (possibly device-stacked)
+    ``GraphBatch``. Masks are loader-produced leaves, so reading them never
+    waits on device compute (the same contract the epoch loop relies on),
+    and the per-shard pad spec is recovered from the trailing axes of a
+    stacked batch. Device-resident masks read back per ``_mask_arrays``
+    (one batched round trip on accelerators); the graph mask is only
+    materialized when the loop did not already pass its count —
+    padded counts and stacking come from shapes, which are free."""
+    gshape = tuple(batch.graph_mask.shape)
+    nm, em = _mask_arrays(batch.node_mask, batch.edge_mask)
+    real = {
+        "graphs": (
+            int(np.asarray(batch.graph_mask).sum())
+            if real_graphs is None
+            else int(real_graphs)
+        ),
+        "nodes": int(nm.sum()),
+        "edges": int(em.sum()),
+    }
+    padded = {"graphs": int(np.prod(gshape)), "nodes": int(nm.size),
+              "edges": int(em.size)}
+    if len(gshape) == 2:  # stacked [num_shards, ...]
+        spec_key = (int(nm.shape[1]), int(em.shape[1]))
+    else:
+        spec_key = (int(nm.size), int(em.size))
+    return real, padded, spec_key
+
+
+class StepTelemetry:
+    """Per-step instrumentation layer of the training loop.
+
+    Construct via ``from_config`` (returns None when the ``Telemetry``
+    section is absent/disabled — the loop then skips every call site);
+    drive with ``on_step(batch, dt, real_graphs)`` from the epoch loop,
+    ``on_epoch`` at epoch boundaries, ``absorb_counters`` wherever the
+    run-level totals are already host-synced, and ``close`` in the run's
+    ``finally``."""
+
+    @staticmethod
+    def from_config(
+        config: Dict[str, Any],
+        log_name: str,
+        writer=None,
+        log_path: str = "./logs",
+    ) -> Optional["StepTelemetry"]:
+        settings = resolve_telemetry(config)
+        if not settings["enabled"]:
+            return None
+        return StepTelemetry(settings, log_name, writer=writer,
+                             log_path=log_path)
+
+    def __init__(self, settings: Dict[str, Any], log_name: str, writer=None,
+                 log_path: str = "./logs"):
+        self.settings = settings
+        self.log_name = log_name
+        self.run_dir = os.path.join(log_path, log_name)
+        self.writer = writer
+        self.interval = int(settings["interval_steps"])
+        self.want_mfu = bool(settings["mfu"])
+        self.global_step = 0
+        self._flops_for: Optional[Callable[[Tuple[int, int]], Optional[float]]] = None
+        self._flops_cache: Dict[Tuple[int, int], Optional[float]] = {}
+        self._device_kind: Optional[str] = None
+        self._mem_refreshed_at = 0.0
+        self._reset_window()
+
+        # -- sinks / registry ------------------------------------------------
+        self.stream = (
+            MetricsStream(self.run_dir) if settings["jsonl"] else None
+        )
+        self.trigger = (
+            ProfileTrigger(self.run_dir, steps=int(settings["profile_steps"]))
+            if settings["profile_trigger"]
+            else None
+        )
+        self.http = None
+        if settings["http_port"] is not None:
+            from .prometheus import start_endpoint
+
+            self.http = start_endpoint(
+                int(settings["http_port"]),
+                ready_fn=lambda: True,
+                health_fn=lambda: (True, "training"),
+                label=f"telemetry[{log_name}]",
+                host=str(settings["http_host"]),
+            )
+        reg = registry()
+        self._h_step = reg.histogram(
+            "hydragnn_step_time_seconds",
+            "Optimizer-step wall time (host dispatch-to-dispatch)",
+            labelnames=("phase",),
+        )
+        self._g_rate = reg.gauge(
+            "hydragnn_goodput_per_second",
+            "Real (mask-counted) items processed per second over the last "
+            "telemetry window",
+            labelnames=("axis",),
+        )
+        self._g_waste = reg.gauge(
+            "hydragnn_padding_waste_fraction",
+            "1 - real/padded slots over the last telemetry window",
+            labelnames=("axis",),
+        )
+        self._g_waste_bucket = reg.gauge(
+            "hydragnn_padding_waste_bucket_fraction",
+            "Node-slot padding waste per pad-bucket specialization",
+            labelnames=("bucket",),
+        )
+        self._g_mfu = reg.gauge(
+            "hydragnn_mfu_estimate",
+            "XLA-counted FLOPs / elapsed / chip peak over the last window",
+        )
+        self._g_devmem = reg.gauge(
+            "hydragnn_device_memory_peak_bytes",
+            "Per-device peak bytes in use",
+            labelnames=("device",),
+        )
+        self._g_hostmem = reg.gauge(
+            "hydragnn_host_memory_rss_bytes", "Host process resident set size"
+        )
+        self._g_epoch = reg.gauge(
+            "hydragnn_epoch", "Last completed training epoch"
+        )
+        self._g_loss = reg.gauge(
+            "hydragnn_loss", "Per-epoch loss", labelnames=("split",)
+        )
+        self._g_lr = reg.gauge(
+            "hydragnn_learning_rate", "Current injected learning rate"
+        )
+        self._c_guard = reg.counter(
+            "hydragnn_guard_skipped_steps_total",
+            "Non-finite steps skipped by the in-graph guard",
+        )
+        self._c_data_skip = reg.counter(
+            "hydragnn_data_skipped_samples_total",
+            "Samples dropped by the data-plane validator",
+            labelnames=("reason",),
+        )
+        self._c_retrace = reg.counter(
+            "hydragnn_retrace_violations_total",
+            "Trace-sentinel violations (silent recompiles) this process",
+        )
+        self._c_cache_hits = reg.counter(
+            "hydragnn_compile_cache_hits_total",
+            "Persistent compilation cache hits this process",
+        )
+        self._c_cache_misses = reg.counter(
+            "hydragnn_compile_cache_misses_total",
+            "Persistent compilation cache misses this process",
+        )
+        # materialize the always-expected series so a scrape is schema-
+        # complete from the first window (counters appear at 0, not never)
+        self._c_guard.set_total(0)
+        self._c_retrace.set_total(0)
+        self._c_cache_hits.set_total(0)
+        self._c_cache_misses.set_total(0)
+
+    def _reset_window(self) -> None:
+        self._w_steps = 0
+        self._w_dt = 0.0
+        self._w_real = {"graphs": 0, "nodes": 0, "edges": 0}
+        self._w_padded = {"graphs": 0, "nodes": 0, "edges": 0}
+        self._w_buckets: Dict[Tuple[int, int], Dict[str, float]] = {}
+
+    # -- wiring --------------------------------------------------------------
+
+    def attach_flops(
+        self, flops_for: Callable[[Tuple[int, int]], Optional[float]]
+    ) -> None:
+        """Install the FLOPs source: (per-shard padded nodes, edges) ->
+        XLA-counted FLOPs of that train-step specialization, or None while
+        unknown (the compile plane fills its table as warm-up progresses)."""
+        self._flops_for = flops_for
+
+    def _flops_of(self, key: Tuple[int, int]) -> Optional[float]:
+        got = self._flops_cache.get(key)
+        if got is None and self._flops_for is not None:
+            got = self._flops_for(key)
+            if got is not None:
+                self._flops_cache[key] = float(got)
+        return got
+
+    # -- per-step path -------------------------------------------------------
+
+    def on_step(self, batch, dt: float, real_graphs: Optional[int] = None) -> None:
+        """Record one optimizer step: ``dt`` is the host wall time of the
+        dispatch (see module docstring for why that converges to device
+        step time), ``real_graphs`` the already-computed mask count the
+        loop has anyway."""
+        self.global_step += 1
+        self._h_step.observe(dt, phase="train")
+        real, padded, key = _batch_census(batch, real_graphs)
+        self._w_steps += 1
+        self._w_dt += float(dt)
+        for axis in ("graphs", "nodes", "edges"):
+            self._w_real[axis] += real[axis]
+            self._w_padded[axis] += padded[axis]
+        b = self._w_buckets.setdefault(
+            key, {"steps": 0, "real_nodes": 0, "padded_nodes": 0, "dt": 0.0}
+        )
+        b["steps"] += 1
+        b["real_nodes"] += real["nodes"]
+        b["padded_nodes"] += padded["nodes"]
+        b["dt"] += float(dt)
+        if self.trigger is not None:
+            self.trigger.step(self.global_step)
+        if self._w_steps >= self.interval:
+            self.flush()
+
+    def flush(self) -> None:
+        """Close the current window: compute rates/waste/MFU, update the
+        registry, emit one ``step_window`` record, poll the profile
+        trigger, refresh the memory gauges."""
+        if self._w_steps == 0:
+            if self.trigger is not None:
+                self.trigger.poll(self.global_step)
+            return
+        dt = max(self._w_dt, 1e-9)
+        rates = {a: self._w_real[a] / dt for a in ("graphs", "nodes", "edges")}
+        waste = {
+            a: 1.0 - self._w_real[a] / max(self._w_padded[a], 1)
+            for a in ("graphs", "nodes", "edges")
+        }
+        for a in ("graphs", "nodes", "edges"):
+            self._g_rate.set(rates[a], axis=a)
+            self._g_waste.set(waste[a], axis=a)
+        buckets = {}
+        flops = 0.0
+        flops_known = self.want_mfu and self._flops_for is not None
+        for key, b in self._w_buckets.items():
+            label = f"{key[0]}n/{key[1]}e"
+            bucket_waste = 1.0 - b["real_nodes"] / max(b["padded_nodes"], 1)
+            self._g_waste_bucket.set(bucket_waste, bucket=label)
+            buckets[label] = {
+                "steps": b["steps"],
+                "padding_waste": round(bucket_waste, 4),
+            }
+            if flops_known:
+                f = self._flops_of(key)
+                if f is None:
+                    flops_known = False
+                else:
+                    flops += f * b["steps"]
+        mfu = None
+        if flops_known and flops > 0:
+            mfu = mfu_estimate(flops, dt, self._device_kind_cached())
+            self._g_mfu.set(mfu)
+        self._update_memory_gauges()
+        if self.stream is not None:
+            self.stream.write(
+                "step_window",
+                {
+                    "step": self.global_step,
+                    "steps": self._w_steps,
+                    "step_time_ms": round(dt / self._w_steps * 1e3, 3),
+                    "graphs_per_sec": round(rates["graphs"], 2),
+                    "nodes_per_sec": round(rates["nodes"], 1),
+                    "edges_per_sec": round(rates["edges"], 1),
+                    "padding_waste": round(waste["nodes"], 4),
+                    "padding_waste_graphs": round(waste["graphs"], 4),
+                    "padding_waste_edges": round(waste["edges"], 4),
+                    # 9 decimals: a CPU-backend MFU is ~1e-7 against the
+                    # TPU peak table and must not round to a dead 0.0
+                    "mfu_est": round(mfu, 9) if mfu is not None else None,
+                    "buckets": buckets,
+                },
+            )
+        if self.writer is not None:
+            self.writer.add_scalars(
+                {
+                    "telemetry/step_time_ms": dt / self._w_steps * 1e3,
+                    "telemetry/graphs_per_sec": rates["graphs"],
+                    "telemetry/padding_waste": waste["nodes"],
+                    **(
+                        {"telemetry/mfu_est": mfu} if mfu is not None else {}
+                    ),
+                },
+                self.global_step,
+            )
+        if self.trigger is not None:
+            self.trigger.poll(self.global_step)
+        self._reset_window()
+
+    def _device_kind_cached(self) -> str:
+        if self._device_kind is None:
+            try:
+                import jax
+
+                self._device_kind = jax.devices()[0].device_kind
+            except Exception:
+                self._device_kind = "unknown"
+        return self._device_kind
+
+    def _update_memory_gauges(self, force: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._mem_refreshed_at < _MEMORY_REFRESH_S:
+            return
+        self._mem_refreshed_at = now
+        try:
+            from ..utils.profile import peak_memory_stats
+
+            for dev, peak in peak_memory_stats().items():
+                self._g_devmem.set(peak, device=dev)
+        except Exception:
+            pass
+        self._g_hostmem.set(host_memory_bytes())
+
+    # -- epoch / run path ----------------------------------------------------
+
+    def on_epoch(self, epoch: int, scalars: Dict[str, float],
+                 filler: bool = False) -> None:
+        """Epoch-boundary record. ``filler=True`` marks rows whose val/test
+        entries are carried forward (mid-epoch preemption stop) rather than
+        measured — consumers comparing validation curves (HPO early
+        stopping) must skip them."""
+        self.flush()
+        self._g_epoch.set(int(epoch))
+        for split, v in scalars.items():
+            if split == "lr":
+                self._g_lr.set(float(v))
+            else:
+                self._g_loss.set(float(v), split=split)
+        if self.stream is not None:
+            self.stream.write(
+                "epoch",
+                {
+                    "epoch": int(epoch),
+                    **{k: float(v) for k, v in scalars.items()},
+                    "filler": bool(filler),
+                },
+            )
+
+    def absorb_counters(
+        self,
+        guard_skipped: Optional[int] = None,
+        data_skipped: Optional[Dict[str, int]] = None,
+        retrace_violations: Optional[int] = None,
+        compile_metrics: Optional[Dict[str, float]] = None,
+    ) -> None:
+        """Absorb externally maintained monotonic totals (idempotent:
+        counters max-merge). Call wherever the owning subsystem is already
+        host-synced — the epoch boundary, run end. ``guard_skipped`` must
+        be a monotonic EVENT count: the raw TrainState counter can go DOWN
+        on a rollback restore, so the loop accumulates positive deltas
+        before absorbing (train/loop.py guard_events)."""
+        if guard_skipped is not None:
+            self._c_guard.set_total(int(guard_skipped))
+        for reason, count in (data_skipped or {}).items():
+            self._c_data_skip.set_total(int(count), reason=reason)
+        if retrace_violations is not None:
+            self._c_retrace.set_total(int(retrace_violations))
+        if compile_metrics:
+            self._c_cache_hits.set_total(int(compile_metrics["cache_hits"]))
+            self._c_cache_misses.set_total(
+                int(compile_metrics["cache_misses"])
+            )
+
+    def run_record(self, info: Dict[str, Any]) -> None:
+        if self.stream is not None:
+            self.stream.write("run", dict(info))
+
+    @property
+    def endpoint_port(self) -> Optional[int]:
+        return self.http.port if self.http is not None else None
+
+    def close(self) -> None:
+        self.flush()
+        if self.trigger is not None:
+            self.trigger.close()
+        if self.http is not None:
+            self.http.close()
+            self.http = None
+        if self.stream is not None:
+            self.stream.close()

@@ -35,7 +35,15 @@ class ServeConfig:
     - lifecycle: ``hot_reload`` watches the run dir's ``latest`` pointer and
       swaps verified checkpoints in between batches (``reload_poll_s``
       cadence); ``drain_timeout_s`` bounds how long ``close()`` waits for
-      in-flight work.
+      in-flight work;
+    - observability: ``http_port`` mounts the Prometheus ``/metrics`` +
+      ``/healthz``/``/readyz`` endpoint (obs/prometheus.py) on the server —
+      0 (the default) binds an ephemeral loopback port (read it back from
+      ``GraphServer.http_port``), a positive value pins the port, a
+      negative value disables the endpoint (embedded/test servers);
+      ``http_host`` is the bind interface (default loopback — metrics are
+      not public by default; set ``"0.0.0.0"`` for off-host scrapers and
+      load-balancer readiness probes).
     """
 
     max_queue_requests: int = 256
@@ -49,6 +57,8 @@ class ServeConfig:
     hot_reload: bool = False
     reload_poll_s: float = 2.0
     drain_timeout_s: float = 30.0
+    http_port: int = 0
+    http_host: str = "127.0.0.1"
 
     _KNOWN = (
         "max_queue_requests",
@@ -62,6 +72,8 @@ class ServeConfig:
         "hot_reload",
         "reload_poll_s",
         "drain_timeout_s",
+        "http_port",
+        "http_host",
     )
 
     def __post_init__(self):
@@ -85,6 +97,16 @@ class ServeConfig:
                     f"Serving.{key} must be >= 0 (seconds; 0 disables), got "
                     f"{getattr(self, key)!r}"
                 )
+        if int(self.http_port) > 65535:
+            raise ValueError(
+                f"Serving.http_port must be <= 65535 (0 = ephemeral, "
+                f"negative disables), got {self.http_port!r}"
+            )
+        if not isinstance(self.http_host, str) or not self.http_host:
+            raise ValueError(
+                f"Serving.http_host must be a non-empty bind address, got "
+                f"{self.http_host!r}"
+            )
 
     @staticmethod
     def from_config(config: Dict[str, Any]) -> "ServeConfig":

@@ -28,7 +28,13 @@ operator doc):
   serving;
 - **graceful drain**: ``initiate_drain`` (wired to SIGTERM by
   ``install_sigterm``) stops admissions with a typed ``ServerDrainingError``
-  while every in-flight request still completes.
+  while every in-flight request still completes;
+- **observability** (obs/; docs/OBSERVABILITY.md): every lifecycle counter,
+  queue depth, readiness, and batch/request latency histograms publish into
+  the process metrics registry, scraped at the server's mandatory
+  ``/metrics`` + ``/healthz``/``/readyz`` endpoint (``Serving.http_port``,
+  default ephemeral loopback) — ``/readyz`` IS the warm-up flip, and goes
+  not-ready again the instant a drain starts.
 
 Chaos hooks (exact no-ops unarmed) live in utils/faultinject.py:
 ``HYDRAGNN_FAULT_SERVE_REQ_NAN`` / ``HYDRAGNN_FAULT_SERVE_WEDGE`` /
@@ -75,15 +81,18 @@ class PredictionHandle:
     ``GraphServer.predict`` use)."""
 
     __slots__ = (
-        "request_id", "deadline", "done_at", "_event", "_result", "_error",
+        "request_id", "deadline", "submitted_at", "done_at", "_event",
+        "_result", "_error",
     )
 
     def __init__(self, request_id: int, deadline: float):
         self.request_id = request_id
         self.deadline = deadline
-        # monotonic completion stamp (perf_counter), set with the outcome —
-        # lets latency harnesses (BENCH_SERVE) compute per-request latency
-        # without a waiter thread per request
+        # monotonic admission/completion stamps (perf_counter): done_at is
+        # set with the outcome so latency harnesses (BENCH_SERVE) and the
+        # serve latency histogram compute per-request latency without a
+        # waiter thread per request
+        self.submitted_at: float = time.perf_counter()
         self.done_at: Optional[float] = None
         self._event = threading.Event()
         self._result: Optional[Dict[str, np.ndarray]] = None
@@ -283,12 +292,54 @@ class GraphServer:
             "batches": 0,
             "reloads": 0,
         }
+        # telemetry plane (obs/): every counter _bump touches is mirrored
+        # into the process registry, plus queue depth / readiness gauges and
+        # batch / per-request latency histograms — the scrapeable SLO
+        # surface behind /metrics (Serving.http_port). Series materialize
+        # at 0 so a scrape is schema-complete before the first request.
+        # Scope: these are PROCESS metrics (one serving instance per
+        # process is the run_server deployment model) — counters span every
+        # instance's lifetime, gauges are last-writer; construction uses
+        # set_default so building a standby server never clobbers a live
+        # one's readiness.
+        from ..obs.registry import registry as _obs_registry
+
+        _reg = _obs_registry()
+        self._m_events = _reg.counter(
+            "hydragnn_serve_events_total",
+            "Serving request-lifecycle event counts (GraphServer.stats keys)",
+            labelnames=("event",),
+        )
+        for key in self._stats:
+            self._m_events.inc(0, event=key)
+        self._m_queue = _reg.gauge(
+            "hydragnn_serve_queue_depth",
+            "Admitted requests waiting in the micro-batcher queue",
+        )
+        self._m_ready = _reg.gauge(
+            "hydragnn_serve_ready",
+            "1 once the full ladder is warmed and admissions are open",
+        )
+        self._m_batch_lat = _reg.histogram(
+            "hydragnn_serve_batch_latency_seconds",
+            "Device micro-batch service time (form -> outputs on host)",
+        )
+        self._m_req_lat = _reg.histogram(
+            "hydragnn_serve_request_latency_seconds",
+            "Per-request latency, admission to delivered outcome (outcome="
+            "error covers deadline/wedge/batch failures — without it the "
+            "p99 would be survivorship-biased exactly under overload)",
+            labelnames=("outcome",),
+        )
+        self._m_queue.set_default(0)
+        self._m_ready.set_default(0)
         self._predict_fn = self._build_predict_fn()
         self._runner: Optional[_StepRunner] = None
         self._serve_thread: Optional[threading.Thread] = None
         self._warm_thread: Optional[threading.Thread] = None
         self._watcher = None  # serve/reload.CheckpointWatcher
         self._prev_sigterm = None
+        self._http = None  # obs/prometheus.TelemetryHTTPServer
 
     # -- construction helpers ------------------------------------------------
 
@@ -324,6 +375,36 @@ class GraphServer:
             raise ServerClosedError("server is closed")
         if self._serve_thread is not None:
             return self
+        if int(self.cfg.http_port) >= 0:
+            # mandatory observability surface (docs/SERVING.md
+            # "Endpoints"): /metrics + /healthz + /readyz. Readiness IS the
+            # full-ladder warm-up flip that opens the serve loop — a load
+            # balancer routing on /readyz only ever sends traffic to a
+            # zero-retrace server that is accepting admissions. Best-effort
+            # bind: an occupied port warns instead of failing the server.
+            from ..obs.prometheus import start_endpoint
+
+            self._http = start_endpoint(
+                int(self.cfg.http_port),
+                ready_fn=lambda: (
+                    self._ready.is_set()
+                    and self.failed is None
+                    and not self._closed
+                    and not self._draining.is_set()
+                ),
+                health_fn=lambda: (
+                    (True, "serving")
+                    if self.failed is None and not self._closed
+                    else (
+                        False,
+                        "closed"
+                        if self.failed is None
+                        else f"warm-up failed: {self.failed}",
+                    )
+                ),
+                label=f"serve[{self.log_name}]",
+                host=self.cfg.http_host,
+            )
         if install_sigterm:
             import signal
 
@@ -402,10 +483,17 @@ class GraphServer:
             )
             return
         self._ready.set()
+        self._m_ready.set(1)
 
     @property
     def ready(self) -> bool:
         return self._ready.is_set()
+
+    @property
+    def http_port(self) -> Optional[int]:
+        """Port of the /metrics//healthz//readyz endpoint, or None when
+        disabled (``Serving.http_port`` < 0) or the bind failed."""
+        return self._http.port if self._http is not None else None
 
     @property
     def draining(self) -> bool:
@@ -425,8 +513,15 @@ class GraphServer:
 
     def initiate_drain(self) -> None:
         """Stop admitting (async-signal-safe: only sets a flag); in-flight
-        and queued requests still complete. The SIGTERM hook."""
+        and queued requests still complete. The SIGTERM hook. (The ready
+        gauge/endpoint report not-ready from here on — a draining server
+        must fall out of its load balancer; the gauge write is a plain
+        dict store, still async-signal-safe. Only the instance that
+        reported ready may zero the shared gauge — draining a never-ready
+        standby must not clobber a live server's readiness.)"""
         self._draining.set()
+        if self._ready.is_set():
+            self._m_ready.set(0)
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Initiate + wait for the drain to finish. Returns True when every
@@ -445,6 +540,13 @@ class GraphServer:
             self.drain(timeout)
         self._closed = True
         self._stop.set()
+        if self._ready.is_set():
+            # same standby guard as initiate_drain: only a server that
+            # reported ready un-reports on close
+            self._m_ready.set(0)
+        if self._http is not None:
+            self._http.close()
+            self._http = None
         if self._watcher is not None:
             self._watcher.stop()
         if self._serve_thread is not None:
@@ -561,6 +663,7 @@ class GraphServer:
                 request_id=idx,
             ) from None
         self._bump("admitted")
+        self._m_queue.set(self._queue.qsize())
         return handle
 
     def predict(
@@ -614,11 +717,12 @@ class GraphServer:
                     return None
             if time.monotonic() > req.handle.deadline:
                 self._bump("deadline_expired")
-                req.handle._fail(
+                self._fail_request(
+                    req.handle,
                     DeadlineExceededError(
                         "deadline expired while queued (waited past the "
                         "request's budget)"
-                    )
+                    ),
                 )
                 continue
             return req
@@ -697,7 +801,8 @@ class GraphServer:
                 # the wedged runner thread is abandoned (daemon); recycle
                 self._runner = _StepRunner()
                 for r in reqs:
-                    r.handle._fail(
+                    self._fail_request(
+                        r.handle,
                         WedgedStepError(
                             f"device step for batch {batch_index} exceeded "
                             f"step_timeout_s={self.cfg.step_timeout_s}s; the "
@@ -709,15 +814,18 @@ class GraphServer:
             except Exception as e:  # noqa: BLE001 — batch-level failure
                 self._bump("failed_batches")
                 for r in reqs:
-                    r.handle._fail(
+                    self._fail_request(
+                        r.handle,
                         RequestError(
                             f"batch {batch_index} failed: "
                             f"{type(e).__name__}: {e}"
-                        )
+                        ),
                     )
                 self._inflight_graphs = 0
                 continue
             dt = time.perf_counter() - t0
+            self._m_batch_lat.observe(dt)
+            self._m_queue.set(self._queue.qsize())
             self._deliver(reqs, batch, outputs)
             self._bump("batches")
             self._bump("completed", len(reqs))
@@ -749,19 +857,32 @@ class GraphServer:
                 else:  # scalar/aux output: handed through as-is
                     result[name] = a
             r.handle._resolve(result)
+            self._m_req_lat.observe(
+                r.handle.done_at - r.handle.submitted_at, outcome="ok"
+            )
 
     # -- bookkeeping ---------------------------------------------------------
 
+    def _fail_request(self, handle: PredictionHandle, err: RequestError) -> None:
+        """Fail one admitted request AND observe its latency with the error
+        outcome — failed requests (deadline, wedge, batch error, drain) are
+        precisely the slow tail, so excluding them would make the scraped
+        p99 improve as the server violates its SLO harder."""
+        handle._fail(err)
+        self._m_req_lat.observe(
+            handle.done_at - handle.submitted_at, outcome="error"
+        )
+
     def _fail_queued(self, err: RequestError) -> None:
         if self._holdover is not None:
-            self._holdover.handle._fail(err)
+            self._fail_request(self._holdover.handle, err)
             self._holdover = None
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 return
-            req.handle._fail(err)
+            self._fail_request(req.handle, err)
 
     def _install_state(self, state, label: Optional[str]) -> None:
         """Stage a reloaded state; the serve loop swaps it in at the next
@@ -773,6 +894,7 @@ class GraphServer:
     def _bump(self, key: str, by: int = 1) -> None:
         with self._stats_lock:
             self._stats[key] = self._stats.get(key, 0) + by
+        self._m_events.inc(by, event=key)
 
     def stats(self) -> Dict[str, Any]:
         """Structured serving counters + the current policy/observability
@@ -793,5 +915,6 @@ class GraphServer:
                 len(sentinel().violations()) - self._violations_at_launch, 0
             ),
             current_checkpoint=self.current_checkpoint,
+            http_port=self.http_port,
         )
         return out

@@ -110,6 +110,22 @@ def _sha256_path(fname: str) -> str:
     return fname + ".sha256"
 
 
+def _observe_duration(op: str, t0: float) -> None:
+    """Publish one checkpoint write/restore duration into the telemetry
+    registry (obs/registry.py; docs/OBSERVABILITY.md). Observability only:
+    never allowed to fail a save/restore."""
+    try:
+        from ..obs.registry import registry
+
+        registry().histogram(
+            "hydragnn_checkpoint_seconds",
+            "Checkpoint write/restore wall time",
+            labelnames=("op",),
+        ).observe(time.perf_counter() - t0, op=op)
+    except Exception:
+        pass
+
+
 def _epoch_from_env() -> Optional[int]:
     """HYDRAGNN_EPOCH, hardened: a malformed value at the very end of a run
     must not crash the save — warn and fall back to the unsuffixed name."""
@@ -169,6 +185,7 @@ def save_model(
 
     from ..parallel.mesh import materialize_replicated
 
+    t0 = time.perf_counter()
     state = materialize_replicated(state)
     if jax.process_index() != 0:
         return ""
@@ -202,6 +219,7 @@ def save_model(
         os.path.join(d, "latest"), os.path.basename(fname).encode("utf-8")
     )
     _prune_retention(d, log_name, retention)
+    _observe_duration("write", t0)
     return fname
 
 
@@ -219,6 +237,7 @@ def save_model_orbax(
     manager's ``max_to_keep`` (0 = keep every step)."""
     import orbax.checkpoint as ocp
 
+    t0 = time.perf_counter()
     if epoch is None:
         epoch = _epoch_from_env() or 0
     d = _run_dir(log_name, path)
@@ -239,6 +258,7 @@ def save_model_orbax(
         atomic_write(
             os.path.join(d, "latest"), f"orbax/{int(epoch)}".encode("utf-8")
         )
+    _observe_duration("write", t0)
     return os.path.join(ckpt_dir, str(int(epoch)))
 
 
@@ -438,6 +458,7 @@ def load_inference_state(
     chain fell back past a corrupt candidate" (serve/reload.py keeps the
     current weights in the latter case). Orbax-backed runs raise ValueError:
     their shard-parallel restore needs the full-template path."""
+    t0 = time.perf_counter()
     tried: List[str] = []
     d, entry = _resolve_restore_dir(log_name, path, tried)
     if entry and entry.startswith("orbax/"):
@@ -459,6 +480,7 @@ def load_inference_state(
                 ),
                 step=int(np.asarray(raw.get("step", 0))),
             )
+            _observe_duration("restore", t0)
             return restored, fn
         except Exception as e:  # noqa: BLE001 — structure drift / truncation
             tried.append(f"{fn}: inference deserialization failed ({e})")
@@ -483,6 +505,7 @@ def load_existing_model(
     pointer names). Total failure raises a FileNotFoundError that lists the
     run dir's files and every candidate tried with the reason it was
     rejected."""
+    t0 = time.perf_counter()
     tried: List[str] = []
     d, entry = _resolve_restore_dir(log_name, path, tried)
     if entry and entry.startswith("orbax/"):
@@ -498,6 +521,7 @@ def load_existing_model(
                 )
             if loaded_entry is not None:
                 loaded_entry.append(entry)
+            _observe_duration("restore", t0)
             return restored
         except Exception as e:  # noqa: BLE001 — fall back to the msgpack chain
             tried.append(f"{entry}: orbax restore failed ({e})")
@@ -509,5 +533,6 @@ def load_existing_model(
             continue
         if loaded_entry is not None:
             loaded_entry.append(fn)
+        _observe_duration("restore", t0)
         return restored
     _raise_no_checkpoint(log_name, d, tried)

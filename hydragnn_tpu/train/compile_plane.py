@@ -514,6 +514,13 @@ class CompilePlane:
         self.jobs: List[Tuple[str, Callable]] = []
         self.compiled: List[Tuple[str, float]] = []  # (label, secs)
         self.errors: List[Tuple[str, str]] = []
+        # XLA-counted FLOPs per warmed specialization (label -> flops),
+        # harvested from the AOT-compiled executables' cost_analysis — the
+        # flops-audit recipe (run-scripts/flops_audit.py) at zero extra
+        # compile cost. The telemetry plane's MFU gauge reads this table
+        # (obs/telemetry.py attach_flops); dict writes are atomic under the
+        # GIL, so the background worker publishes lock-free.
+        self.flops_by_spec: Dict[str, float] = {}
         self.time_to_first_step: Optional[float] = None
         self._t0: Optional[float] = None
         self._m0: Dict[str, float] = {}
@@ -638,11 +645,20 @@ class CompilePlane:
                 return
             t0 = time.perf_counter()
             try:
-                thunk().compile()
+                compiled = thunk().compile()
             except Exception as e:  # warm-up must never kill training
                 self.errors.append((label, f"{type(e).__name__}: {e}"))
                 continue
             self.compiled.append((label, time.perf_counter() - t0))
+            try:
+                cost = compiled.cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0]
+                flops = float(cost.get("flops", 0.0))
+                if flops > 0:
+                    self.flops_by_spec[label] = flops
+            except Exception:  # cost analysis is best-effort observability
+                pass
 
     def _worker_main(self) -> None:
         from ..utils.timers import Timer
@@ -657,6 +673,12 @@ class CompilePlane:
         # warm-up hiccup into a spurious (possibly fatal) sentinel report
         if self.jobs and not self.errors and not self._stop.is_set():
             _SENTINEL.arm(self.retrace_policy)
+
+    def train_flops_for(self, key: Tuple[int, int]) -> Optional[float]:
+        """FLOPs of the train-step specialization padded to ``key`` =
+        (per-shard nodes, edges), or None while warm-up has not compiled
+        it (background mode fills the table as it goes)."""
+        return self.flops_by_spec.get(f"train:{key[0]}n/{key[1]}e")
 
     def finish(self, verbosity: int = 0) -> Dict[str, Any]:
         """End the run: stop/join the worker, disarm the sentinel, return

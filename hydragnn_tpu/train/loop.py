@@ -269,7 +269,8 @@ def _maybe_device_prefetch(iterator):
     return device_prefetch(iterator, depth=depth)
 
 
-def train_epoch(loader, step_fn, state, rng, start_batch: int = 0):
+def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
+                telemetry=None):
     """One training epoch. Returns ``(state, tot, tasks, rng, cursor)``:
     ``cursor`` is None when the epoch completed, or the next-batch offset
     (loader-absolute) when a SIGTERM arrived between steps — the mid-epoch
@@ -278,7 +279,11 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0):
     keep the epoch-boundary stop). ``start_batch`` fast-forwards a loader
     WITHOUT native resume support by consuming (not stepping) its first
     batches; loaders that implement ``resume()`` skip building them
-    entirely and report their offset via ``start_batch`` attribute."""
+    entirely and report their offset via ``start_batch`` attribute.
+    ``telemetry`` (obs/telemetry.StepTelemetry, or None) receives every
+    step's batch + host dispatch time — under async dispatch the queue
+    throttles the host to the device rate, so the window means it
+    publishes converge to device step time without per-step syncs."""
     from ..utils import preemption
     from ..utils import tracer as tr
 
@@ -312,12 +317,17 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0):
             continue  # fast-forward (mid-epoch resume on a generic loader)
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
+        t_step = time.perf_counter()
         state, tot, tasks = step_fn(state, batch, sub)
         # graph_mask is loader data (host numpy, or an already-transferred
         # leaf under device_prefetch) — reading it never waits on compute
         n = int(np.asarray(batch.graph_mask).sum())
         tr.stop("train_step")
         entries.append((tot, tasks, n))
+        if telemetry is not None:
+            telemetry.on_step(
+                batch, time.perf_counter() - t_step, real_graphs=n
+            )
         if check_preempt and preemption.preempted():
             # SIGTERM between steps: stop HERE and let the loop checkpoint
             # state + loader cursor, so resume replays exactly the batches
@@ -410,6 +420,7 @@ def train_validate_test(
     eval_fn: Optional[Callable] = None,
     restore_fn: Optional[Callable[[TrainState], TrainState]] = None,
     loader_state_fn: Optional[Callable[[Dict[str, int]], None]] = None,
+    writer=None,
 ) -> Tuple[TrainState, Dict[str, List[float]]]:
     """Outer epoch loop (reference: train_validate_test.py:52-264).
 
@@ -423,6 +434,12 @@ def train_validate_test(
     ``loader_state_fn`` persists the loader cursor dict of a MID-epoch
     preemption stop (api.py wires it to ``save_loader_state``); without it
     a mid-epoch SIGTERM still checkpoints, at epoch-replay granularity.
+    ``writer`` (utils.MetricsWriter) additionally receives the run's
+    already-counted health signals — guard skip totals, data-plane skip
+    tallies, retrace violations, compile-cache hits/misses — so they land
+    in ``scalars.jsonl``/TensorBoard instead of stdout-only report lines;
+    it is also the TB mirror of the per-step telemetry layer when the
+    ``Telemetry`` config section enables one (obs/telemetry.py).
     """
     training = config["NeuralNetwork"]["Training"]
     num_epoch = training["num_epoch"]
@@ -471,6 +488,16 @@ def train_validate_test(
     preemption.install()
     tr.enable()
 
+    # per-step telemetry layer (obs/telemetry.py): opt-in via the top-level
+    # ``Telemetry`` config section (HYDRAGNN_TELEMETRY overrides) — step
+    # time, goodput, padding waste, MFU estimate, memory gauges, the
+    # versioned metrics.jsonl stream, an optional /metrics endpoint, and
+    # the on-demand profiling trigger. None when disabled: the loop then
+    # pays one `is not None` check per step and nothing else.
+    from ..obs.telemetry import StepTelemetry
+
+    telemetry = StepTelemetry.from_config(config, log_name, writer=writer)
+
     # compile plane (train/compile_plane.py): AOT warm-up of every
     # (train, eval) x pad-bucket specialization against the persistent
     # compilation cache, plus the retrace sentinel. Degrades to off when no
@@ -493,6 +520,11 @@ def train_validate_test(
         rng=jax.random.PRNGKey(seed),
         skip_eval=not do_valtest,
     )
+    if telemetry is not None:
+        # MFU source: the AOT warm-up's cost_analysis table — background
+        # mode fills it while epoch 0 runs, so early windows may publish
+        # no MFU and later ones do (the flush handles None)
+        telemetry.attach_flops(plane.train_flops_for)
 
     rng = jax.random.PRNGKey(seed)
     hist: Dict[str, List[float]] = {"train": [], "val": [], "test": [], "lr": []}
@@ -527,6 +559,19 @@ def train_validate_test(
     # data-plane skip tally dedup: log at the epoch boundary only when the
     # run-level count changed (ingest skips report once, at epoch 0)
     reported_skips = 0
+    # guard-skip EVENT accounting for the telemetry counter: a rollback
+    # restores an older state whose skipped_steps total is LOWER, so
+    # absorbing the raw total (max-merge) would swallow every post-rollback
+    # skip until the old high-water mark is passed — accumulate positive
+    # deltas instead, resyncing the reference on any decrease. Seeded from
+    # the INCOMING state's counter: a Training.continue resume carries the
+    # previous run's total, which are not THIS process's events
+    guard_seen = (
+        int(jax.device_get(state.skipped_steps))
+        if writer is not None or telemetry is not None
+        else 0
+    )
+    guard_events = 0
     try:
         for epoch in range(num_epoch):
             t0 = time.time()
@@ -540,7 +585,7 @@ def train_validate_test(
             train_loader.set_epoch(epoch)
             with tr.timer("train"):
                 state, tr_loss, tr_tasks, rng, cursor = train_epoch(
-                    train_loader, step_fn, state, rng
+                    train_loader, step_fn, state, rng, telemetry=telemetry
                 )
             hist["train"].append(tr_loss)
             # data-plane skip tally (data/validate.py): whenever the run's
@@ -554,17 +599,76 @@ def train_validate_test(
                     f"{sval.tally()}",
                     file=sys.stderr,
                 )
+            # route the run's already-counted health signals into the
+            # metric stream (scalars.jsonl + TensorBoard + the registry) —
+            # machine-readable, not stdout-only: guard skips, data-plane
+            # skip tally, retrace violations, this run's cache hits/misses
+            if writer is not None or telemetry is not None:
+                skipped_total = int(jax.device_get(state.skipped_steps))
+                guard_events += max(skipped_total - guard_seen, 0)
+                guard_seen = skipped_total
+                plane_rep = plane.report()
+                health = {
+                    "guard/skipped_steps": skipped_total,
+                    "data/skipped_samples": (
+                        sval.skipped_total if sval is not None else 0
+                    ),
+                    "compile/retrace_violations": plane_rep["violations"],
+                    "compile/cache_hits": plane_rep["cache_hits"],
+                    "compile/cache_misses": plane_rep["cache_misses"],
+                }
+                if writer is not None:
+                    writer.add_scalars(health, epoch)
+                if telemetry is not None:
+                    from .compile_plane import compile_metrics
+
+                    telemetry.absorb_counters(
+                        guard_skipped=guard_events,
+                        data_skipped=(
+                            dict(sval.counts) if sval is not None else None
+                        ),
+                        retrace_violations=plane_rep["violations"],
+                        compile_metrics=compile_metrics(),
+                    )
             if cursor is not None:
                 # SIGTERM between steps: checkpoint state + loader cursor
                 # NOW (the grace window is ticking — no val/test, no policy
                 # pass) and stop; Training.continue replays the remaining
                 # batches of THIS epoch in the same order (api.py wires
                 # loader_state_fn -> save_loader_state). hist stays
-                # rectangular: the partial epoch's train loss stands in for
-                # the never-run val/test, like the HYDRAGNN_VALTEST=0 path.
-                hist["val"].append(tr_loss)
-                hist["test"].append(tr_loss)
+                # rectangular by CARRYING the last real val/test values —
+                # copying the partial epoch's train loss in (the pre-r7
+                # behavior) corrupted HPO early-stopping comparisons, which
+                # minimize over hist["val"] (hpo.py): a lucky partial-epoch
+                # train loss would masquerade as a validation improvement.
+                # A first-epoch preemption has no real value to carry, so
+                # the train loss stands in there (the HYDRAGNN_VALTEST=0
+                # degenerate case); either way the emitted stream marks the
+                # row as filler so consumers can skip it.
+                last_val = hist["val"][-1] if hist["val"] else tr_loss
+                last_test = hist["test"][-1] if hist["test"] else tr_loss
+                hist["val"].append(last_val)
+                hist["test"].append(last_test)
                 hist["lr"].append(state.learning_rate)
+                filler_row = {
+                    "train": tr_loss,
+                    "val": last_val,
+                    "test": last_test,
+                    "lr": state.learning_rate,
+                }
+                if log_fn is not None:
+                    # the filler row flows through the SAME epoch-logging
+                    # hook as every measured epoch (api.py owns the tag
+                    # schema there), keeping every sink rectangular like
+                    # hist itself
+                    log_fn(epoch, filler_row)
+                if writer is not None:
+                    # marks this epoch's val/test as carried, not measured
+                    # (the scalars.jsonl/TB analog of the filler flag in
+                    # metrics.jsonl)
+                    writer.add_scalar("loss/filler", 1.0, epoch)
+                if telemetry is not None:
+                    telemetry.on_epoch(epoch, filler_row, filler=True)
                 preemption.note_global_stop()
                 if save_fn is not None:
                     save_fn(state, epoch)
@@ -625,6 +729,16 @@ def train_validate_test(
                     epoch,
                     {"train": tr_loss, "val": va_loss, "test": te_loss, "lr": state.learning_rate},
                 )
+            if telemetry is not None:
+                telemetry.on_epoch(
+                    epoch,
+                    {
+                        "train": tr_loss,
+                        "val": va_loss,
+                        "test": te_loss,
+                        "lr": state.learning_rate,
+                    },
+                )
             if verbosity > 0:
                 print(
                     f"[{log_name}] epoch {epoch}: train {tr_loss:.5f} val {va_loss:.5f} "
@@ -658,7 +772,65 @@ def train_validate_test(
         preemption.uninstall()
         # join the warm-up worker, disarm the sentinel, and (verbosity > 0)
         # print the one-line compile report the smokes parse
-        plane.finish(verbosity)
+        rep = plane.finish(verbosity)
+        if telemetry is not None:
+            # final absorption AFTER plane.finish: the warm-up worker has
+            # joined, so the flops table is complete and the run-level
+            # compile tallies are final. The whole teardown is exception-
+            # guarded: a telemetry failure here must neither mask the real
+            # training exception nor discard a completed run's result.
+            try:
+                from .compile_plane import compile_metrics
+
+                try:
+                    guard_total = int(jax.device_get(state.skipped_steps))
+                    guard_events += max(guard_total - guard_seen, 0)
+                except Exception:  # state donated-dead on an error path
+                    pass
+                telemetry.absorb_counters(
+                    guard_skipped=guard_events,
+                    data_skipped=(
+                        dict(train_loader.validator.counts)
+                        if getattr(train_loader, "validator", None)
+                        is not None
+                        else None
+                    ),
+                    retrace_violations=rep["violations"],
+                    compile_metrics=compile_metrics(),
+                )
+                telemetry.run_record(
+                    {
+                        "log_name": log_name,
+                        "epochs": len(hist["train"]),
+                        "global_step": telemetry.global_step,
+                        "endpoint_port": telemetry.endpoint_port,
+                        "compile": {
+                            k: rep[k]
+                            for k in (
+                                "precompiled",
+                                "specializations",
+                                "cache_hits",
+                                "cache_misses",
+                                "violations",
+                                "time_to_first_step",
+                            )
+                        },
+                    }
+                )
+            except Exception as e:  # noqa: BLE001
+                import warnings as _warnings
+
+                _warnings.warn(
+                    f"telemetry teardown failed ({type(e).__name__}: {e}); "
+                    "the run result is unaffected",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            finally:
+                try:
+                    telemetry.close()
+                except Exception:  # noqa: BLE001 — same contract
+                    pass
     if best_state is not None:
         state = best_state
     return state, hist

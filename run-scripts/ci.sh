@@ -49,6 +49,9 @@ python run-scripts/data_chaos_smoke.py
 echo "== serve-plane chaos smoke (zero-retrace load, corrupt-request isolation, wedged step, hot reload, SIGTERM drain) =="
 python run-scripts/serve_chaos_smoke.py
 
+echo "== telemetry smoke (metrics.jsonl + /metrics//healthz//readyz on train + serve legs; <=2% overhead A/B) =="
+python run-scripts/telemetry_smoke.py
+
 echo "== BENCH_SERVE cells (p50/p99 latency vs offered load, throughput at SLO, shed rate) =="
 BENCH_SERVE=1 BENCH_SERVE_SECS=2 python bench.py
 

@@ -39,6 +39,67 @@ def pytest_tracer_accumulates_regions():
     tr.reset()
 
 
+def pytest_tracer_reentrant_nesting():
+    """start(name) on an already-open region nests (per-name stack) instead
+    of overwriting the open timestamp — both stops record."""
+    tr.reset()
+    tr.enable()
+    tr.start("outer")
+    time.sleep(0.01)  # outer-only time >> inner, so the ratio check below
+    tr.start("outer")  # re-entrant: nests      # is robust to sleep jitter
+    time.sleep(0.002)
+    tr.stop("outer")  # closes the INNER span (LIFO within the name)
+    inner = tr.get_regions()["outer"]
+    assert inner["count"] == 1
+    assert 0.001 <= inner["total"] < 0.05, inner
+    tr.stop("outer")  # closes the outer span, which contains the inner
+    regions = tr.get_regions()["outer"]
+    assert regions["count"] == 2
+    # the outer span contains the inner sleep PLUS its own — if nesting
+    # regressed to overwrite-on-start, both spans would measure ~equal
+    assert regions["max"] >= 1.8 * regions["min"], regions
+    # per-name stack fully unwound: another stop is a no-op
+    tr.stop("outer")
+    assert tr.get_regions()["outer"]["count"] == 2
+    tr.reset()
+
+
+def pytest_tracer_strict_annotation_lifo():
+    """An out-of-nesting stop must unwind the xprof annotation stack in
+    strict LIFO order — inner (still-open) annotations are closed early
+    rather than exited out of order (scoped C++ objects)."""
+    from hydragnn_tpu.utils.tracer import _ann_stack
+
+    tr.reset()
+    tr.enable()
+    tr.start("a")
+    tr.start("b")
+    tr.start("c")
+    # annotations may be unavailable (no jax profiler) — the LIFO contract
+    # is on the stack bookkeeping either way
+    depth = len(_ann_stack)
+    assert depth in (0, 3)
+    tr.stop("a")  # out of nesting order: must pop c, b, then a
+    assert len(_ann_stack) == 0
+    # timing bookkeeping for the skipped names is still open and their
+    # stops still record (annotations were sacrificed, not the spans)
+    tr.stop("b")
+    tr.stop("c")
+    regions = tr.get_regions()
+    assert {regions[k]["count"] for k in ("a", "b", "c")} == {1}
+    # in-order close leaves one annotation popped per stop
+    tr.start("x")
+    tr.start("y")
+    if depth:
+        assert len(_ann_stack) == 2
+    tr.stop("y")
+    if depth:
+        assert [n for n, _ in _ann_stack] == ["x"]
+    tr.stop("x")
+    assert len(_ann_stack) == 0
+    tr.reset()
+
+
 def pytest_tracer_profile_decorator_and_report(tmp_path, capsys):
     tr.reset()
     tr.enable()
@@ -80,9 +141,34 @@ def pytest_metrics_writer_jsonl(tmp_path):
         json.loads(l)
         for l in open(tmp_path / "run_x" / "scalars.jsonl")
     ]
+    # schema: every record is exactly {tag: str, value: float, step: int} —
+    # downstream consumers (HPO, plotting) parse on this shape
+    for l in lines:
+        assert set(l) == {"tag", "value", "step"}, l
+        assert isinstance(l["tag"], str)
+        assert isinstance(l["value"], float)
+        assert isinstance(l["step"], int)
     tags = {(l["tag"], l["step"]): l["value"] for l in lines}
     assert tags[("loss/train", 0)] == 1.5
     assert tags[("loss/val", 1)] == 2.5
+
+
+def pytest_metrics_writer_rank0_gating(tmp_path, monkeypatch):
+    """Only process 0 writes: a non-zero rank's writer creates neither the
+    run dir nor the stream, and its add_scalar is a silent no-op."""
+    import jax
+
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    w = MetricsWriter("run_r1", path=str(tmp_path))
+    w.add_scalar("loss/train", 1.0, 0)
+    w.add_scalars({"x": 2.0}, 1)
+    w.close()
+    assert not os.path.exists(tmp_path / "run_r1")
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    w0 = MetricsWriter("run_r0", path=str(tmp_path))
+    w0.add_scalar("loss/train", 1.0, 0)
+    w0.close()
+    assert os.path.exists(tmp_path / "run_r0" / "scalars.jsonl")
 
 
 def pytest_walltime_parser():
