@@ -982,6 +982,30 @@ def _(config: dict, datasets=None, install_sigterm: bool = False):
     training = config["NeuralNetwork"]["Training"]
     arch = config["NeuralNetwork"]["Architecture"]
     serve_cfg = ServeConfig.from_config(config)
+    # tracing plane (obs/trace.py, obs/flightrec.py; docs/OBSERVABILITY.md):
+    # Telemetry.trace arms head-sampled request traces (trace_sample) to
+    # logs/<run>/trace.jsonl; the flight recorder arms the serve-wedge /
+    # unhandled-exception / SIGUSR2 black box. The server owns both and
+    # tears them down at close().
+    from .obs.telemetry import resolve_telemetry
+
+    obs_settings = resolve_telemetry(config)
+    run_dir = os.path.join("./logs", log_name)
+    tracer = None
+    if obs_settings["trace"]:
+        from .obs import trace as obs_trace
+
+        tracer = obs_trace.Tracer(
+            run_dir, sample=float(obs_settings["trace_sample"])
+        )
+        obs_trace.install(tracer)
+    flight = None
+    if obs_settings["flight_recorder"] and (
+        obs_settings["trace"] or obs_settings["enabled"]
+    ):
+        from .obs.flightrec import FlightRecorder
+
+        flight = FlightRecorder(run_dir, tracer=tracer).install()
     server = GraphServer(
         model,
         state,
@@ -992,6 +1016,8 @@ def _(config: dict, datasets=None, install_sigterm: bool = False):
         sort_edges=bool(arch.get("use_sorted_aggregation", False)),
         log_name=log_name,
         checkpoint_label=entry,
+        tracer=tracer,
+        flight_recorder=flight,
     )
     server.start(install_sigterm=install_sigterm)
     if serve_cfg.hot_reload:

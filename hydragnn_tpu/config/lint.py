@@ -166,6 +166,10 @@ _HANDLED = {
     "Telemetry.jsonl",
     "Telemetry.profile_trigger",
     "Telemetry.profile_steps",
+    "Telemetry.trace",
+    "Telemetry.trace_sample",
+    "Telemetry.trace_interval_steps",
+    "Telemetry.flight_recorder",
 }
 
 # reference keys that are intentionally NOT consumed here, with the

@@ -742,6 +742,25 @@ class GraphLoader:
                 [[self.graphs[i] for i in grp] for grp in chunk], self.spec
             )
 
+    def _emit_stall_event(self, cause: str, batch_index: int) -> None:
+        """Typed incident record for a stall verdict (obs/events.py) — the
+        flight-recorder window sees WHICH batch wedged, not just a counter
+        increment. Never allowed to fail the watchdog itself."""
+        try:
+            from ..obs.events import EV_LOADER_STALL
+            from ..obs.events import emit as _emit_event
+
+            _emit_event(
+                EV_LOADER_STALL,
+                severity="error",
+                cause=cause,
+                source=self.source,
+                batch_index=int(batch_index),
+                epoch=int(self.epoch),
+            )
+        except Exception:
+            pass
+
     def __iter__(self) -> Iterator[GraphBatch]:
         if self.prefetch <= 0:
             yield from self._batches()
@@ -822,6 +841,9 @@ class GraphLoader:
                                 break
                             except queue.Empty:
                                 c_stall.inc(source=self.source)
+                                self._emit_stall_event(
+                                    "producer_died", epoch_start + delivered
+                                )
                                 raise LoaderStallError(
                                     "prefetch producer thread exited without "
                                     "an end-of-epoch sentinel after batch "
@@ -833,6 +855,9 @@ class GraphLoader:
                         waited += _WATCHDOG_TICK_S
                         if timeout and waited >= timeout:
                             c_stall.inc(source=self.source)
+                            self._emit_stall_event(
+                                "producer_wedged", epoch_start + delivered
+                            )
                             raise LoaderStallError(
                                 "prefetch producer produced nothing for "
                                 f"{waited:.1f}s (> loader_stall_timeout="

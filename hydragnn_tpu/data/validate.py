@@ -219,6 +219,22 @@ class SampleValidator:
             return
         self._seen.add(key)
         self.counts[reason] = self.counts.get(reason, 0) + 1
+        # typed incident record (obs/events.py) — quarantine/skip verdicts
+        # land in the flight-recorder window with their reason attached
+        try:
+            from ..obs.events import EV_DATA_SKIP
+            from ..obs.events import emit as _emit_event
+
+            _emit_event(
+                EV_DATA_SKIP,
+                severity="warn",
+                reason=reason,
+                source=source,
+                index=int(index),
+                quarantined=self.policy == "quarantine",
+            )
+        except Exception:
+            pass
         entry = {
             "index": int(index),
             "dataset_id": ds_id,

@@ -105,6 +105,49 @@ def suggest_config(
     return config
 
 
+def _surface_trial_metrics(
+    run_dir: str,
+    trial_id,
+    study_dir: str,
+    offsets: Optional[Dict[str, int]] = None,
+) -> Optional[str]:
+    """Copy one trial run's telemetry files (``metrics.jsonl``,
+    ``scalars.jsonl``, ``trace.jsonl`` — whichever exist) into
+    ``<study_dir>/trials/trial_<id>/``, so the parent study sees every
+    worker's per-trial signal stream without digging through per-run log
+    dirs.
+
+    ``offsets`` (a per-source byte-cursor map the caller keeps across
+    trials) makes the copy *incremental*: the streams are append-mode, so
+    two trials whose configs resolve to the same log name share one
+    physical file — without the cursor, trial N's surfaced copy would
+    contain trials 0..N-1's records too. Returns the surfaced directory,
+    or None when the trial appended no telemetry. Best-effort: surfacing
+    failure never fails the trial."""
+    out = os.path.join(study_dir, "trials", f"trial_{trial_id}")
+    copied = False
+    for fname in ("metrics.jsonl", "scalars.jsonl", "trace.jsonl"):
+        src = os.path.join(run_dir, fname)
+        try:
+            if not os.path.exists(src):
+                continue
+            start = (offsets or {}).get(src, 0)
+            with open(src, "rb") as fh:
+                fh.seek(start)
+                data = fh.read()
+            if offsets is not None:
+                offsets[src] = start + len(data)
+            if not data:
+                continue
+            os.makedirs(out, exist_ok=True)
+            with open(os.path.join(out, fname), "wb") as fh:
+                fh.write(data)
+            copied = True
+        except OSError:
+            pass
+    return out if copied else None
+
+
 def run_hpo(
     base_config: Dict[str, Any],
     search_space: Dict[str, Any],
@@ -113,20 +156,78 @@ def run_hpo(
     trial_offset: int = 0,
     objective: Optional[Callable[[Dict[str, Any]], float]] = None,
     use_optuna: Optional[bool] = None,
+    study_dir: Optional[str] = None,
 ) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     """Run an HPO study; returns (best_config, trial records).
 
     ``objective(config) -> loss`` defaults to training the config with the
     public API and reporting the best validation loss. With Optuna available
     (and not disabled), the sampler is TPE; otherwise pure random search.
+
+    Every trial runs under a ``HYDRAGNN_TRIAL_ID`` label: the telemetry
+    plane stamps it into each ``metrics.jsonl`` record (obs/telemetry.py)
+    and the registry publishes it as ``hydragnn_hpo_trial``, so a worker's
+    signals are attributable per trial instead of hiding behind the run
+    name. ``study_dir`` (default: the ``HYDRAGNN_HPO_STUDY_DIR`` env, which
+    ``launch_hpo_workers`` exports as the parent workdir) makes the default
+    objective additionally surface each trial's metric files into
+    ``<study_dir>/trials/trial_<id>/`` (docs/OBSERVABILITY.md "HPO").
     """
+    if study_dir is None:
+        study_dir = os.getenv("HYDRAGNN_HPO_STUDY_DIR") or None
+    # worker-qualified labels: launch_hpo_workers gives every worker an
+    # overlapping trial_offset range (offset+i seeds the sampler stream),
+    # so bare numeric ids would collide across workers — two workers'
+    # trials/trial_3/ dirs silently overwriting each other. The exported
+    # HYDRAGNN_HPO_WORKER index disambiguates both the surfaced dirs and
+    # the "trial" labels in metrics.jsonl.
+    worker = os.getenv("HYDRAGNN_HPO_WORKER")
+    surf_offsets: Dict[str, int] = {}
+
     if objective is None:
 
         def objective(config: Dict[str, Any]) -> float:
             from .api import run_training
+            from .config import get_log_name_config
 
-            _, _, hist, *_ = run_training(config)
+            _, _, hist, cfg_out, *_ = run_training(config)
+            if study_dir:
+                _surface_trial_metrics(
+                    os.path.join("./logs", get_log_name_config(cfg_out)),
+                    os.environ.get("HYDRAGNN_TRIAL_ID", "unknown"),
+                    study_dir,
+                    offsets=surf_offsets,
+                )
             return float(np.min(hist["val"]))
+
+    # trial-id labeling wraps WHATEVER objective runs (default or custom):
+    # the env label scopes exactly the trial's lifetime, and the registry
+    # gauge makes the active trial scrapeable on a worker's endpoint
+    inner_objective = objective
+    trial_counter = iter(range(trial_offset, trial_offset + max(num_trials, 1)))
+
+    def objective(config: Dict[str, Any]) -> float:
+        tid = next(trial_counter, trial_offset + num_trials)
+        prev = os.environ.get("HYDRAGNN_TRIAL_ID")
+        os.environ["HYDRAGNN_TRIAL_ID"] = (
+            f"w{worker}.{tid}" if worker is not None else str(tid)
+        )
+        try:
+            from .obs.registry import registry
+
+            registry().gauge(
+                "hydragnn_hpo_trial",
+                "Trial id currently running in this HPO worker",
+            ).set(tid)
+        except Exception:
+            pass
+        try:
+            return inner_objective(config)
+        finally:
+            if prev is None:
+                os.environ.pop("HYDRAGNN_TRIAL_ID", None)
+            else:
+                os.environ["HYDRAGNN_TRIAL_ID"] = prev
 
     if use_optuna is None:
         try:
@@ -240,6 +341,14 @@ def launch_hpo_workers(
     if num_workers < 1:
         raise ValueError("num_workers must be >= 1")
     os.makedirs(workdir, exist_ok=True)
+    # the parent workdir IS the study dir: each worker's run_hpo surfaces
+    # per-trial metric files into <workdir>/trials/trial_w<i>.<id>/
+    # (run_hpo study_dir + worker-label resolution), closing the "HPO
+    # workers hide their signals" gap — the parent reads one directory,
+    # not N per-run log trees. The worker index rides the env too: the
+    # per-worker trial_offset ranges overlap by design (sampler seeding),
+    # so the index is what keeps surfaced dirs and trial labels disjoint.
+    base_env = {**(env or {}), "HYDRAGNN_HPO_STUDY_DIR": os.path.abspath(workdir)}
     shares = [
         num_trials // num_workers + (1 if i < num_trials % num_workers else 0)
         for i in range(num_workers)
@@ -256,6 +365,7 @@ def launch_hpo_workers(
             if os.path.exists(res):
                 os.remove(res)
             results.append(res)
+            worker_env = {**base_env, "HYDRAGNN_HPO_WORKER": str(i)}
             argv = [
                 tok.format(
                     worker=i, num_trials=share,
@@ -270,8 +380,9 @@ def launch_hpo_workers(
                 # would only configure the local ssh client).
                 import shlex
 
-                if env:
-                    argv = ["env"] + [f"{k}={v}" for k, v in env.items()] + argv
+                argv = ["env"] + [
+                    f"{k}={v}" for k, v in worker_env.items()
+                ] + argv
                 argv = ["ssh", hosts[i % len(hosts)]] + [
                     shlex.quote(t) for t in argv
                 ]
@@ -282,7 +393,7 @@ def launch_hpo_workers(
                     i,
                     subprocess.Popen(
                         argv, stdout=log, stderr=subprocess.STDOUT,
-                        env={**os.environ, **env} if env is not None else None,
+                        env={**os.environ, **worker_env},
                     ),
                     res,
                 )

@@ -1,8 +1,13 @@
 """Unified telemetry plane (docs/OBSERVABILITY.md): the process-wide
 metrics registry every subsystem publishes into, the Prometheus scrape +
 health endpoint, the per-step train instrumentation with its versioned
-``metrics.jsonl`` stream, and the on-demand profiling trigger."""
+``metrics.jsonl`` stream, the on-demand profiling trigger, and the tracing
+plane — request/step spans (obs/trace.py), the structured event log
+(obs/events.py), and the crash flight recorder (obs/flightrec.py)."""
 
+from .events import EventLog, events
+from .events import emit as emit_event
+from .flightrec import FlightRecorder
 from .prometheus import TelemetryHTTPServer, render_text, start_endpoint
 from .registry import (
     Counter,
@@ -21,17 +26,24 @@ from .telemetry import (
     peak_flops,
     resolve_telemetry,
 )
+from .trace import Span, Tracer
 
 __all__ = [
     "Counter",
+    "EventLog",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
     "MetricsStream",
     "ProfileTrigger",
     "SCHEMA_VERSION",
+    "Span",
     "StepTelemetry",
     "TelemetryHTTPServer",
+    "Tracer",
+    "emit_event",
+    "events",
     "host_memory_bytes",
     "mfu_estimate",
     "peak_flops",

@@ -1,0 +1,143 @@
+"""Structured event log: typed, ring-buffered records for every discrete
+incident the subsystems already detect (docs/OBSERVABILITY.md "Event log").
+
+Before r8 these incidents were counter increments plus scattered
+warnings/stderr lines: a guard skip bumped ``skipped_steps``, a shed bumped
+a stats key, a retrace violation appended to a list inside the sentinel.
+The event log gives each one a typed record — timestamp, kind, severity,
+the active trace_id (obs/trace.py) when one is open, and the incident's own
+attributes — in one process-wide ring buffer the crash flight recorder
+(obs/flightrec.py) dumps verbatim, so a post-mortem sees the last N
+incidents in order without re-running anything.
+
+Publishing is unconditional and cheap (one deque append + one counter inc
+under the registry lock), matching the registry's contract; sinks
+(flight-recorder dumps, ``snapshot()`` consumers) are opt-in. Emission is
+exception-safe by construction: a malformed attribute is coerced to its
+``str`` rather than raised, because an incident *reporter* must never
+become an incident *source*.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .registry import registry
+
+# -- stable event vocabulary (the kinds subsystems emit today) ---------------
+EV_GUARD_SKIP = "guard_skip"              # non-finite steps skipped (epoch tally)
+EV_GUARD_ROLLBACK = "guard_rollback"      # rollback policy restored a checkpoint
+EV_GUARD_FATAL = "guard_fatal"            # non_finite_policy=error raising
+EV_DATA_SKIP = "data_skip"                # validator reject (incl. quarantine)
+EV_RETRACE_VIOLATION = "retrace_violation"  # sentinel saw a silent recompile
+EV_CACHE_MISS = "compile_cache_miss"      # persistent compile cache miss
+EV_LOADER_STALL = "loader_stall"          # LoaderStallError raised
+EV_CKPT_WRITE = "checkpoint_write"        # checkpoint committed
+EV_SHED = "serve_shed"                    # SLO load shed at admission
+EV_QUEUE_FULL = "serve_queue_full"        # admission queue at its bound
+EV_DEADLINE = "serve_deadline"            # request expired while queued
+EV_WEDGE = "serve_wedge"                  # device-step watchdog fired
+EV_DRAIN = "serve_drain"                  # graceful drain initiated
+EV_RELOAD_SWAP = "reload_swap"            # hot reload installed a checkpoint
+EV_RELOAD_REJECT = "reload_reject"        # hot reload rejected a candidate
+EV_FLIGHT_DUMP = "flightrec_dump"         # the recorder itself dumped
+
+EVENT_KINDS = (
+    EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
+    EV_RETRACE_VIOLATION, EV_CACHE_MISS, EV_LOADER_STALL, EV_CKPT_WRITE,
+    EV_SHED, EV_QUEUE_FULL, EV_DEADLINE, EV_WEDGE, EV_DRAIN,
+    EV_RELOAD_SWAP, EV_RELOAD_REJECT, EV_FLIGHT_DUMP,
+)
+
+SEVERITIES = ("info", "warn", "error", "fatal")
+
+# default ring capacity: deep enough that a post-mortem sees the whole
+# incident cascade (a wedge under load sheds dozens of requests), small
+# enough that the resident cost is a few hundred dicts
+DEFAULT_CAPACITY = 256
+
+
+def _json_safe(v: Any) -> Any:
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+class EventLog:
+    """Process-wide ring buffer of typed incident records, mirrored into
+    the metrics registry (``hydragnn_events_total{kind=...}``)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        # RLock, not Lock: emitters run from signal handlers too (the serve
+        # drain hook emits EV_DRAIN from SIGTERM) — a handler interrupting
+        # its own thread mid-emit must be able to re-acquire, matching the
+        # registry's locking contract
+        self._lock = threading.RLock()
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=max(int(capacity), 1))
+        self.emitted = 0
+        self._counter = registry().counter(
+            "hydragnn_events_total",
+            "Structured incident events emitted, by kind "
+            "(docs/OBSERVABILITY.md event vocabulary)",
+            labelnames=("kind",),
+        )
+
+    def emit(
+        self,
+        kind: str,
+        severity: str = "info",
+        trace_id: Optional[str] = None,
+        **attrs: Any,
+    ) -> Dict[str, Any]:
+        """Record one incident. ``trace_id`` defaults to the active
+        tracer's current span context, so incidents inside a sampled
+        request/step carry their causal anchor for free."""
+        if trace_id is None:
+            from . import trace as _trace
+
+            trace_id = _trace.current_trace_id()
+        rec: Dict[str, Any] = {
+            "ts": round(time.time(), 6),
+            "kind": str(kind),
+            "severity": severity if severity in SEVERITIES else "info",
+        }
+        if trace_id:
+            rec["trace_id"] = trace_id
+        for k, v in attrs.items():
+            rec[k] = _json_safe(v)
+        with self._lock:
+            self._ring.append(rec)
+            self.emitted += 1
+        try:
+            self._counter.inc(kind=rec["kind"])
+        except Exception:
+            pass  # an invalid label value must not fail the reporter
+        return rec
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """The last N events, oldest first (what the flight recorder dumps)."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        """Drop buffered events (tests; the counter keeps its totals)."""
+        with self._lock:
+            self._ring.clear()
+
+
+_EVENTS = EventLog()
+
+
+def events() -> EventLog:
+    """The process-wide event log every subsystem emits into."""
+    return _EVENTS
+
+
+def emit(kind: str, severity: str = "info",
+         trace_id: Optional[str] = None, **attrs: Any) -> Dict[str, Any]:
+    """Module-level shorthand for ``events().emit(...)`` — the one-line
+    call subsystems use at their incident sites."""
+    return _EVENTS.emit(kind, severity=severity, trace_id=trace_id, **attrs)

@@ -68,6 +68,17 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
     "jsonl": True,
     "profile_trigger": True,
     "profile_steps": 5,
+    # tracing plane (obs/trace.py; docs/OBSERVABILITY.md "Tracing"):
+    # spans to logs/<run>/trace.jsonl under head-based sampling —
+    # trace_sample is the per-request probability on the serving side,
+    # trace_interval_steps the every-Nth-step cadence on the training side
+    "trace": False,
+    "trace_sample": 0.01,
+    "trace_interval_steps": 50,
+    # crash flight recorder (obs/flightrec.py): events + spans + registry
+    # snapshot dumped on unhandled exception / SIGUSR2 / fatal guard /
+    # serve wedge; armed whenever the plane is on (enabled or trace)
+    "flight_recorder": True,
 }
 
 # peak dense bf16 FLOP/s by TPU generation (public figures; bench.py
@@ -137,6 +148,16 @@ def resolve_telemetry(config: Dict[str, Any]) -> Dict[str, Any]:
             "Telemetry.http_host must be a non-empty bind address, got "
             f"{out['http_host']!r}"
         )
+    if not (0.0 <= float(out["trace_sample"]) <= 1.0):
+        raise ValueError(
+            "Telemetry.trace_sample must be a probability in [0, 1], got "
+            f"{out['trace_sample']!r}"
+        )
+    if int(out["trace_interval_steps"]) < 1:
+        raise ValueError(
+            "Telemetry.trace_interval_steps must be >= 1, got "
+            f"{out['trace_interval_steps']!r}"
+        )
     return out
 
 
@@ -173,15 +194,41 @@ class MetricsStream:
         self.path = os.path.join(run_dir, "metrics.jsonl")
         self._fh = None
         self._flushed_at = 0.0
+        # HPO trial labeling (hpo.py run_hpo exports HYDRAGNN_TRIAL_ID per
+        # trial): every record of a worker's stream carries its trial id,
+        # so a parent study can attribute per-trial signals after the fact
+        trial = os.getenv("HYDRAGNN_TRIAL_ID")
+        self._trial: Optional[Any] = None
+        if trial is not None:
+            try:
+                self._trial = int(trial)
+            except ValueError:
+                self._trial = trial
         if rank0:
             os.makedirs(run_dir, exist_ok=True)
             self._fh = open(self.path, "a")
+            # abnormal-exit guarantee: an unhandled exception (or a signal
+            # handler exiting via sys.exit) still flushes the buffered tail
+            # of the stream — without this a crash truncates the final
+            # telemetry window (the 1 Hz flush limiter keeps it in memory)
+            import atexit
+
+            atexit.register(self._atexit_flush)
+
+    def _atexit_flush(self) -> None:
+        try:
+            if self._fh is not None:
+                self._fh.flush()
+        except Exception:
+            pass
 
     def write(self, kind: str, record: Dict[str, Any]) -> None:
         if self._fh is None:
             return
         line = {"v": SCHEMA_VERSION, "ts": round(time.time(), 3),
                 "kind": kind, **record}
+        if self._trial is not None:
+            line["trial"] = self._trial
         try:
             self._fh.write(json.dumps(line) + "\n")
             # flush ~1/s, not per record: the file flush is one of the two
@@ -211,6 +258,12 @@ class MetricsStream:
             except OSError:
                 pass
             self._fh = None
+        try:
+            import atexit
+
+            atexit.unregister(self._atexit_flush)
+        except Exception:
+            pass
 
 
 class ProfileTrigger:

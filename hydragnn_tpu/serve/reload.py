@@ -75,6 +75,7 @@ class CheckpointWatcher:
             )
         except Exception as e:  # noqa: BLE001 — keep serving current weights
             self.rejected += 1
+            self._emit_event("reject", entry, detail=f"{type(e).__name__}: {e}")
             warnings.warn(
                 f"hot reload: candidate {entry!r} of run {self.log_name!r} "
                 f"failed to restore ({type(e).__name__}: {e}); keeping the "
@@ -88,6 +89,9 @@ class CheckpointWatcher:
             # pointer names a corrupt file. Installing the older file it
             # found instead would be a silent downgrade — keep current.
             self.rejected += 1
+            self._emit_event(
+                "reject", entry, detail=f"walk-back restored {loaded_from!r}"
+            )
             warnings.warn(
                 f"hot reload: candidate {entry!r} failed verification (the "
                 f"restore chain fell back to {loaded_from!r}); keeping the "
@@ -98,7 +102,27 @@ class CheckpointWatcher:
             return "rejected"
         self.server._install_state(state, entry)
         self.installed += 1
+        self._emit_event("swap", entry)
         return "installed"
+
+    def _emit_event(self, outcome: str, entry: str, detail: str = "") -> None:
+        """Typed reload incident (obs/events.py) — swap/reject verdicts in
+        the flight-recorder window; never allowed to fail the watcher."""
+        try:
+            from ..obs.events import EV_RELOAD_REJECT, EV_RELOAD_SWAP
+            from ..obs.events import emit as _emit
+
+            kind = EV_RELOAD_SWAP if outcome == "swap" else EV_RELOAD_REJECT
+            attrs = {"candidate": entry, "run": self.log_name}
+            if detail:
+                attrs["detail"] = detail
+            _emit(
+                kind,
+                severity="info" if outcome == "swap" else "warn",
+                **attrs,
+            )
+        except Exception:
+            pass
 
     def _main(self) -> None:
         while not self._stop.is_set():

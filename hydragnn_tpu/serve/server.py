@@ -56,6 +56,15 @@ import numpy as np
 
 from ..data.graph import Graph, SpecLadder, batch_graphs
 from ..data.validate import R_CHANNELS, describe_reason, validate_graph
+from ..obs.events import (
+    EV_DEADLINE,
+    EV_DRAIN,
+    EV_QUEUE_FULL,
+    EV_SHED,
+    EV_WEDGE,
+)
+from ..obs.events import emit as _emit_event
+from ..obs.trace import STATUS_ERROR, STATUS_OK
 from ..utils import faultinject
 from .config import ServeConfig
 from .errors import (
@@ -74,6 +83,15 @@ _TICK_S = 0.02
 _JOIN_TIMEOUT_S = 5.0
 
 
+def _emit_serve_event(kind, severity: str = "warn", trace_id=None, **attrs):
+    """Typed incident record (obs/events.py), exception-proof: an event
+    emission must never fail the request path it describes."""
+    try:
+        _emit_event(kind, severity=severity, trace_id=trace_id, **attrs)
+    except Exception:
+        pass
+
+
 class PredictionHandle:
     """Client-side handle for one submitted request. ``result()`` blocks for
     the outcome and re-raises the request's typed error; ``error()`` returns
@@ -82,7 +100,7 @@ class PredictionHandle:
 
     __slots__ = (
         "request_id", "deadline", "submitted_at", "done_at", "_event",
-        "_result", "_error",
+        "_result", "_error", "trace",
     )
 
     def __init__(self, request_id: int, deadline: float):
@@ -97,6 +115,9 @@ class PredictionHandle:
         self._event = threading.Event()
         self._result: Optional[Dict[str, np.ndarray]] = None
         self._error: Optional[RequestError] = None
+        # head-sampled tracing (obs/trace.py): the open serve/request root
+        # span of this request's trace, or None (unsampled/no tracer)
+        self.trace = None
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -227,9 +248,18 @@ class GraphServer:
         sort_edges: bool = False,
         log_name: str = "serve",
         checkpoint_label: Optional[str] = None,
+        tracer=None,
+        flight_recorder=None,
     ):
         self.model = model
         self.cfg = serve_config or ServeConfig()
+        # tracing plane (obs/trace.py; docs/OBSERVABILITY.md "Tracing"):
+        # sampled requests get a serve/request trace covering admit ->
+        # queue_wait -> (linked serve/step) -> respond. The server OWNS a
+        # tracer/flight recorder handed to it (api.run_server builds them
+        # from Telemetry.trace*): close() tears them down.
+        self._tracer = tracer
+        self._flight = flight_recorder
         self.ladder = ladder
         self.log_name = log_name
         self.mixed_precision = mixed_precision
@@ -258,6 +288,7 @@ class GraphServer:
             maxsize=max(int(self.cfg.max_queue_requests), 0)
         )
         self._holdover: Optional[_Request] = None
+        self._form_started: Optional[float] = None
         self._submit_seq = itertools.count()
         self._batch_seq = itertools.count()
         self._inflight_graphs = 0
@@ -522,6 +553,10 @@ class GraphServer:
         self._draining.set()
         if self._ready.is_set():
             self._m_ready.set(0)
+        # typed drain record (signal-safe like the gauge write: the event
+        # log's RLock allows same-thread re-entry, and the emit is a deque
+        # append + counter inc)
+        _emit_serve_event(EV_DRAIN, severity="info", queued=self._queue.qsize())
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Initiate + wait for the drain to finish. Returns True when every
@@ -573,6 +608,20 @@ class GraphServer:
             except ValueError:
                 pass
             self._prev_sigterm = None
+        # tracing-plane teardown (the server owns what it was handed)
+        if self._flight is not None:
+            try:
+                self._flight.uninstall()
+            except Exception:
+                pass
+        if self._tracer is not None:
+            from ..obs import trace as _obs_trace
+
+            try:
+                _obs_trace.uninstall(self._tracer)
+                self._tracer.close()
+            except Exception:
+                pass
         self._drained.set()
 
     def __enter__(self) -> "GraphServer":
@@ -592,6 +641,7 @@ class GraphServer:
         error directly (invalid request, queue full, shed, draining/closed);
         an admitted request's later failures are delivered on the handle."""
         idx = next(self._submit_seq)
+        t_admit_wall = time.time()
         self._bump("submitted")
         # chaos hook: a slow client holding the admission door (no-op unarmed)
         faultinject.maybe_slow_client(idx)
@@ -639,6 +689,12 @@ class GraphServer:
             projected = backlog * self._per_graph_s
             if projected > self.cfg.slo_p99_s:
                 self._bump("shed")
+                _emit_serve_event(
+                    EV_SHED,
+                    request_id=idx,
+                    projected_wait_s=round(projected, 6),
+                    slo_s=self.cfg.slo_p99_s,
+                )
                 raise SheddedError(
                     f"request {idx} shed: projected queue wait "
                     f"{projected:.3f}s exceeds the p99 SLO "
@@ -653,10 +709,35 @@ class GraphServer:
             time.monotonic() + float(deadline_s) if deadline_s else float("inf")
         )
         handle = PredictionHandle(idx, deadline)
+        # head-sampling decision at the trace root, BEFORE the enqueue: the
+        # serve loop could dequeue (and look for the trace context) the
+        # instant the request lands in the queue
+        if self._tracer is not None and self._tracer.sample_request():
+            # backdated to submit ENTRY: the root's duration is the full
+            # admission-to-outcome latency, and the admit child nests
+            # inside it temporally
+            root = self._tracer.begin("serve/request", start_unix=t_admit_wall)
+            root.set_attribute("request_id", idx)
+            handle.trace = root
+            self._tracer.emit_completed(
+                "serve/admit",
+                t_admit_wall,
+                time.time() - t_admit_wall,
+                parent=root,
+            )
         try:
             self._queue.put_nowait(_Request(g, handle))
         except queue.Full:
             self._bump("queue_full")
+            _emit_serve_event(
+                EV_QUEUE_FULL,
+                trace_id=(
+                    handle.trace.trace_id if handle.trace is not None else None
+                ),
+                request_id=idx,
+                bound=self.cfg.max_queue_requests,
+            )
+            self._end_request_trace(handle, error="queue_full")
             raise QueueFullError(
                 f"request {idx} rejected: admission queue is at its bound "
                 f"({self.cfg.max_queue_requests} requests)",
@@ -717,6 +798,18 @@ class GraphServer:
                     return None
             if time.monotonic() > req.handle.deadline:
                 self._bump("deadline_expired")
+                _emit_serve_event(
+                    EV_DEADLINE,
+                    trace_id=(
+                        req.handle.trace.trace_id
+                        if req.handle.trace is not None
+                        else None
+                    ),
+                    request_id=req.handle.request_id,
+                    waited_s=round(
+                        time.perf_counter() - req.handle.submitted_at, 6
+                    ),
+                )
                 self._fail_request(
                     req.handle,
                     DeadlineExceededError(
@@ -725,6 +818,16 @@ class GraphServer:
                     ),
                 )
                 continue
+            if req.handle.trace is not None:
+                # queue-wait span, retroactive at dequeue: THE latency
+                # explainer under pressure (admission -> this dequeue)
+                wait = time.perf_counter() - req.handle.submitted_at
+                self._tracer.emit_completed(
+                    "serve/queue_wait",
+                    time.time() - wait,
+                    wait,
+                    parent=req.handle.trace,
+                )
             return req
         return None
 
@@ -736,6 +839,9 @@ class GraphServer:
         first = self._take_request(timeout=0.0)
         if first is None:
             return None
+        # batch-formation clock starts at the leading request's dequeue
+        # (the serve/batch_form span; idle waiting before it is queue time)
+        self._form_started = time.perf_counter()
         reqs = [first]
         n = first.graph.num_nodes
         e = first.graph.num_edges
@@ -785,9 +891,21 @@ class GraphServer:
             batch_index = next(self._batch_seq)
             state = self._state
             graphs = [r.graph for r in reqs]
+            step_span = self._begin_step_span(reqs, batch_index)
             t0 = time.perf_counter()
             try:
                 spec = self.ladder.select_for(graphs)
+                if step_span is not None:
+                    sel_dt = time.perf_counter() - t0
+                    self._tracer.emit_completed(
+                        "serve/bucket_select",
+                        time.time() - sel_dt,
+                        sel_dt,
+                        parent=step_span,
+                        attributes={
+                            "level": f"{spec.n_nodes}n/{spec.n_edges}e"
+                        },
+                    )
                 batch = batch_graphs(graphs, spec, sort_edges=self.sort_edges)
 
                 def step(_state=state, _batch=batch, _bi=batch_index):
@@ -795,9 +913,28 @@ class GraphServer:
                     faultinject.maybe_serve_wedge(_bi)
                     return jax.device_get(self._predict_fn(_state, _batch))
 
+                t_dev = time.perf_counter()
                 outputs = self._runner.run(step, self.cfg.step_timeout_s)
+                if step_span is not None:
+                    dev_dt = time.perf_counter() - t_dev
+                    self._tracer.emit_completed(
+                        "serve/device_step",
+                        time.time() - dev_dt,
+                        dev_dt,
+                        parent=step_span,
+                    )
             except _StepTimeout:
                 self._bump("wedged_batches")
+                _emit_serve_event(
+                    EV_WEDGE,
+                    severity="error",
+                    trace_id=(
+                        step_span.trace_id if step_span is not None else None
+                    ),
+                    batch_index=batch_index,
+                    graphs=len(reqs),
+                    step_timeout_s=self.cfg.step_timeout_s,
+                )
                 # the wedged runner thread is abandoned (daemon); recycle
                 self._runner = _StepRunner()
                 for r in reqs:
@@ -809,6 +946,11 @@ class GraphServer:
                             "batch was abandoned and the step runner recycled"
                         )
                     )
+                self._finish_step_span(step_span, error="wedged_step")
+                # black-box dump: a wedged device step is a flight-recorder
+                # trigger point — the dump carries the wedge event (with its
+                # trace_id), the abandoned batch's spans, and the registry
+                self._flight_dump("serve_wedge")
                 self._inflight_graphs = 0
                 continue
             except Exception as e:  # noqa: BLE001 — batch-level failure
@@ -821,12 +963,25 @@ class GraphServer:
                             f"{type(e).__name__}: {e}"
                         ),
                     )
+                self._finish_step_span(
+                    step_span, error=f"{type(e).__name__}: {e}"
+                )
                 self._inflight_graphs = 0
                 continue
             dt = time.perf_counter() - t0
             self._m_batch_lat.observe(dt)
             self._m_queue.set(self._queue.qsize())
+            t_resp = time.perf_counter()
             self._deliver(reqs, batch, outputs)
+            if step_span is not None:
+                resp_dt = time.perf_counter() - t_resp
+                self._tracer.emit_completed(
+                    "serve/respond",
+                    time.time() - resp_dt,
+                    resp_dt,
+                    parent=step_span,
+                )
+            self._finish_step_span(step_span)
             self._bump("batches")
             self._bump("completed", len(reqs))
             # EMA service-time estimate drives the shed projection
@@ -860,6 +1015,79 @@ class GraphServer:
             self._m_req_lat.observe(
                 r.handle.done_at - r.handle.submitted_at, outcome="ok"
             )
+            self._end_request_trace(r.handle)
+
+    # -- tracing helpers -----------------------------------------------------
+
+    def _begin_step_span(self, reqs: List[_Request], batch_index: int):
+        """Open the shared device-step span for a batch holding sampled
+        requests: the span lives in the LEAD sampled request's trace and is
+        cross-linked with every other sampled request in the batch (OTLP
+        links), so one trace explains the whole co-batched step. Includes
+        the retroactive serve/batch_form child (lead dequeue -> now)."""
+        if self._tracer is None:
+            return None
+        sampled = [r.handle.trace for r in reqs if r.handle.trace is not None]
+        if not sampled:
+            return None
+        sp = self._tracer.begin("serve/step", parent=sampled[0])
+        sp.set_attribute("batch_index", batch_index)
+        sp.set_attribute("graphs", len(reqs))
+        for other in sampled[1:]:
+            sp.add_link(other.trace_id, other.span_id)
+            other.add_link(sp.trace_id, sp.span_id)
+        if self._form_started is not None:
+            form_dt = time.perf_counter() - self._form_started
+            self._tracer.emit_completed(
+                "serve/batch_form",
+                time.time() - form_dt,
+                form_dt,
+                parent=sp,
+            )
+        return sp
+
+    def _finish_step_span(self, span, error: Optional[str] = None) -> None:
+        if span is None:
+            return
+        try:
+            span.set_status(
+                STATUS_ERROR if error is not None else STATUS_OK,
+                error or "",
+            )
+            self._tracer.finish(span)
+        except Exception:
+            pass  # tracing must never fail the serve loop
+
+    def _end_request_trace(
+        self, handle: PredictionHandle, error: Optional[str] = None
+    ) -> None:
+        """Close a sampled request's root span with its outcome; the span's
+        duration IS the request's admission-to-outcome latency."""
+        root = handle.trace
+        if root is None:
+            return
+        handle.trace = None
+        try:
+            root.set_status(
+                STATUS_ERROR if error is not None else STATUS_OK,
+                error or "",
+            )
+            self._tracer.finish(root)
+        except Exception:
+            pass
+
+    def _flight_dump(self, reason: str) -> None:
+        """Dump the black box (the server's own recorder when it was handed
+        one, else whatever recorder is process-active)."""
+        try:
+            if self._flight is not None:
+                self._flight.dump(reason)
+            else:
+                from ..obs import flightrec as _flightrec
+
+                _flightrec.trigger(reason)
+        except Exception:
+            pass
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -871,6 +1099,9 @@ class GraphServer:
         handle._fail(err)
         self._m_req_lat.observe(
             handle.done_at - handle.submitted_at, outcome="error"
+        )
+        self._end_request_trace(
+            handle, error=getattr(err, "code", type(err).__name__)
         )
 
     def _fail_queued(self, err: RequestError) -> None:

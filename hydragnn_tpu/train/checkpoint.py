@@ -112,8 +112,10 @@ def _sha256_path(fname: str) -> str:
 
 def _observe_duration(op: str, t0: float) -> None:
     """Publish one checkpoint write/restore duration into the telemetry
-    registry (obs/registry.py; docs/OBSERVABILITY.md). Observability only:
-    never allowed to fail a save/restore."""
+    registry, the span plane, and the event log (obs/;
+    docs/OBSERVABILITY.md). Observability only: never allowed to fail a
+    save/restore."""
+    dt = time.perf_counter() - t0
     try:
         from ..obs.registry import registry
 
@@ -121,7 +123,21 @@ def _observe_duration(op: str, t0: float) -> None:
             "hydragnn_checkpoint_seconds",
             "Checkpoint write/restore wall time",
             labelnames=("op",),
-        ).observe(time.perf_counter() - t0, op=op)
+        ).observe(dt, op=op)
+    except Exception:
+        pass
+    try:
+        # span under the active tracer (a checkpoint inside a sampled step/
+        # epoch span nests; otherwise it is its own single-span trace), and
+        # a write event for the flight-recorder window
+        from ..obs import trace as _obs_trace
+        from ..obs.events import EV_CKPT_WRITE, emit as _emit_event
+
+        _obs_trace.note_completed(
+            f"train/checkpoint_{op}", dt, attributes={"op": op}
+        )
+        if op == "write":
+            _emit_event(EV_CKPT_WRITE, seconds=round(dt, 6))
     except Exception:
         pass
 

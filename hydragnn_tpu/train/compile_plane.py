@@ -93,6 +93,17 @@ def _on_event(name: str, **kw) -> None:
     elif name == "/jax/compilation_cache/cache_misses":
         with _METRICS_LOCK:
             _METRICS["cache_misses"] += 1
+            misses = _METRICS["cache_misses"]
+        # a miss in steady state is a full XLA compile paid — record it as
+        # a typed incident (obs/events.py; misses are rare by design, so
+        # the emission cost is irrelevant)
+        try:
+            from ..obs.events import EV_CACHE_MISS
+            from ..obs.events import emit as _emit_event
+
+            _emit_event(EV_CACHE_MISS, severity="warn", total=misses)
+        except Exception:
+            pass
 
 
 def _on_duration(name: str, secs: float, **kw) -> None:
@@ -325,6 +336,22 @@ class _TraceSentinel:
             msg = f"{msg} [violation #{len(self._violations) + 1}]"
             self._violations.append(msg)
             policy = self._policy
+            n_violations = len(self._violations)
+        # structured incident record (obs/events.py) with the active trace
+        # context — a violation inside a sampled serving request carries the
+        # request's trace_id into the flight-recorder window
+        try:
+            from ..obs.events import EV_RETRACE_VIOLATION
+            from ..obs.events import emit as _emit_event
+
+            _emit_event(
+                EV_RETRACE_VIOLATION,
+                severity="error" if policy == "error" else "warn",
+                builder=name,
+                violation=n_violations,
+            )
+        except Exception:
+            pass
         if policy == "error":
             raise RetraceError(msg)
         warnings.warn(msg, RuntimeWarning, stacklevel=3)

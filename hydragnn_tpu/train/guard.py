@@ -164,11 +164,36 @@ class NonFinitePolicy:
             f"step(s) skipped by the train-step guard "
             f"(total {skipped}, {consec} consecutive at epoch end)"
         )
+        # structured incident record (obs/events.py): the epoch's skip tally
+        # with the active trace context attached (the loop opens a
+        # train/guard_verdict span around this call when tracing is on)
+        from ..obs.events import EV_GUARD_FATAL, EV_GUARD_SKIP
+        from ..obs.events import emit as _emit_event
+
+        _emit_event(
+            EV_GUARD_SKIP,
+            severity="warn",
+            epoch=epoch,
+            new_skips=new_skips,
+            total=skipped,
+            consecutive=consec,
+            policy=self.policy,
+        )
         if self.policy == "error":
-            raise RuntimeError(
+            err = RuntimeError(
                 msg + "; Training.non_finite_policy is 'error'. Inspect the "
                 "data/LR, or set 'warn_skip'/'rollback' to ride through."
             )
+            # black-box dump BEFORE raising: the fatal guard verdict is one
+            # of the flight recorder's trigger points — the dump carries
+            # this epoch's guard_skip/guard_fatal events + registry snapshot
+            _emit_event(
+                EV_GUARD_FATAL, severity="fatal", epoch=epoch, total=skipped
+            )
+            from ..obs import flightrec as _flightrec
+
+            _flightrec.trigger("fatal_guard", exc=err)
+            raise err
         print(msg, file=sys.stderr)
         if self.policy != "rollback":
             return state
@@ -193,6 +218,16 @@ class NonFinitePolicy:
                 "checkpoint exists to roll back to."
             )
         state = self.restore_fn(state)
+        from ..obs.events import EV_GUARD_ROLLBACK
+        from ..obs.events import emit as _emit_rollback
+
+        _emit_rollback(
+            EV_GUARD_ROLLBACK,
+            severity="error",
+            epoch=epoch,
+            rollback=self.rollbacks_done,
+            max_rollbacks=self.max_rollbacks,
+        )
         # COMPOUND the backoff across rollbacks: sustained divergence keeps
         # restoring the SAME checkpoint (BestCheckpoint only writes on val
         # improvement), so a flat factor would retry the identical LR until

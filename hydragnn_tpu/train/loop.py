@@ -270,7 +270,7 @@ def _maybe_device_prefetch(iterator):
 
 
 def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
-                telemetry=None):
+                telemetry=None, tracer=None):
     """One training epoch. Returns ``(state, tot, tasks, rng, cursor)``:
     ``cursor`` is None when the epoch completed, or the next-batch offset
     (loader-absolute) when a SIGTERM arrived between steps — the mid-epoch
@@ -283,7 +283,12 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
     ``telemetry`` (obs/telemetry.StepTelemetry, or None) receives every
     step's batch + host dispatch time — under async dispatch the queue
     throttles the host to the device rate, so the window means it
-    publishes converge to device step time without per-step syncs."""
+    publishes converge to device step time without per-step syncs.
+    ``tracer`` (obs/trace.Tracer, or None) emits one span tree per
+    every-Nth sampled step: a ``train/step`` root with retroactive
+    ``train/host_batch_build`` (host batching + validation + H2D staging,
+    the ``dataload`` region) and ``train/device_dispatch`` children —
+    unsampled steps pay one ``is not None`` check."""
     from ..utils import preemption
     from ..utils import tracer as tr
 
@@ -305,6 +310,7 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
         # dataload span covers host batching + H2D staging (the reference's
         # per-step data.to(device), train_validate_test.py:506-514; here the
         # jitted step overlaps with the next host batch via async dispatch)
+        t_build = time.perf_counter()
         tr.start("dataload")
         try:
             batch = next(it)
@@ -312,9 +318,20 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
             tr.stop("dataload")
             break
         tr.stop("dataload")
+        build_dt = time.perf_counter() - t_build
         consumed += 1
         if i < start_batch:
             continue  # fast-forward (mid-epoch resume on a generic loader)
+        sp = None
+        if tracer is not None and tracer.sample_step():
+            sp = tracer.begin("train/step")
+            sp.set_attribute("batch_index", offset + consumed - 1)
+            tracer.emit_completed(
+                "train/host_batch_build",
+                time.time() - build_dt,
+                build_dt,
+                parent=sp,
+            )
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
         t_step = time.perf_counter()
@@ -324,6 +341,17 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
         n = int(np.asarray(batch.graph_mask).sum())
         tr.stop("train_step")
         entries.append((tot, tasks, n))
+        if sp is not None:
+            dispatch_dt = time.perf_counter() - t_step
+            tracer.emit_completed(
+                "train/device_dispatch",
+                time.time() - dispatch_dt,
+                dispatch_dt,
+                parent=sp,
+                attributes={"real_graphs": n},
+            )
+            sp.set_attribute("real_graphs", n)
+            tracer.finish(sp)
         if telemetry is not None:
             telemetry.on_step(
                 batch, time.perf_counter() - t_step, real_graphs=n
@@ -494,9 +522,39 @@ def train_validate_test(
     # versioned metrics.jsonl stream, an optional /metrics endpoint, and
     # the on-demand profiling trigger. None when disabled: the loop then
     # pays one `is not None` check per step and nothing else.
-    from ..obs.telemetry import StepTelemetry
+    from ..obs.telemetry import StepTelemetry, resolve_telemetry
 
-    telemetry = StepTelemetry.from_config(config, log_name, writer=writer)
+    obs_settings = resolve_telemetry(config)
+    telemetry = (
+        StepTelemetry(obs_settings, log_name, writer=writer)
+        if obs_settings["enabled"]
+        else None
+    )
+    run_dir = os.path.join("./logs", log_name)
+    # tracing plane (obs/trace.py; docs/OBSERVABILITY.md "Tracing"): spans
+    # for every trace_interval_steps-th step to logs/<run>/trace.jsonl,
+    # with the region timers (dataload/train_step/...) folded in as child
+    # spans of whatever sampled span is open
+    tracer = None
+    if obs_settings["trace"]:
+        from ..obs import trace as obs_trace
+
+        tracer = obs_trace.Tracer(
+            run_dir,
+            sample=float(obs_settings["trace_sample"]),
+            every_n_steps=int(obs_settings["trace_interval_steps"]),
+        )
+        obs_trace.install(tracer)
+    # crash flight recorder (obs/flightrec.py): armed whenever the plane is
+    # on — unhandled exception / SIGUSR2 / fatal guard policy dump the last
+    # events + spans + a registry snapshot to logs/<run>/flightrec/
+    flight = None
+    if obs_settings["flight_recorder"] and (
+        obs_settings["enabled"] or obs_settings["trace"]
+    ):
+        from ..obs.flightrec import FlightRecorder
+
+        flight = FlightRecorder(run_dir, tracer=tracer).install()
 
     # compile plane (train/compile_plane.py): AOT warm-up of every
     # (train, eval) x pad-bucket specialization against the persistent
@@ -585,7 +643,8 @@ def train_validate_test(
             train_loader.set_epoch(epoch)
             with tr.timer("train"):
                 state, tr_loss, tr_tasks, rng, cursor = train_epoch(
-                    train_loader, step_fn, state, rng, telemetry=telemetry
+                    train_loader, step_fn, state, rng, telemetry=telemetry,
+                    tracer=tracer,
                 )
             hist["train"].append(tr_loss)
             # data-plane skip tally (data/validate.py): whenever the run's
@@ -698,7 +757,14 @@ def train_validate_test(
             # non-finite-step policy: warn/raise/rollback BEFORE val/test so
             # a rollback epoch evaluates the restored state, not a stale one
             rollbacks_before = nf_policy.rollbacks_done
-            state = nf_policy.after_epoch(state, epoch)
+            if tracer is not None:
+                # every epoch's guard verdict is traced (epochs are rare;
+                # the guard's skip/rollback/fatal events attach to this
+                # span's trace_id, so a rollback post-mortem has its anchor)
+                with tracer.span("train/guard_verdict", epoch=epoch):
+                    state = nf_policy.after_epoch(state, epoch)
+            else:
+                state = nf_policy.after_epoch(state, epoch)
             if nf_policy.rollbacks_done > rollbacks_before:
                 # the warmup ramp below recomputes the LR from base_lr every
                 # warmup epoch — scale the base too, or the next ramp line
@@ -767,6 +833,16 @@ def train_validate_test(
                 if verbosity > 0:
                     print(f"[{log_name}] SIGTERM: checkpointed at epoch {epoch}, stopping")
                 break
+    except BaseException as e:
+        # capture the crash while the black box is still armed: the
+        # teardown below uninstalls the excepthook before the exception
+        # could reach it (KeyboardInterrupt is a shutdown, not a crash)
+        if flight is not None and not isinstance(e, KeyboardInterrupt):
+            try:
+                flight.dump("train_exception", exc=e)
+            except Exception:  # noqa: BLE001 — never mask the real error
+                pass
+        raise
     finally:
         profiler.close()
         preemption.uninstall()
@@ -831,6 +907,23 @@ def train_validate_test(
                     telemetry.close()
                 except Exception:  # noqa: BLE001 — same contract
                     pass
+        # tracing-plane teardown LAST: the flight recorder must still be
+        # armed while the telemetry teardown above could raise, and the
+        # tracer's close flushes the span tail (abnormal exits are covered
+        # by its atexit hook + the recorder's excepthook)
+        if flight is not None:
+            try:
+                flight.uninstall()
+            except Exception:  # noqa: BLE001 — observability teardown
+                pass
+        if tracer is not None:
+            from ..obs import trace as obs_trace
+
+            try:
+                obs_trace.uninstall(tracer)
+                tracer.close()
+            except Exception:  # noqa: BLE001 — same contract
+                pass
     if best_state is not None:
         state = best_state
     return state, hist

@@ -22,6 +22,10 @@ from typing import Dict, Optional
 
 _enabled = False
 _regions: Dict[str, Dict[str, float]] = {}
+# span-plane bridge (obs/trace.py), resolved lazily once: a region closing
+# while a sampled span is open on this thread is emitted as a child span,
+# so the pre-existing region instrumentation lands in the trace tree
+_obs_trace = None
 # per-name stacks so re-entrant start(name) nests instead of overwriting
 _open: Dict[str, list] = {}
 # one global LIFO of (name, TraceAnnotation): xprof annotations are scoped
@@ -123,6 +127,27 @@ def stop(name: str, sync: Optional[bool] = None) -> None:
     rec["total"] += dt
     rec["min"] = min(rec["min"], dt)
     rec["max"] = max(rec["max"], dt)
+    _note_span(name, dt)
+
+
+def _note_span(name: str, dt: float) -> None:
+    """Forward a closed region to the span plane (no-op without an active
+    tracer + open span — one attribute read on the unsampled hot path)."""
+    global _obs_trace
+    if _obs_trace is None:
+        try:
+            from ..obs import trace as _t
+
+            _obs_trace = _t
+        except Exception:
+            _obs_trace = False
+            return
+    if _obs_trace is False:
+        return
+    try:
+        _obs_trace.note_region(name, dt)
+    except Exception:
+        pass  # tracing must never fail the timed code
 
 
 @contextlib.contextmanager

@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Automated bench regression gate (docs/OBSERVABILITY.md "Bench gate").
+
+Five rounds of ``BENCH_r*.json`` artifacts sit in the repo root with no
+machine-checked contract between them — a PR that halves throughput would
+sail through CI as long as the bench still *ran*. This gate seeds the bench
+trajectory with one:
+
+1. **bench cells** — the newest valid round's parsed cells are diffed
+   against the most recent prior round that carried the same cell
+   (higher-is-better keys: throughput ``value``, ``mfu``, ``vs_baseline``,
+   any ``*graphs_per_sec*`` auxiliary). A relative drop beyond
+   ``--threshold`` (default 8%) fails the gate. The primary
+   ``value``/``mfu``/``vs_baseline`` cells are namespaced by their
+   ``metric`` string, so a round that changed *what* it measures never
+   cross-compares against a different metric; auxiliary throughput keys
+   (``synthetic_pna_graphs_per_sec``) compare by name across rounds.
+   Rounds with ``rc != 0`` or an ``error`` cell are skipped — a
+   hardware-unreachable round is not a baseline.
+
+2. **trace stage timings** (opt-in: ``--trace``) — per-span-name p50/p99
+   durations derived from a ``trace.jsonl`` (obs/trace.py) are compared
+   against a committed baseline JSON (``--trace-baseline``; write one with
+   ``--write-trace-baseline``). A stage whose p50 or p99 exceeds
+   baseline × (1 + ``--trace-threshold``) fails the gate.
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
+``--strict`` additionally fails (exit 1) when there is nothing comparable
+(fewer than two valid rounds / empty cell intersection), so a wiring bug
+cannot masquerade as a pass.
+
+Wired into ``run-scripts/ci.sh`` against the committed rounds; exercised
+(pass AND synthetic-degradation fail) by ``run-scripts/trace_smoke.py``
+and ``tests/test_trace.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+# higher-is-better cell keys gated by default; everything else in a parsed
+# dict (train_loss, flops_per_graph, booleans) is informational
+PRIMARY_KEYS = ("value", "mfu", "vs_baseline")
+AUX_KEY_RE = re.compile(r"graphs_per_sec")
+
+
+def _is_number(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool) and math.isfinite(v)
+
+
+def load_rounds(repo: str) -> List[Tuple[int, str, Dict[str, Any]]]:
+    """All valid bench rounds, ascending by round number. A round is valid
+    when it parses, exited 0, and its parsed cell carries no error."""
+    out: List[Tuple[int, str, Dict[str, Any]]] = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = _ROUND_RE.search(os.path.basename(path))
+        if not m:
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        if int(doc.get("rc", 0)) != 0 or "error" in parsed:
+            continue
+        out.append((int(m.group(1)), path, parsed))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+def cells_of(parsed: Dict[str, Any]) -> Dict[str, float]:
+    """Gated numeric cells of one round, keyed so only like compares with
+    like: primary keys namespaced by the metric string, auxiliary
+    throughput keys by name."""
+    metric = str(parsed.get("metric", ""))
+    cells: Dict[str, float] = {}
+    for key, val in parsed.items():
+        if not _is_number(val) or val <= 0:
+            continue  # a zeroed cell is a failed measurement, not a baseline
+        if key in PRIMARY_KEYS:
+            cells[f"{metric} :: {key}"] = float(val)
+        elif AUX_KEY_RE.search(key):
+            cells[key] = float(val)
+    return cells
+
+
+def gate_bench(
+    rounds: List[Tuple[int, str, Dict[str, Any]]],
+    threshold: float,
+) -> Tuple[List[str], List[str]]:
+    """(failures, report lines). The newest round's cells vs the most
+    recent prior occurrence of each cell."""
+    report: List[str] = []
+    if len(rounds) < 2:
+        report.append(
+            f"bench_gate: {len(rounds)} valid round(s) — nothing to compare"
+        )
+        return [], report
+    cand_n, cand_path, cand_parsed = rounds[-1]
+    baseline: Dict[str, Tuple[int, float]] = {}
+    for n, _, parsed in rounds[:-1]:
+        for key, val in cells_of(parsed).items():
+            baseline[key] = (n, val)  # later rounds override earlier
+    failures: List[str] = []
+    compared = 0
+    for key, val in cells_of(cand_parsed).items():
+        base = baseline.get(key)
+        if base is None:
+            continue
+        base_n, base_val = base
+        compared += 1
+        drop = (base_val - val) / base_val
+        line = (
+            f"bench_gate: r{cand_n:02d} {key!r} = {val:g} vs "
+            f"r{base_n:02d} {base_val:g} ({-drop:+.1%})"
+        )
+        if drop > threshold:
+            failures.append(
+                line + f" — REGRESSION beyond the {threshold:.0%} threshold"
+            )
+        else:
+            report.append(line + " ok")
+    if compared == 0:
+        report.append(
+            f"bench_gate: no cell of {os.path.basename(cand_path)} matches "
+            "any prior round — nothing compared"
+        )
+    return failures, report
+
+
+# ---------------------------------------------------------------------------
+# trace-derived stage timings
+# ---------------------------------------------------------------------------
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+def trace_stage_stats(trace_path: str) -> Dict[str, Dict[str, float]]:
+    """Per-span-name duration stats from a trace.jsonl: p50/p99 in
+    milliseconds plus the sample count."""
+    durations: Dict[str, List[float]] = {}
+    with open(trace_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            try:
+                dur_ms = (
+                    int(rec["endTimeUnixNano"]) - int(rec["startTimeUnixNano"])
+                ) / 1e6
+            except (KeyError, ValueError):
+                continue
+            durations.setdefault(str(rec.get("name", "?")), []).append(dur_ms)
+    out: Dict[str, Dict[str, float]] = {}
+    for name, vals in durations.items():
+        vals.sort()
+        out[name] = {
+            "p50_ms": round(_percentile(vals, 0.50), 4),
+            "p99_ms": round(_percentile(vals, 0.99), 4),
+            "count": len(vals),
+        }
+    return out
+
+
+def gate_trace(
+    stats: Dict[str, Dict[str, float]],
+    baseline: Dict[str, Dict[str, float]],
+    threshold: float,
+) -> Tuple[List[str], List[str]]:
+    failures: List[str] = []
+    report: List[str] = []
+    for name in sorted(set(stats) & set(baseline)):
+        for q in ("p50_ms", "p99_ms"):
+            have = float(stats[name][q])
+            want = float(baseline[name][q])
+            if want <= 0:
+                continue
+            ratio = have / want
+            line = (
+                f"bench_gate[trace]: {name} {q} = {have:.3f}ms vs baseline "
+                f"{want:.3f}ms ({ratio - 1:+.1%})"
+            )
+            if ratio > 1.0 + threshold:
+                failures.append(
+                    line
+                    + f" — REGRESSION beyond the {threshold:.0%} threshold"
+                )
+            else:
+                report.append(line + " ok")
+    if not (set(stats) & set(baseline)):
+        report.append(
+            "bench_gate[trace]: no stage of the trace matches the baseline "
+            "— nothing compared"
+        )
+    return failures, report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    repo_default = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo", default=repo_default,
+                    help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.08,
+                    help="max tolerated relative drop per bench cell")
+    ap.add_argument("--strict", action="store_true",
+                    help="fail when nothing was comparable")
+    ap.add_argument("--trace", default=None,
+                    help="trace.jsonl to gate stage timings from")
+    ap.add_argument("--trace-baseline", default=None,
+                    help="committed JSON baseline of per-stage p50/p99")
+    ap.add_argument("--trace-threshold", type=float, default=0.5,
+                    help="max tolerated relative p50/p99 growth per stage")
+    ap.add_argument("--write-trace-baseline", default=None, metavar="PATH",
+                    help="derive a stage baseline from --trace and write it")
+    args = ap.parse_args(argv)
+
+    failures: List[str] = []
+    compared_something = False
+
+    rounds = load_rounds(args.repo)
+    bench_failures, report = gate_bench(rounds, args.threshold)
+    failures.extend(bench_failures)
+    compared_something |= any(" ok" in l or "REGRESSION" in l for l in report)
+    compared_something |= bool(bench_failures)
+    for line in report:
+        print(line)
+
+    if args.trace is not None:
+        if not os.path.exists(args.trace):
+            print(f"bench_gate: trace file {args.trace!r} not found")
+            return 2
+        stats = trace_stage_stats(args.trace)
+        if args.write_trace_baseline:
+            with open(args.write_trace_baseline, "w") as fh:
+                json.dump(stats, fh, indent=2, sort_keys=True)
+            print(
+                f"bench_gate[trace]: wrote baseline for {len(stats)} "
+                f"stage(s) to {args.write_trace_baseline}"
+            )
+        if args.trace_baseline:
+            try:
+                with open(args.trace_baseline) as fh:
+                    trace_base = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"bench_gate: cannot read trace baseline: {e}")
+                return 2
+            t_failures, t_report = gate_trace(
+                stats, trace_base, args.trace_threshold
+            )
+            failures.extend(t_failures)
+            compared_something |= any(" ok" in l for l in t_report) or bool(
+                t_failures
+            )
+            for line in t_report:
+                print(line)
+
+    for line in failures:
+        print(line, file=sys.stderr)
+    if failures:
+        print(f"bench_gate: FAIL ({len(failures)} regression(s))",
+              file=sys.stderr)
+        return 1
+    if args.strict and not compared_something:
+        print("bench_gate: FAIL (--strict and nothing was comparable)",
+              file=sys.stderr)
+        return 1
+    print("bench_gate: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -52,6 +52,12 @@ python run-scripts/serve_chaos_smoke.py
 echo "== telemetry smoke (metrics.jsonl + /metrics//healthz//readyz on train + serve legs; <=2% overhead A/B) =="
 python run-scripts/telemetry_smoke.py
 
+echo "== tracing smoke (span parentage train+serve, queue-wait latency contract, flight-recorder dump on injected wedge, <=2% tracing overhead A/B, bench-gate self-check) =="
+python run-scripts/trace_smoke.py
+
+echo "== bench regression gate (newest committed round vs prior; BENCH_r05.json) =="
+python run-scripts/bench_gate.py
+
 echo "== BENCH_SERVE cells (p50/p99 latency vs offered load, throughput at SLO, shed rate) =="
 BENCH_SERVE=1 BENCH_SERVE_SECS=2 python bench.py
 

@@ -48,11 +48,12 @@ import hydragnn_tpu
 import numpy as _np
 import hydragnn_tpu.train.loop as _L
 _orig_epoch = _L.train_epoch
-def _traced_epoch(loader, step_fn, state, rng, start_batch=0):
+def _traced_epoch(loader, step_fn, state, rng, start_batch=0, **kw):
+    # forward the loop's keyword surface (telemetry, tracer, ...) untouched
     def stepped(s, b, r):
         print("BATCH %.4f" % float(_np.asarray(b.x).sum()), flush=True)
         return step_fn(s, b, r)
-    return _orig_epoch(loader, stepped, state, rng, start_batch)
+    return _orig_epoch(loader, stepped, state, rng, start_batch, **kw)
 _L.train_epoch = _traced_epoch
 
 cfg = {{

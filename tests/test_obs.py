@@ -105,6 +105,77 @@ def _get(url):
         return e.code, e.read().decode()
 
 
+def pytest_prometheus_concurrent_scrape_under_mutation():
+    """The RLock contract: /metrics scrapes racing registry mutation (new
+    instruments registered, counters inc'd, histograms observed, from
+    several threads) must all succeed with well-formed exposition text —
+    no torn lines, no exceptions."""
+    import threading
+
+    reg = MetricsRegistry()
+    reg.gauge("scrape_up").set(1)
+    srv = TelemetryHTTPServer(reg=reg, port=0)
+    stop = threading.Event()
+    errors = []
+
+    def mutate(tid):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            try:
+                reg.counter("scrape_c_total", labelnames=("t",)).inc(t=tid)
+                reg.histogram("scrape_lat", buckets=(0.1, 1.0)).observe(
+                    0.01 * (i % 7)
+                )
+                reg.gauge(f"scrape_g_{tid}_{i % 5}").set(i)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+                return
+
+    writers = [
+        threading.Thread(target=mutate, args=(t,), daemon=True)
+        for t in range(3)
+    ]
+    for w in writers:
+        w.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        bodies = []
+
+        def scrape():
+            for _ in range(25):
+                code, text = _get(base + "/metrics")
+                if code != 200:
+                    errors.append(AssertionError(f"scrape got {code}"))
+                    return
+                bodies.append(text)
+
+        scrapers = [
+            threading.Thread(target=scrape, daemon=True) for _ in range(4)
+        ]
+        for s in scrapers:
+            s.start()
+        for s in scrapers:
+            s.join(timeout=30)
+        assert not errors, errors
+        assert bodies
+        for text in bodies:
+            assert "scrape_up 1" in text
+            for line in text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                # every sample line is "name[{labels}] value" — a torn
+                # write under concurrent mutation would break this shape
+                assert len(line.rsplit(" ", 1)) == 2, line
+                float(line.rsplit(" ", 1)[1].replace("+Inf", "inf"))
+    finally:
+        stop.set()
+        for w in writers:
+            w.join(timeout=5)
+        srv.close()
+    assert not errors, errors
+
+
 def pytest_http_endpoint_metrics_health_ready():
     reg = MetricsRegistry()
     reg.gauge("up").set(1)
@@ -352,9 +423,27 @@ def pytest_graphserver_endpoint_ready_flip(tmp_path, monkeypatch):
         assert "hydragnn_serve_queue_depth" in text
         assert "hydragnn_serve_batch_latency_seconds_count" in text
         assert "hydragnn_serve_request_latency_seconds_count" in text
-        # a draining server must report not-ready (LB removal contract)
+        # a draining server must report not-ready (LB removal contract),
+        # and /metrics must keep answering THROUGH the drain — operators
+        # watch the drain complete on the scrape surface
+        import threading
+
+        scrape_results = []
+
+        def scrape_through_drain():
+            for _ in range(10):
+                scrape_results.append(_get(base + "/metrics"))
+
+        scraper = threading.Thread(target=scrape_through_drain, daemon=True)
         server.initiate_drain()
+        scraper.start()
         assert _get(base + "/readyz")[0] == 503
+        assert server.drain(timeout=30)
+        scraper.join(timeout=30)
+        assert len(scrape_results) == 10
+        for code, text in scrape_results:
+            assert code == 200
+            assert "hydragnn_serve_ready 0" in text
         assert server.stats()["http_port"] == server.http_port
     finally:
         server.close()

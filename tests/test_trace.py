@@ -1,0 +1,503 @@
+"""Tracing plane (docs/OBSERVABILITY.md): span API + OTLP-shaped JSONL,
+head-based sampling, region-timer unification, train-loop step spans, the
+structured event log, the crash flight recorder, abnormal-exit stream
+flushing, the bench regression gate, and HPO trial labeling."""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.obs import flightrec as obs_flightrec
+from hydragnn_tpu.obs import trace as obs_trace
+from hydragnn_tpu.obs.events import (
+    EV_DATA_SKIP,
+    EV_SHED,
+    EV_WEDGE,
+    EventLog,
+    emit as emit_event,
+    events,
+)
+from hydragnn_tpu.obs.registry import registry
+from hydragnn_tpu.obs.trace import STATUS_ERROR, Tracer
+
+
+def _read_spans(run_dir):
+    with open(os.path.join(run_dir, "trace.jsonl")) as fh:
+        return [json.loads(l) for l in fh if l.strip()]
+
+
+# ---------------------------------------------------------------------------
+# span API
+
+
+def pytest_span_nesting_parentage_and_otlp_shape(tmp_path):
+    t = Tracer(str(tmp_path), rank0=True)
+    with t.span("root", answer=42, ratio=0.5, tag="x", flag=True) as root:
+        with t.span("child"):
+            pass
+        t.emit_completed("retro", 123.0, 0.25, parent=root)
+    sp = t.begin("xthread")  # explicit context: its own trace
+    sp.add_link(root.trace_id, root.span_id)
+    t.finish(sp)
+    # a backdated root (sampling decided after the work began) spans the
+    # DECLARED start: duration covers the pre-begin time too
+    import time as _time
+
+    late = t.begin("backdated", start_unix=_time.time() - 5.0)
+    t.finish(late)
+    t.close()
+
+    recs = {r["name"]: r for r in _read_spans(str(tmp_path))}
+    assert set(recs) == {"root", "child", "retro", "xthread", "backdated"}
+    bd = recs["backdated"]
+    bd_dur = (int(bd["endTimeUnixNano"]) - int(bd["startTimeUnixNano"])) / 1e9
+    assert 5.0 <= bd_dur < 6.0, bd_dur
+    r, c = recs["root"], recs["child"]
+    assert "parentSpanId" not in r and len(r["traceId"]) == 32
+    assert c["parentSpanId"] == r["spanId"] and c["traceId"] == r["traceId"]
+    assert recs["retro"]["parentSpanId"] == r["spanId"]
+    # retro span's nanos reflect the measured (start, duration)
+    assert int(recs["retro"]["endTimeUnixNano"]) - int(
+        recs["retro"]["startTimeUnixNano"]
+    ) == int(0.25e9)
+    # OTLP attribute value mapping: ints as strings, typed values
+    attrs = {a["key"]: a["value"] for a in r["attributes"]}
+    assert attrs["answer"] == {"intValue": "42"}
+    assert attrs["ratio"] == {"doubleValue": 0.5}
+    assert attrs["tag"] == {"stringValue": "x"}
+    assert attrs["flag"] == {"boolValue": True}
+    # cross-trace link
+    assert recs["xthread"]["traceId"] != r["traceId"]
+    assert recs["xthread"]["links"] == [
+        {"traceId": r["traceId"], "spanId": r["spanId"]}
+    ]
+    # every record is schema-versioned
+    assert all(rec["v"] == 1 for rec in recs.values())
+
+
+def pytest_span_error_status_and_ring(tmp_path):
+    t = Tracer(str(tmp_path), ring=2, rank0=True)
+    with pytest.raises(RuntimeError):
+        with t.span("boom"):
+            raise RuntimeError("bad")
+    with t.span("a"):
+        pass
+    with t.span("b"):
+        pass
+    recs = {r["name"]: r for r in _read_spans(str(tmp_path))}
+    assert recs == recs  # file keeps everything...
+    assert recs["boom"]["status"]["code"] == STATUS_ERROR
+    assert "bad" in recs["boom"]["status"]["message"]
+    # ...but the flight-recorder ring holds only the last N
+    assert [r["name"] for r in t.recent()] == ["a", "b"]
+    t.close()
+
+
+def pytest_head_sampling_decisions(tmp_path):
+    t = Tracer(str(tmp_path), sample=0.0, every_n_steps=3, rank0=True)
+    assert not t.sample_request()
+    # every-Nth-step: steps 3 and 6 sample
+    assert [t.sample_step() for _ in range(6)] == [
+        False, False, True, False, False, True
+    ]
+    t.close()
+    t2 = Tracer(str(tmp_path), sample=1.0, every_n_steps=0, rank0=True)
+    assert t2.sample_request() and not t2.sample_step()
+    t2.close()
+
+
+def pytest_region_timer_unification(tmp_path):
+    """utils/tracer.py regions closing inside a sampled span become child
+    spans of it; with no active tracer (or no open span) they are no-ops."""
+    from hydragnn_tpu.utils import tracer as tr
+
+    t = Tracer(str(tmp_path), rank0=True)
+    obs_trace.install(t)
+    tr.reset()
+    tr.enable()
+    try:
+        with tr.timer("orphan_region"):
+            pass  # no open span: not emitted
+        with t.span("step"):
+            with tr.timer("dataload"):
+                pass
+    finally:
+        tr.disable()
+        obs_trace.uninstall(t)
+    t.close()
+    recs = {r["name"]: r for r in _read_spans(str(tmp_path))}
+    assert "orphan_region" not in recs
+    assert recs["dataload"]["parentSpanId"] == recs["step"]["spanId"]
+    # the region accumulator still counted both (unchanged behavior)
+    assert tr.get_regions()["orphan_region"]["count"] == 1
+
+
+def pytest_train_epoch_step_spans(tmp_path):
+    """train_epoch with a tracer emits one train/step root per sampled step
+    with host_batch_build + device_dispatch children."""
+    import jax
+
+    from hydragnn_tpu.data import GraphLoader, deterministic_graph_dataset
+    from hydragnn_tpu.train.loop import train_epoch
+
+    graphs = deterministic_graph_dataset(24, seed=7)
+    loader = GraphLoader(graphs, 6, seed=0, prefetch=0)
+
+    def fake_step(state, batch, rng):
+        return state, 0.0, {}
+
+    t = Tracer(str(tmp_path), every_n_steps=2, rank0=True)
+    train_epoch(loader, fake_step, None, jax.random.PRNGKey(0), tracer=t)
+    t.close()
+    recs = _read_spans(str(tmp_path))
+    roots = [r for r in recs if r["name"] == "train/step"]
+    assert len(roots) == len(loader) // 2, (len(roots), len(loader))
+    for root in roots:
+        kids = {
+            r["name"]
+            for r in recs
+            if r.get("parentSpanId") == root["spanId"]
+            and r["traceId"] == root["traceId"]
+        }
+        assert {"train/host_batch_build", "train/device_dispatch"} <= kids
+
+
+# ---------------------------------------------------------------------------
+# event log
+
+
+def pytest_event_log_ring_counter_and_trace_id(tmp_path):
+    log = EventLog(capacity=3)
+    for i in range(5):
+        log.emit(EV_SHED, severity="warn", request_id=i)
+    snap = log.snapshot()
+    assert [e["request_id"] for e in snap] == [2, 3, 4]  # ring keeps last 3
+    assert all(e["kind"] == EV_SHED and e["severity"] == "warn" for e in snap)
+    assert log.emitted == 5
+
+    # the process-wide log mirrors into the registry counter
+    before = registry().counter(
+        "hydragnn_events_total", labelnames=("kind",)
+    ).value(kind=EV_WEDGE)
+    events().emit(EV_WEDGE, severity="error", batch_index=7)
+    after = registry().counter(
+        "hydragnn_events_total", labelnames=("kind",)
+    ).value(kind=EV_WEDGE)
+    assert after == before + 1
+
+    # active-span trace_id attaches automatically; non-JSON attrs coerce
+    t = Tracer(str(tmp_path), rank0=True)
+    obs_trace.install(t)
+    try:
+        with t.span("incident") as sp:
+            rec = emit_event(EV_DATA_SKIP, reason="nonfinite_features",
+                             detail=ValueError("x"))
+        assert rec["trace_id"] == sp.trace_id
+        assert isinstance(rec["detail"], str)
+    finally:
+        obs_trace.uninstall(t)
+        t.close()
+    rec2 = emit_event(EV_DATA_SKIP, reason="r2")
+    assert "trace_id" not in rec2
+
+
+def pytest_validator_reject_emits_event():
+    from hydragnn_tpu.data import deterministic_graph_dataset
+    from hydragnn_tpu.data.validate import SampleValidator
+
+    graphs = deterministic_graph_dataset(4, seed=3)
+    import dataclasses
+
+    bad = np.array(graphs[0].x, dtype=np.float32, copy=True)
+    bad.flat[0] = np.nan
+    graphs[0] = dataclasses.replace(graphs[0], x=bad)
+    events().clear()
+    v = SampleValidator("warn_skip")
+    kept = v.filter(graphs, source="unit")
+    assert len(kept) == 3
+    skips = [e for e in events().snapshot() if e["kind"] == EV_DATA_SKIP]
+    assert skips and skips[-1]["reason"] == "nonfinite_features"
+    assert skips[-1]["source"] == "unit"
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+
+def pytest_flight_recorder_dump_contents(tmp_path):
+    t = Tracer(str(tmp_path), rank0=True)
+    obs_trace.install(t)
+    try:
+        with t.span("doomed"):
+            emit_event(EV_WEDGE, severity="error", batch_index=3)
+    finally:
+        obs_trace.uninstall(t)
+    rec = obs_flightrec.FlightRecorder(str(tmp_path), tracer=t, max_dumps=2)
+    try:
+        err = RuntimeError("boom")
+        out = rec.dump("unit_reason", exc=err)
+        assert out is not None and os.path.isdir(out)
+        assert os.path.basename(out).endswith("unit_reason")
+        meta = json.load(open(os.path.join(out, "meta.json")))
+        assert meta["reason"] == "unit_reason"
+        assert meta["exception"]["type"] == "RuntimeError"
+        evs = json.load(open(os.path.join(out, "events.json")))
+        assert any(
+            e["kind"] == EV_WEDGE and e.get("trace_id") for e in evs
+        ), evs
+        spans = json.load(open(os.path.join(out, "spans.json")))
+        assert any(s["name"] == "doomed" for s in spans)
+        prom = open(os.path.join(out, "metrics.prom")).read()
+        assert "hydragnn_events_total" in prom
+        # no half-written temp dirs survive a completed dump
+        assert not [
+            d
+            for d in os.listdir(os.path.join(str(tmp_path), "flightrec"))
+            if d.startswith(".tmp")
+        ]
+        # the dump budget bounds a crash loop
+        assert rec.dump("again") is not None
+        assert rec.dump("over_budget") is None
+    finally:
+        t.close()
+
+
+def pytest_flight_recorder_trigger_and_install(tmp_path):
+    rec = obs_flightrec.FlightRecorder(str(tmp_path)).install(
+        signal_hook=False
+    )
+    try:
+        assert obs_flightrec.active() is rec
+        out = obs_flightrec.trigger("via_trigger")
+        assert out is not None and "via_trigger" in out
+    finally:
+        rec.uninstall()
+    assert obs_flightrec.active() is None
+    assert obs_flightrec.trigger("noop") is None
+
+
+# ---------------------------------------------------------------------------
+# abnormal-exit flush (satellite: atexit + SIGTERM drain path)
+
+_CRASH_CHILD = textwrap.dedent(
+    """
+    import os, signal, sys
+    sys.path.insert(0, {repo!r})
+    from hydragnn_tpu.obs.telemetry import MetricsStream
+    from hydragnn_tpu.obs.trace import Tracer
+
+    stream = MetricsStream({run_dir!r}, rank0=True)
+    tracer = Tracer({run_dir!r}, rank0=True)
+    stream.write("step_window", {{"step": 1}})   # first write flushes
+    stream.write("step_window", {{"step": 2}})   # buffered (1 Hz limiter)
+    with tracer.span("last_window"):
+        pass                                     # buffered (1 Hz limiter)
+    mode = sys.argv[1]
+    if mode == "exception":
+        raise RuntimeError("crash without close()")
+    if mode == "sigterm":
+        signal.signal(signal.SIGTERM, lambda *a: sys.exit(1))
+        os.kill(os.getpid(), signal.SIGTERM)
+    """
+)
+
+
+@pytest.mark.parametrize("mode", ["exception", "sigterm"])
+def pytest_abnormal_exit_flushes_streams(tmp_path, mode):
+    """A crash (unhandled exception) or the SIGTERM drain path (handler ->
+    sys.exit) must not truncate the buffered tail of metrics.jsonl or
+    trace.jsonl: the atexit hooks flush what close() never got to."""
+    run_dir = str(tmp_path / "run")
+    os.makedirs(run_dir)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "child.py"
+    script.write_text(_CRASH_CHILD.format(repo=repo, run_dir=run_dir))
+    proc = subprocess.run(
+        [sys.executable, str(script), mode],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode != 0  # it really did die abnormally
+    metrics = [
+        json.loads(l)
+        for l in open(os.path.join(run_dir, "metrics.jsonl"))
+        if l.strip()
+    ]
+    assert [m["step"] for m in metrics] == [1, 2], (metrics, proc.stderr)
+    spans = _read_spans(run_dir)
+    assert [s["name"] for s in spans] == ["last_window"], proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate
+
+
+def _bench_gate():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(repo, "run-scripts", "bench_gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_round(d, n, parsed, rc=0):
+    with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as fh:
+        json.dump({"n": n, "rc": rc, "parsed": parsed}, fh)
+
+
+def pytest_bench_gate_pass_fail_and_skips(tmp_path):
+    bg = _bench_gate()
+    d = str(tmp_path)
+    cell = {"metric": "prod shape", "value": 100.0, "mfu": 0.2,
+            "vs_baseline": 2.0, "train_loss": 1.5}
+    _write_round(d, 1, cell)
+    # an unchanged round passes
+    _write_round(d, 2, dict(cell))
+    assert bg.main(["--repo", d]) == 0
+    # a degraded throughput cell fails
+    _write_round(d, 3, {**cell, "value": 80.0})
+    assert bg.main(["--repo", d]) == 1
+    # within threshold passes again
+    _write_round(d, 4, {**cell, "value": 95.0})
+    assert bg.main(["--repo", d, "--threshold", "0.08"]) == 0
+    # an errored/nonzero-rc round is not a baseline and not a candidate
+    _write_round(d, 5, {**cell, "value": 0.0, "error": "device unreachable"},
+                 rc=2)
+    assert bg.main(["--repo", d]) == 0  # candidate is still r4 vs r1/r2/r3
+    # a renamed metric never cross-compares (nothing comparable != failure
+    # without --strict)
+    d2 = str(tmp_path / "renamed")
+    os.makedirs(d2)
+    _write_round(d2, 1, cell)
+    _write_round(d2, 2, {**cell, "metric": "other shape", "value": 1.0})
+    assert bg.main(["--repo", d2]) == 0
+    assert bg.main(["--repo", d2, "--strict"]) == 1
+    # train_loss (lower-better, ungated) never trips the gate
+    d3 = str(tmp_path / "loss")
+    os.makedirs(d3)
+    _write_round(d3, 1, cell)
+    _write_round(d3, 2, {**cell, "train_loss": 99.0})
+    assert bg.main(["--repo", d3]) == 0
+
+
+def pytest_bench_gate_trace_stage_timings(tmp_path):
+    bg = _bench_gate()
+    t = Tracer(str(tmp_path), rank0=True)
+    for dur in (0.010, 0.011, 0.012, 0.050):
+        t.emit_completed("serve/device_step", 100.0, dur)
+    t.emit_completed("serve/queue_wait", 100.0, 0.001)
+    t.close()
+    trace = os.path.join(str(tmp_path), "trace.jsonl")
+    stats = bg.trace_stage_stats(trace)
+    assert stats["serve/device_step"]["count"] == 4
+    # nearest-rank on [10, 11, 12, 50]: upper median / max
+    assert stats["serve/device_step"]["p50_ms"] == pytest.approx(12.0)
+    assert stats["serve/device_step"]["p99_ms"] == pytest.approx(50.0)
+    base = os.path.join(str(tmp_path), "base.json")
+    d = str(tmp_path / "rounds")
+    os.makedirs(d)
+    assert bg.main(["--repo", d, "--trace", trace,
+                    "--write-trace-baseline", base]) == 0
+    # against its own baseline: pass
+    assert bg.main(["--repo", d, "--trace", trace,
+                    "--trace-baseline", base]) == 0
+    # against a 10x-tighter baseline: fail
+    shrunk = {
+        k: {**v, "p50_ms": v["p50_ms"] / 10, "p99_ms": v["p99_ms"] / 10}
+        for k, v in json.load(open(base)).items()
+    }
+    json.dump(shrunk, open(base, "w"))
+    assert bg.main(["--repo", d, "--trace", trace,
+                    "--trace-baseline", base]) == 1
+
+
+# ---------------------------------------------------------------------------
+# HPO trial labeling (satellite: workers stop hiding their signals)
+
+
+def pytest_hpo_trial_labeling_and_surfacing(tmp_path, monkeypatch):
+    from hydragnn_tpu.hpo import _surface_trial_metrics, run_hpo
+    from hydragnn_tpu.obs.telemetry import MetricsStream
+
+    seen = []
+
+    def objective(config):
+        # the wrapper labels every trial's lifetime with HYDRAGNN_TRIAL_ID
+        tid = os.environ["HYDRAGNN_TRIAL_ID"]
+        seen.append(int(tid))
+        # a stream opened inside the trial stamps its records
+        run_dir = str(tmp_path / f"run{tid}")
+        s = MetricsStream(run_dir, rank0=True)
+        s.write("epoch", {"epoch": 0, "val": 1.0})
+        s.close()
+        # ...and the default objective's surfacing helper lifts them out
+        out = _surface_trial_metrics(run_dir, int(tid), str(tmp_path / "study"))
+        assert out is not None
+        return float(config["lr"])
+
+    monkeypatch.delenv("HYDRAGNN_TRIAL_ID", raising=False)
+    best, trials = run_hpo(
+        {"lr": 0.0},
+        {"lr": [0.1, 0.2]},
+        num_trials=3,
+        trial_offset=10,
+        objective=objective,
+        use_optuna=False,
+    )
+    assert seen == [10, 11, 12]
+    assert "HYDRAGNN_TRIAL_ID" not in os.environ  # label scoped to trials
+    assert registry().gauge("hydragnn_hpo_trial").value() == 12
+    for tid in (10, 11, 12):
+        path = tmp_path / "study" / "trials" / f"trial_{tid}" / "metrics.jsonl"
+        rec = json.loads(path.read_text().splitlines()[0])
+        assert rec["trial"] == tid and rec["kind"] == "epoch"
+    assert len(trials) == 3 and best["lr"] in (0.1, 0.2)
+
+    # a worker index disambiguates the label (launch_hpo_workers exports
+    # HYDRAGNN_HPO_WORKER — per-worker trial_offset ranges overlap)
+    monkeypatch.setenv("HYDRAGNN_HPO_WORKER", "2")
+    labels = []
+    run_hpo(
+        {"lr": 0.0}, {"lr": [0.1]}, num_trials=1, trial_offset=10,
+        objective=lambda c: labels.append(os.environ["HYDRAGNN_TRIAL_ID"])
+        or 0.1,
+        use_optuna=False,
+    )
+    assert labels == ["w2.10"]
+
+
+def pytest_surface_trial_metrics_incremental_offsets(tmp_path):
+    """Two trials sharing one append-mode run dir must surface DISJOINT
+    slices: the offsets cursor copies only what each trial appended."""
+    from hydragnn_tpu.hpo import _surface_trial_metrics
+
+    run_dir = tmp_path / "run"
+    os.makedirs(run_dir)
+    offsets = {}
+    (run_dir / "metrics.jsonl").write_text('{"trial": 0}\n')
+    out0 = _surface_trial_metrics(str(run_dir), 0, str(tmp_path / "study"),
+                                  offsets=offsets)
+    with open(run_dir / "metrics.jsonl", "a") as fh:
+        fh.write('{"trial": 1}\n')
+    out1 = _surface_trial_metrics(str(run_dir), 1, str(tmp_path / "study"),
+                                  offsets=offsets)
+    assert json.loads(open(os.path.join(out0, "metrics.jsonl")).read()) == {
+        "trial": 0
+    }
+    assert json.loads(open(os.path.join(out1, "metrics.jsonl")).read()) == {
+        "trial": 1
+    }
+    # a trial that appended nothing surfaces nothing
+    assert _surface_trial_metrics(str(run_dir), 2, str(tmp_path / "study"),
+                                  offsets=offsets) is None
