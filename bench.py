@@ -1238,6 +1238,150 @@ def main_serve():
     }))
 
 
+def main_mix():
+    """BENCH_MIX=1: GFM mixture-plane cells (docs/GFM.md "Benchmarks").
+
+    Two cells over an N-family synthetic mixture (``BENCH_MIX_FAMILIES``,
+    default 3; hardware rounds raise families/configs/epochs to the
+    OC20+ANI+QM9-shaped mix):
+
+    - ``mix_stream``: host-side draw->validate->ladder-pack throughput of
+      the MixturePlane alone (graphs/sec, plus per-source graphs/sec from
+      the draw tallies) — the loader ceiling of the mixture path;
+    - ``mix_train``: a short balanced multibranch training through the
+      plane (graphs/sec end to end, final per-branch loss-drift maximum
+      from the EMA monitor — the balanced-loss health number the gate
+      watches: a drift that GROWS round-over-round means a branch is
+      starving).
+
+    One JSON record per invocation appends to ``logs/mix_cells.jsonl``;
+    ``run-scripts/bench_gate.py --mix-cells`` compares the newest two
+    records (throughput higher-better, drift lower-better)."""
+    import dataclasses
+
+    import numpy as np
+
+    from hydragnn_tpu.api import prepare_data
+    from hydragnn_tpu.data.pipeline import (
+        MinMax,
+        VariablesOfInterest,
+        extract_variables,
+        split_dataset,
+    )
+    from hydragnn_tpu.data.synthetic import deterministic_graph_dataset
+
+    families = int(os.getenv("BENCH_MIX_FAMILIES", "3"))
+    n_conf = int(os.getenv("BENCH_MIX_CONFIGS", "180"))
+    epochs = int(os.getenv("BENCH_MIX_EPOCHS", "3"))
+    batch = int(os.getenv("BENCH_MIX_BATCH", "16"))
+
+    raw = deterministic_graph_dataset(n_conf, seed=11)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["s"], ["graph"], [0], [1, 1, 1], [1])
+    ready = [
+        dataclasses.replace(extract_variables(g, voi), dataset_id=i % families)
+        for i, g in enumerate(raw)
+    ]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    gh = {"num_sharedlayers": 1, "dim_sharedlayers": 8,
+          "num_headlayers": 2, "dim_headlayers": [8, 8]}
+    config = {
+        "Verbosity": {"level": 0},
+        "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                    "graph_features": {"dim": [1]}},
+        "Mixture": {"temperature": 2.0},
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "hidden_dim": 8, "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {"graph": [
+                    {"type": f"branch-{b}", "architecture": dict(gh)}
+                    for b in range(families)
+                ]},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0], "output_names": ["s"],
+                "output_index": [0], "type": ["graph"],
+            },
+            "Training": {
+                "num_epoch": epochs, "batch_size": batch, "seed": 7,
+                "precompile": "blocking", "retrace_policy": "error",
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+    config, (tr_l, va_l, te_l), _ = prepare_data(config, datasets=(tr, va, te))
+
+    cells = {"ts": round(time.time(), 3), "metric": "mixture plane cells",
+             "families": families, "device_kind": _device_kind()}
+    # ---- mix_stream: host batching throughput of the plane alone
+    tr_l.set_epoch(0)
+    t0 = time.perf_counter()
+    n_graphs = 0
+    for b in tr_l:
+        n_graphs += int(np.asarray(b.graph_mask).sum())
+    dt = max(time.perf_counter() - t0, 1e-9)
+    cells["mix_stream_graphs_per_sec"] = round(n_graphs / dt, 1)
+    for sid in sorted(tr_l.sources):
+        name = tr_l.sources[sid].name
+        cells[f"mix_source_{name}_graphs_per_sec"] = round(
+            tr_l.epoch_draws.get(sid, 0) / dt, 1
+        )
+    tr_l.epoch_draws, tr_l.epoch_skips = {}, {}
+
+    # ---- mix_train: balanced multibranch training end to end
+    from hydragnn_tpu.models.create import create_model, init_model
+    from hydragnn_tpu.train import train_validate_test
+    from hydragnn_tpu.train.optimizer import make_optimizer
+    from hydragnn_tpu.train.state import TrainState
+
+    prev_valtest = os.environ.get("HYDRAGNN_VALTEST")
+    os.environ["HYDRAGNN_VALTEST"] = "0"
+    try:
+        from hydragnn_tpu.utils.timers import Timer
+
+        model = create_model(config)
+        variables = init_model(model, next(iter(tr_l)), seed=7)
+        tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+        state = TrainState.create(variables, tx)
+        Timer.reset()
+        t0 = time.perf_counter()
+        state, hist = train_validate_test(
+            model, state, tx, tr_l, va_l, te_l, config,
+            log_name="bench_mix", seed=7,
+        )
+        dt = max(time.perf_counter() - t0, 1e-9)
+        # gate steady-state goodput, not the (epoch-count-dependent) share
+        # of the compile bill: first-step latency carries warm-up/compile
+        ttfs = Timer.totals().get("time_to_first_step", 0.0)
+        steady = max(dt - ttfs, 1e-9)
+    finally:
+        if prev_valtest is None:
+            os.environ.pop("HYDRAGNN_VALTEST", None)
+        else:
+            os.environ["HYDRAGNN_VALTEST"] = prev_valtest
+    total_graphs = len(tr_l) * batch * len(hist["train"])
+    cells["mix_train_graphs_per_sec"] = round(
+        max(total_graphs - batch, 0) / steady, 1
+    )
+    cells["mix_time_to_first_step_s"] = round(ttfs, 3)
+    cells["mix_train_loss"] = round(float(hist["train"][-1]), 6)
+    ema = tr_l.drift.ema
+    if ema:
+        vals = sorted(ema.values())
+        median = vals[len(vals) // 2] or 1.0
+        cells["mix_loss_drift_max"] = round(max(ema.values()) / median, 4)
+    assert hist["train"][-1] < hist["train"][0], (
+        f"mixture training did not learn: {hist['train']}"
+    )
+
+    os.makedirs("logs", exist_ok=True)
+    line = json.dumps(cells)
+    print(line, flush=True)
+    with open(os.path.join("logs", "mix_cells.jsonl"), "a") as fh:
+        fh.write(line + "\n")
+
+
 def _device_kind() -> str:
     import jax
 
@@ -1253,6 +1397,9 @@ def main():
         return
     if os.getenv("BENCH_SERVE", "0") == "1":
         main_serve()
+        return
+    if os.getenv("BENCH_MIX", "0") == "1":
+        main_mix()
         return
     if os.getenv("BENCH_AB", "0") == "1":
         main_ab()

@@ -35,7 +35,9 @@ from .train.checkpoint import (
     clear_loader_state,
     load_existing_model,
     load_loader_state,
+    load_mixture_state,
     save_loader_state,
+    save_mixture_state,
     save_model,
 )
 from .train.loop import test_model, train_validate_test
@@ -364,6 +366,55 @@ def prepare_data(
         sample_weights = branch_sample_weights(
             trainset, {i: 1.0 for i in ids}
         )
+    # GFM mixture plane (docs/GFM.md): a ``Mixture`` config section swaps
+    # the train loader for the streaming temperature-sampled multi-source
+    # scheduler; val/test stay plain ladder loaders over the merged splits
+    # (deterministic eval), sharing the same spec ladder so every
+    # specialization is reused across train and eval
+    if config.get("Mixture"):
+        if bool(training.get("branch_parallel", False)):
+            raise ValueError(
+                "the Mixture section is not supported together with "
+                "Training.branch_parallel yet: the mixture plane emits "
+                "unstacked dense-multibranch batches (dataset_id routing); "
+                "drop one of the two"
+            )
+        if pack:
+            raise ValueError(
+                "the Mixture section is not supported with "
+                "Training.pack_batches (mixture batches are drawn at a "
+                "fixed graph count and ladder-padded); use num_pad_buckets"
+            )
+        if num_shards > 1 or host_count > 1:
+            raise ValueError(
+                "the Mixture plane is single-host/single-shard for now "
+                f"(num_shards={num_shards}, host_count={host_count}); run "
+                "it on one process or drop the Mixture section"
+            )
+        if balance:
+            raise ValueError(
+                "Training.balance_branch_sampling is subsumed by the "
+                "Mixture section (Mixture.temperature/weights set the "
+                "per-source draw shares); drop one of the two"
+            )
+        from .mix import MixturePlane, sources_from_graphs
+
+        train_loader = MixturePlane(
+            sources_from_graphs(trainset),
+            batch_size,
+            settings=config["Mixture"],
+            spec=spec,
+            seed=int(training.get("seed", 0)),
+            sort_edges=shard_kw["sort_edges"],
+            validator=validator,
+        )
+        val_loader = GraphLoader(
+            valset, batch_size, shuffle=False, source="val", **shard_kw
+        )
+        test_loader = GraphLoader(
+            testset, batch_size, shuffle=False, source="test", **shard_kw
+        )
+        return config, (train_loader, val_loader, test_loader), mm
     if (
         bool(training.get("branch_parallel", False))
         and num_branches > 1
@@ -376,8 +427,8 @@ def prepare_data(
                 "num_pad_buckets"
             )
         # branch-parallel decoders need branch-routed shard rows
-        # (parallel/branch.py BranchRoutedLoader); ONE worst-case spec over
-        # all splits so eval reuses the train step's compilation
+        # (parallel/branch.py BranchRoutedLoader); ONE ladder over all
+        # splits so eval reuses the train step's compilations
         from .parallel.branch import BranchRoutedLoader
 
         route_kw = dict(
@@ -386,7 +437,13 @@ def prepare_data(
             host_count=host_count,
             host_index=host_index,
             sort_edges=shard_kw["sort_edges"],
-            spec=spec.specs[-1],
+            # the FULL ladder (shared across splits): each stacked batch
+            # selects the smallest level fitting its largest row, and the
+            # loader's per-branch template census warms every reachable
+            # level (parallel/branch.py; multi-host collapses to worst-case
+            # inside the loader — level choice cannot agree across hosts
+            # without a collective)
+            spec=spec,
         )
         train_loader = BranchRoutedLoader(
             trainset, batch_size, seed=0, shuffle=True, **route_kw
@@ -521,6 +578,13 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             )
             if recipe_ok:
                 train_loader.resume(ls.epoch, ls.next_batch)
+                if ls.mixture and hasattr(train_loader, "restore_mixture"):
+                    # mid-epoch mixture resume: cursors + draw index + the
+                    # source topology AT the checkpointed batch — BEFORE the
+                    # batch-count guard below, which must compare against
+                    # the sidecar's (possibly churned/demoted) active set,
+                    # not the fresh all-sources topology (mix/plane.py)
+                    train_loader.restore_mixture(ls.mixture, mid_epoch=True)
                 # batch-count guard AFTER arming: pack-mode batch counts are
                 # epoch-dependent, so len() is only comparable once the
                 # loader sits at the sidecar's epoch
@@ -541,6 +605,18 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                     "granularity instead of mid-epoch",
                     stacklevel=2,
                 )
+        elif hasattr(train_loader, "restore_mixture"):
+            # epoch-boundary (or SIGKILL) resume: no loader sidecar, but the
+            # mixture snapshot beside the checkpoint still carries the source
+            # topology + the absolute epoch sequence to continue
+            ms = load_mixture_state(startfrom)
+            if ms is not None:
+                train_loader.restore_mixture(ms)
+                if verbosity > 0:
+                    print(
+                        f"[{log_name}] mixture topology restored: epoch "
+                        f"sequence continues at {train_loader.epoch}"
+                    )
 
     # every device-placement transform applied to the state below is also
     # recorded here, so the rollback restore path (non_finite_policy:
@@ -710,6 +786,11 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         # this (loader_state_fn below), so a PRESENT sidecar always
         # describes the checkpoint it sits beside
         clear_loader_state(log_name)
+        if hasattr(train_loader, "mixture_state_dict"):
+            # mixture snapshot beside every checkpoint: active/demoted
+            # sources, weights, absolute epoch — what a SIGKILL resume
+            # needs to continue the exact draw sequence (docs/GFM.md)
+            save_mixture_state(train_loader.mixture_state_dict(), log_name)
         return out
 
     def loader_state_fn(d):

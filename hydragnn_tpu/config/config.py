@@ -441,6 +441,16 @@ def update_config(
             "Training.loader_stall_timeout must be >= 0 (seconds; 0 "
             f"disables), got {training['loader_stall_timeout']!r}"
         )
+    # ---- double-buffered device staging (ROADMAP #3 H2D overlap): true
+    # (default) = a 2-deep background device_put queue, false = inline
+    # transfers, an int = that queue depth; HYDRAGNN_DEVICE_PREFETCH wins
+    training.setdefault("double_buffer", True)
+    db = training["double_buffer"]
+    if not isinstance(db, (bool, int)) or (not isinstance(db, bool) and int(db) < 0):
+        raise ValueError(
+            "Training.double_buffer must be true/false or a queue depth "
+            f">= 0, got {db!r}"
+        )
     if training["non_finite_policy"] == "rollback" and not training["Checkpoint"]:
         # rollback restores the last verified checkpoint — without best-val
         # checkpointing only the preemption/end-of-run saves exist, so the
@@ -480,6 +490,22 @@ def update_config(
         from ..obs.telemetry import resolve_telemetry
 
         config["Telemetry"] = resolve_telemetry(config)
+
+    # ---- mixture plane (docs/GFM.md): same eager-validation contract as
+    # the sections above; the completed section additionally plants the
+    # static per-branch loss-balancing weights into the Architecture so
+    # the jitted multibranch step sees them (train/loss.py)
+    if config.get("Mixture"):
+        from ..mix import branch_loss_weights_from, resolve_mixture
+        from ..models.create import num_branches_from
+
+        config["Mixture"] = resolve_mixture(config)
+        nb = num_branches_from(arch)
+        if nb > 1:
+            blw = branch_loss_weights_from(config["Mixture"], nb)
+            if blw is not None:
+                arch["branch_loss_weights"] = list(blw)
+                arch["branch_loss_metrics"] = True
 
     config.setdefault("Verbosity", {"level": 0})
     config.setdefault("Visualization", {})

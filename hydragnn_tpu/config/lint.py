@@ -35,6 +35,8 @@ _OPAQUE = {
     "Dataset.path",
     "Dataset.synthetic",
     "Dataset.lennard_jones",
+    "Mixture.weights",
+    "Mixture.branch_loss_weights",
 }
 
 # exact key paths this framework consumes (config/config.py completion,
@@ -100,6 +102,8 @@ _HANDLED = {
     "NeuralNetwork.Architecture.max_in_degree",
     "NeuralNetwork.Architecture.use_fused_edge_kernel",
     "NeuralNetwork.Architecture.use_flash_attention",
+    "NeuralNetwork.Architecture.branch_loss_weights",
+    "NeuralNetwork.Architecture.branch_loss_metrics",
     "NeuralNetwork.Architecture.dropout",
     "NeuralNetwork.Architecture.decoder_mirror_init",
     "NeuralNetwork.Architecture.decoder_recovery_slope",
@@ -142,6 +146,7 @@ _HANDLED = {
     "NeuralNetwork.Training.num_pad_buckets",
     "NeuralNetwork.Training.size_bucketed_batching",
     "NeuralNetwork.Training.branch_parallel",
+    "NeuralNetwork.Training.double_buffer",
     "NeuralNetwork.Training.warmup_epochs",
     "NeuralNetwork.Training.walltime_minutes",
     "Visualization.create_plots",
@@ -170,6 +175,15 @@ _HANDLED = {
     "Telemetry.trace_sample",
     "Telemetry.trace_interval_steps",
     "Telemetry.flight_recorder",
+    "Mixture.temperature",
+    "Mixture.weights",
+    "Mixture.draws_per_epoch",
+    "Mixture.balance",
+    "Mixture.branch_loss_weights",
+    "Mixture.drift_ema_decay",
+    "Mixture.drift_threshold",
+    "Mixture.demote_after",
+    "Mixture.seed",
 }
 
 # reference keys that are intentionally NOT consumed here, with the
@@ -218,12 +232,12 @@ _LEGACY = {
 }
 
 # top-level Dataset/Architecture synonyms appearing in some reference
-# example configs at non-standard paths ("Serving" and "Telemetry" are this
-# framework's own sections — no reference analog; docs/SERVING.md,
-# docs/OBSERVABILITY.md)
+# example configs at non-standard paths ("Serving", "Telemetry" and
+# "Mixture" are this framework's own sections — no reference analog;
+# docs/SERVING.md, docs/OBSERVABILITY.md, docs/GFM.md)
 _TOPLEVEL_SECTIONS = (
     "Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving",
-    "Telemetry",
+    "Telemetry", "Mixture",
 )
 
 

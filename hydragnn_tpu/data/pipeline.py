@@ -68,6 +68,39 @@ def _pack_spec(
     )
 
 
+def selectable_levels(
+    graphs: Sequence[Graph],
+    ladder: SpecLadder,
+    trip_count_of=None,
+) -> List[Tuple[int, Graph]]:
+    """(level index, one fitting graph) for every ladder level the graphs
+    can land in. A level no single graph fits can never be selected by
+    ``SpecLadder.select`` (every batch total is >= its smallest member), so
+    this census is exactly the set of specializations batching over
+    ``graphs`` can produce — the shared coverage primitive of the training
+    compile plane, the serving plane, the branch-routed loader's per-branch
+    ladders (parallel/branch.py), and the mixture plane (mix/plane.py).
+    ``trip_count_of`` overrides the per-graph triplet counter (the loader
+    passes its memoized table)."""
+    tcf = trip_count_of if trip_count_of is not None else _triplet_count
+    out: List[Tuple[int, Graph]] = []
+    for li, spec in enumerate(ladder.specs):
+        need_t = bool(spec.n_triplets)
+        g = next(
+            (
+                c
+                for c in graphs
+                if c.num_nodes <= spec.n_nodes - 1
+                and c.num_edges <= spec.n_edges
+                and (not need_t or tcf(c) <= spec.n_triplets)
+            ),
+            None,
+        )
+        if g is not None:
+            out.append((li, g))
+    return out
+
+
 def spec_template_batches(
     graphs: Sequence[Graph],
     ladder: SpecLadder,
@@ -80,30 +113,16 @@ def spec_template_batches(
 
     Batch array SHAPES are fully determined by the pad spec plus the
     dataset's feature widths, so a single fitting graph padded to the level
-    is abstractly identical to any real batch at that level. A level no
-    single dataset graph fits can never be selected by ``SpecLadder.select``
-    either (every batch total is >= its smallest member) and is skipped —
-    warm-up covers exactly the specializations batching can produce, no
-    more. ``trip_count_of`` overrides the per-graph triplet counter (the
-    loader passes its memoized table)."""
-    tcf = trip_count_of if trip_count_of is not None else _triplet_count
-    out: List[Tuple[PadSpec, GraphBatch]] = []
-    for spec in ladder.specs:
-        need_t = bool(spec.n_triplets)
-        g = next(
-            (
-                c
-                for c in graphs
-                if c.num_nodes <= spec.n_nodes - 1
-                and c.num_edges <= spec.n_edges
-                and (not need_t or tcf(c) <= spec.n_triplets)
-            ),
-            None,
+    is abstractly identical to any real batch at that level; unreachable
+    levels are skipped (``selectable_levels``) — warm-up covers exactly the
+    specializations batching can produce, no more."""
+    return [
+        (
+            ladder.specs[li],
+            batch_graphs([g], ladder.specs[li], sort_edges=sort_edges),
         )
-        if g is None:
-            continue
-        out.append((spec, batch_graphs([g], spec, sort_edges=sort_edges)))
-    return out
+        for li, g in selectable_levels(graphs, ladder, trip_count_of)
+    ]
 
 
 @dataclasses.dataclass
@@ -906,20 +925,10 @@ class GraphLoader:
                 trip_count_of=self._trip_count_of,
             )
         out: List[Tuple[PadSpec, GraphBatch]] = []
-        for spec in self.ladder.specs:
-            need_t = bool(spec.n_triplets)
-            g = next(
-                (
-                    c
-                    for c in self.graphs
-                    if c.num_nodes <= spec.n_nodes - 1
-                    and c.num_edges <= spec.n_edges
-                    and (not need_t or self._trip_count_of(c) <= spec.n_triplets)
-                ),
-                None,
-            )
-            if g is None:
-                continue
+        for li, g in selectable_levels(
+            self.graphs, self.ladder, self._trip_count_of
+        ):
+            spec = self.ladder.specs[li]
             shards = [[g]] + [[] for _ in range(self.num_shards - 1)]
             out.append((spec, self._make_stacked(shards, spec)))
         return out

@@ -1,0 +1,33 @@
+"""GFM mixture plane: streaming temperature-sampled multi-dataset training
+with per-branch loss balancing, hot source add/remove under the quarantine
+policy, and deterministic mixture resume (docs/GFM.md)."""
+
+from .balance import DriftMonitor, branch_loss_weights_from
+from .config import MIXTURE_DEFAULTS, resolve_mixture
+from .plane import (
+    MixtureExhaustedError,
+    MixturePlane,
+    MixtureSource,
+    sources_from_graphs,
+)
+from .sampler import (
+    SourceCursor,
+    draw_source,
+    source_permutation,
+    temperature_weights,
+)
+
+__all__ = [
+    "DriftMonitor",
+    "branch_loss_weights_from",
+    "MIXTURE_DEFAULTS",
+    "resolve_mixture",
+    "MixtureExhaustedError",
+    "MixturePlane",
+    "MixtureSource",
+    "sources_from_graphs",
+    "SourceCursor",
+    "draw_source",
+    "source_permutation",
+    "temperature_weights",
+]

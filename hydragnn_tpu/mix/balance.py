@@ -1,0 +1,121 @@
+"""Per-branch loss balancing + drift monitoring for mixture training
+(docs/GFM.md "Loss balancing").
+
+Static balancing happens IN-GRAPH: ``branch_loss_weights_from`` resolves
+the ``Mixture.branch_loss_weights`` setting into a per-branch weight
+vector (normalized to mean 1 so the total-loss scale is unchanged) that
+config completion plants into the Architecture section; the jitted
+multibranch step weights every graph's loss contribution by its branch's
+weight (train/loss.py ``multitask_loss``) and emits per-branch loss
+scalars (``branch<i>`` task entries) at zero extra host syncs.
+
+Dynamic monitoring happens HOST-SIDE at the epoch boundary: the
+``DriftMonitor`` keeps an EMA of each branch's loss and compares it to the
+mixture median — a branch whose smoothed loss diverges past
+``Mixture.drift_threshold`` × median raises a typed EV_MIX_DRIFT event
+(obs/events.py) and a registry gauge, so a collapsing or starved branch is
+visible in the flight-recorder window and on /metrics long before the run
+"finishes wrong". Monitoring never mutates training (the reference's
+uneven-branch process groups have no runtime rebalancer either);
+rebalancing stays an operator decision on the surfaced signal.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def branch_loss_weights_from(
+    settings: Dict, num_branches: int
+) -> Optional[Tuple[float, ...]]:
+    """Resolve ``Mixture.branch_loss_weights`` into a per-branch vector of
+    length ``num_branches``, normalized to mean 1. Returns None when
+    balancing is off (``Mixture.balance: false``) — the loss path then
+    stays byte-identical to a non-mixture run."""
+    if not settings.get("balance", True):
+        return None
+    raw = settings.get("branch_loss_weights")
+    if raw is None:
+        w: List[float] = [1.0] * num_branches
+    elif isinstance(raw, dict):
+        w = [1.0] * num_branches
+        for k, v in raw.items():
+            if not 0 <= int(k) < num_branches:
+                raise ValueError(
+                    f"Mixture.branch_loss_weights names branch {k} but the "
+                    f"model has {num_branches} branches"
+                )
+            w[int(k)] = float(v)
+    else:
+        w = [float(v) for v in raw]
+        if len(w) != num_branches:
+            raise ValueError(
+                f"Mixture.branch_loss_weights has {len(w)} entries but the "
+                f"model has {num_branches} branches"
+            )
+    mean = sum(w) / len(w)
+    return tuple(v / mean for v in w)
+
+
+class DriftMonitor:
+    """EMA tracker of per-branch losses with a divergence alarm."""
+
+    def __init__(self, decay: float = 0.9, threshold: float = 2.0):
+        self.decay = float(decay)
+        self.threshold = float(threshold)
+        self.ema: Dict[int, float] = {}
+        self.alarms = 0
+
+    def update(self, epoch: int, losses: Dict[int, float],
+               writer=None) -> Dict[int, float]:
+        """Fold one epoch's per-branch losses in; returns each branch's
+        drift ratio (EMA / mixture median EMA). Publishes gauges and emits
+        EV_MIX_DRIFT for branches past the threshold."""
+        for b, loss in losses.items():
+            prev = self.ema.get(b)
+            self.ema[b] = (
+                float(loss)
+                if prev is None
+                else self.decay * prev + (1.0 - self.decay) * float(loss)
+            )
+        vals = sorted(self.ema[b] for b in losses)
+        median = vals[len(vals) // 2] if vals else 0.0
+        ratios: Dict[int, float] = {}
+        for b in sorted(losses):
+            ratios[b] = self.ema[b] / median if median > 0 else 1.0
+        try:
+            from ..obs.registry import registry
+
+            g_loss = registry().gauge(
+                "hydragnn_mix_branch_loss_ema",
+                "EMA-smoothed per-branch training loss of the mixture",
+                labelnames=("branch",),
+            )
+            g_drift = registry().gauge(
+                "hydragnn_mix_branch_drift",
+                "Per-branch loss EMA / mixture median (1.0 = balanced)",
+                labelnames=("branch",),
+            )
+            for b, r in ratios.items():
+                g_loss.set(self.ema[b], branch=str(b))
+                g_drift.set(r, branch=str(b))
+        except Exception:
+            pass
+        if writer is not None:
+            for b, r in ratios.items():
+                writer.add_scalar(f"mix/branch_drift_{b}", float(r), epoch)
+        for b, r in sorted(ratios.items()):
+            if r > self.threshold:
+                self.alarms += 1
+                try:
+                    from ..obs.events import EV_MIX_DRIFT, emit
+
+                    emit(
+                        EV_MIX_DRIFT, severity="warn", branch=int(b),
+                        ratio=round(float(r), 4), epoch=int(epoch),
+                        ema=round(float(self.ema[b]), 6),
+                        median=round(float(median), 6),
+                    )
+                except Exception:
+                    pass
+        return ratios

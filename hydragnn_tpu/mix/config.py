@@ -1,0 +1,136 @@
+"""``Mixture`` config section: resolution + validation (docs/GFM.md).
+
+Same eager-validation contract as the ``Serving``/``Telemetry`` sections
+(config/config.py): a typo'd key or out-of-range value fails at config load
+time, not mid-run; unknown keys warn-and-drop ONCE during completion. The
+section is optional — absent means "no mixture plane" and the loaders stay
+the plain single-stream ``GraphLoader``s.
+
+Keys (defaults in ``MIXTURE_DEFAULTS``):
+
+- ``temperature``: T > 0 of the source-sampling law p_i ∝ w_i^(1/T)
+  (w_i defaults to |D_i|, the dataset size). T=1 reproduces
+  proportional-to-size sampling; T→∞ approaches uniform-over-sources —
+  the standard multi-corpus temperature knob.
+- ``weights``: optional {source name: positive float} MULTIPLIER on the
+  |D_i| base weight per source (``{"ds2": 5.0}`` = 5x ds2's natural
+  share; still tempered by T, renormalized as sources come and go).
+- ``draws_per_epoch``: samples drawn per epoch; 0 (default) = the total
+  size of the active sources.
+- ``balance``: per-branch loss balancing on/off (default on): static
+  per-branch loss weights reach the jitted multibranch step
+  (train/loss.py) and per-branch loss scalars feed the drift monitor.
+- ``branch_loss_weights``: optional list (one per branch) or
+  {branch index: w} of positive static loss weights; default equal.
+  Normalized to mean 1 so the total-loss scale is unchanged.
+- ``drift_ema_decay``: EMA decay of the per-branch loss tracker
+  (mix/balance.DriftMonitor), in [0, 1).
+- ``drift_threshold``: branch-EMA / mixture-median ratio beyond which a
+  per-branch divergence event (EV_MIX_DRIFT) is emitted; > 1.
+- ``demote_after``: per-source draw-time validation failures before the
+  source is quarantine-demoted out of the active set (0 disables).
+- ``seed``: sampler seed; null = ``Training.seed``.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Dict
+
+MIXTURE_DEFAULTS: Dict[str, Any] = {
+    "temperature": 1.0,
+    "weights": None,
+    "draws_per_epoch": 0,
+    "balance": True,
+    "branch_loss_weights": None,
+    "drift_ema_decay": 0.9,
+    "drift_threshold": 2.0,
+    "demote_after": 8,
+    "seed": None,
+}
+
+
+def resolve_mixture(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Completed ``Mixture`` section from a config dict: defaults filled,
+    values validated, unknown keys warned-and-dropped. Raises ``ValueError``
+    on out-of-range values — the fail-at-load-time contract."""
+    section = dict(config.get("Mixture") or {})
+    out = dict(MIXTURE_DEFAULTS)
+    for key, val in section.items():
+        if key not in MIXTURE_DEFAULTS:
+            warnings.warn(
+                f"Mixture.{key} is not a known mixture key; ignoring it "
+                "(see docs/GFM.md for the Mixture section schema)",
+                stacklevel=2,
+            )
+            continue
+        out[key] = val
+
+    t = float(out["temperature"])
+    if not t > 0:
+        raise ValueError(
+            f"Mixture.temperature must be > 0 (got {out['temperature']!r}); "
+            "T=1 is proportional-to-size, larger T flattens toward uniform"
+        )
+    out["temperature"] = t
+    if out["weights"] is not None:
+        if not isinstance(out["weights"], dict) or not out["weights"]:
+            raise ValueError(
+                "Mixture.weights must be a non-empty {source name: weight} "
+                f"mapping or null, got {out['weights']!r}"
+            )
+        for name, w in out["weights"].items():
+            if not float(w) > 0:
+                raise ValueError(
+                    f"Mixture.weights[{name!r}] must be positive, got {w!r}"
+                )
+        out["weights"] = {str(k): float(v) for k, v in out["weights"].items()}
+    dpe = int(out["draws_per_epoch"])
+    if dpe < 0:
+        raise ValueError(
+            f"Mixture.draws_per_epoch must be >= 0 (0 = total active source "
+            f"size), got {out['draws_per_epoch']!r}"
+        )
+    out["draws_per_epoch"] = dpe
+    out["balance"] = bool(out["balance"])
+    blw = out["branch_loss_weights"]
+    if blw is not None:
+        if isinstance(blw, dict):
+            blw = {int(k): float(v) for k, v in blw.items()}
+            vals = blw.values()
+        elif isinstance(blw, (list, tuple)):
+            blw = [float(v) for v in blw]
+            vals = blw
+        else:
+            raise ValueError(
+                "Mixture.branch_loss_weights must be a list (one weight per "
+                f"branch) or a {{branch index: weight}} mapping, got {blw!r}"
+            )
+        if any(not v > 0 for v in vals):
+            raise ValueError(
+                f"Mixture.branch_loss_weights must all be positive: {blw!r}"
+            )
+        out["branch_loss_weights"] = blw
+    decay = float(out["drift_ema_decay"])
+    if not (0.0 <= decay < 1.0):
+        raise ValueError(
+            f"Mixture.drift_ema_decay must be in [0, 1), got {decay!r}"
+        )
+    out["drift_ema_decay"] = decay
+    thr = float(out["drift_threshold"])
+    if not thr > 1.0:
+        raise ValueError(
+            "Mixture.drift_threshold is a ratio vs the mixture median and "
+            f"must be > 1, got {thr!r}"
+        )
+    out["drift_threshold"] = thr
+    da = int(out["demote_after"])
+    if da < 0:
+        raise ValueError(
+            f"Mixture.demote_after must be >= 0 (0 disables quarantine "
+            f"demotion), got {out['demote_after']!r}"
+        )
+    out["demote_after"] = da
+    if out["seed"] is not None:
+        out["seed"] = int(out["seed"])
+    return out

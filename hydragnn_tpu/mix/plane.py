@@ -1,0 +1,684 @@
+"""MixturePlane: streaming temperature-sampled multi-dataset training
+(docs/GFM.md) — the production pipeline behind the GFM workload.
+
+A loader-compatible object (``set_epoch``/``__len__``/``__iter__``/
+``spec_template_batches``/``state_dict``/``resume``) that streams N
+registered sources (each a list of host ``Graph``s — local, DDStore-backed
+datasets materialize to the same interface) through the weighted
+temperature-sampled scheduler (mix/sampler.py) and packs every drawn
+sample stream through the existing ``SpecLadder`` pad-bucket machinery, so
+the compile plane warms exactly the specializations mixture batching can
+emit and the retrace sentinel holds in ``error`` mode.
+
+Fault model:
+
+- **dirty sources**: every drawn sample re-validates through the run's
+  ``SampleValidator`` (data/validate.py) at draw time — post-ingest rot
+  (bit flips at rest, a corrupted shard) is skipped-and-counted per
+  source, and a source whose draw-time failures cross
+  ``Mixture.demote_after`` is quarantine-DEMOTED out of the active set
+  with a typed event (EV_MIX_DEMOTE), not a crash; the remaining weights
+  renormalize and the epoch's batch budget is still met.
+- **churn**: ``add_source``/``remove_source`` retarget the scheduler at
+  the next draw (EV_MIX_SOURCE_ADD/REMOVE); epoch length is frozen at
+  epoch start so the step loop never desynchronizes mid-epoch.
+- **crashes**: all sampling state is pure-in-integers (mix/sampler.py);
+  the durable snapshot (``mixture_state_dict``) is the active set +
+  weights + per-source cursors + (epoch, draw), serialized beside every
+  checkpoint (train/checkpoint.py ``save_mixture_state``) and inside the
+  PR 4 loader-state sidecar on a mid-epoch preemption stop — a SIGKILL
+  anywhere resumes the exact remaining draw sequence.
+
+Observability (obs/): per-source weight/draw/skip gauges and counters in
+the registry, demotion/churn/drift events in the event log, a per-epoch
+tally line through the loop's ``mixture_epoch_hook``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import sys
+import zlib
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..data.graph import (
+    Graph,
+    GraphBatch,
+    PadSpec,
+    SpecLadder,
+    _triplet_count,
+    batch_graphs,
+)
+from ..data.pipeline import spec_template_batches as _module_templates
+from .balance import DriftMonitor
+from .sampler import SourceCursor, draw_source, temperature_weights
+
+
+class MixtureExhaustedError(RuntimeError):
+    """Every mixture source was removed or demoted — nothing left to draw.
+    The message carries the demotion history so the operator sees WHY the
+    fleet emptied instead of a bare stop."""
+
+
+@dataclasses.dataclass
+class MixtureSource:
+    """One registered dataset of the mixture."""
+
+    sid: int
+    name: str
+    graphs: List[Graph]
+    weight: Optional[float] = None  # multiplier on the |D| base (default 1)
+
+
+def sources_from_graphs(
+    graphs: Sequence[Graph], names: Optional[Dict[int, str]] = None
+) -> List[MixtureSource]:
+    """Group a merged multi-dataset list into per-``dataset_id`` sources —
+    the bridge from the repo's existing merged-GFM datasets (examples/
+    multidataset*) to the mixture plane."""
+    by_id: Dict[int, List[Graph]] = {}
+    for g in graphs:
+        by_id.setdefault(int(getattr(g, "dataset_id", 0) or 0), []).append(g)
+    out = []
+    for sid in sorted(by_id):
+        name = (names or {}).get(sid, f"ds{sid}")
+        out.append(MixtureSource(sid=sid, name=name, graphs=by_id[sid]))
+    return out
+
+
+def _fingerprint(graphs: Sequence[Graph], sids: Sequence[int]) -> str:
+    """Cheap stable digest of one batch's sample content + source draw
+    sequence — the bit-exact-resume assertion currency of
+    run-scripts/mix_chaos_smoke.py."""
+    h = zlib.crc32(np.asarray(sids, np.int64).tobytes())
+    for g in graphs:
+        h = zlib.crc32(np.ascontiguousarray(np.asarray(g.x, np.float64)).tobytes(), h)
+    return f"{h:08x}"
+
+
+class MixturePlane:
+    """Temperature-sampled multi-source training stream.
+
+    ``settings`` is a resolved ``Mixture`` section (mix/config.py).
+    ``spec`` is the run's PadSpec/SpecLadder (shared with the eval loaders
+    so every specialization is reused); None derives a ladder from the
+    registered sources. ``validator`` is the run's SampleValidator — draw
+    gating and demotion degrade gracefully to "trust the ingest gate" when
+    it is None.
+    """
+
+    # loader-compat surface consumed by the loop / api
+    num_shards = 1
+    host_count = 1
+    host_index = 0
+    pack = False
+
+    def __init__(
+        self,
+        sources: Sequence[MixtureSource],
+        batch_size: int,
+        settings: Dict[str, Any],
+        spec=None,
+        seed: int = 0,
+        sort_edges: bool = False,
+        validator=None,
+        num_buckets: int = 1,
+    ):
+        if not sources:
+            raise ValueError("MixturePlane needs at least one source")
+        self.batch_size = int(batch_size)
+        self.settings = dict(settings)
+        self.temperature = float(settings.get("temperature", 1.0))
+        self.demote_after = int(settings.get("demote_after", 0) or 0)
+        self.draws_per_epoch = int(settings.get("draws_per_epoch", 0) or 0)
+        self.seed = int(
+            settings.get("seed") if settings.get("seed") is not None else seed
+        )
+        self.sort_edges = bool(sort_edges)
+        self.validator = validator
+        self.sources: Dict[int, MixtureSource] = {}
+        self.demoted: Dict[int, str] = {}  # sid -> demotion reason
+        self._explicit_weights: Dict[int, float] = {}
+        self.weights: Dict[int, float] = {}
+        # spec/ladder: shared with the eval loaders when the caller passes
+        # the run ladder (api.prepare_data), else derived from the sources
+        all_graphs = [g for s in sources for g in s.graphs]
+        if spec is None:
+            self.ladder = SpecLadder.for_dataset(
+                all_graphs, self.batch_size, num_buckets=max(num_buckets, 1)
+            )
+        elif isinstance(spec, SpecLadder):
+            self.ladder = spec
+        else:
+            self.ladder = SpecLadder((spec,))
+        self.spec = self.ladder.specs[-1]
+        # mixture position: epoch is the ABSOLUTE mixture epoch (a resumed
+        # process maps its local epoch loop through _epoch_offset so the
+        # draw sequence continues where the killed run's left off)
+        self.epoch = 0
+        self.start_batch = 0
+        self._epoch_offset = 0
+        self._resume: Optional[Tuple[int, int]] = None
+        self.cursors: Dict[int, SourceCursor] = {}
+        self._perm_caches: Dict[int, dict] = {}
+        self._armed_cursors: Optional[Dict[int, SourceCursor]] = None
+        self._armed_draw: Optional[int] = None
+        # per-run accounting (per-source; epoch tallies reset by the hook)
+        self.epoch_draws: Dict[int, int] = {}
+        self.epoch_skips: Dict[int, int] = {}
+        self.fail_counts: Dict[int, int] = {}
+        self._fail_seen: set = set()
+        self.drift = DriftMonitor(
+            decay=float(settings.get("drift_ema_decay", 0.9)),
+            threshold=float(settings.get("drift_threshold", 2.0)),
+        )
+        self._fingerprint = os.getenv("HYDRAGNN_MIX_FINGERPRINT", "0") == "1"
+        # per-batch position journal of the CURRENT epoch: batch index ->
+        # (draw, cursors) at that batch's first draw. state_dict(next_batch)
+        # reads the journal so a snapshot pairs the cursor state with the
+        # checkpoint's batch index even when device_prefetch built ahead
+        self._journal: Dict[int, Dict[str, Any]] = {}
+        # per-graph triplet counts (DimeNet ladders budget them), memoized
+        # by object id — _triplet_count is O(E) interpreted python
+        self._trip_memo: Dict[int, int] = {}
+        for s in sources:
+            self._register(s, event=False)
+        explicit = settings.get("weights") or {}
+        for key, w in explicit.items():
+            sid = self._sid_of(key)
+            if sid is None:
+                raise ValueError(
+                    f"Mixture.weights names unknown source {key!r}; "
+                    f"registered: {[s.name for s in self.sources.values()]}"
+                )
+            self._explicit_weights[sid] = float(w)
+        self._refresh_weights()
+
+    # -- source registry ----------------------------------------------------
+
+    def _sid_of(self, key) -> Optional[int]:
+        """Source id from a name or an integer-ish key."""
+        for s in self.sources.values():
+            if s.name == str(key):
+                return s.sid
+        try:
+            sid = int(key)
+        except (TypeError, ValueError):
+            return None
+        return sid if sid in self.sources else None
+
+    def _register(self, source: MixtureSource, event: bool = True) -> None:
+        if source.sid in self.sources:
+            raise ValueError(f"duplicate mixture source id {source.sid}")
+        graphs = list(source.graphs)
+        if self.validator is not None:
+            worst = self.spec
+            graphs = self.validator.filter(
+                graphs,
+                source=f"mix:{source.name}",
+                max_nodes=worst.n_nodes - 1,
+                max_edges=worst.n_edges,
+            )
+        if not graphs:
+            raise ValueError(
+                f"mixture source {source.name!r} has no valid samples"
+            )
+        self.sources[source.sid] = dataclasses.replace(source, graphs=graphs)
+        if source.weight is not None:
+            self._explicit_weights[source.sid] = float(source.weight)
+        self.cursors.setdefault(source.sid, SourceCursor())
+        self._perm_caches.setdefault(source.sid, {})
+        if event:
+            self._emit(
+                "mix_source_add", severity="info", source=source.name,
+                sid=source.sid, size=len(graphs),
+            )
+
+    def add_source(self, name: str, graphs: Sequence[Graph],
+                   weight: Optional[float] = None) -> int:
+        """Hot-add a dataset mid-run; takes effect at the next draw (this
+        epoch's batch count stays frozen). Returns the new source id."""
+        sid = max(list(self.sources) + list(self.demoted) + [-1]) + 1
+        self._register(MixtureSource(sid, str(name), list(graphs), weight))
+        self._refresh_weights()
+        return sid
+
+    def remove_source(self, key) -> None:
+        """Hot-remove a dataset mid-run (operator decision, e.g. a corpus
+        recalled for licensing); remaining weights renormalize at the next
+        draw."""
+        sid = self._sid_of(key)
+        if sid is None:
+            raise KeyError(f"no mixture source {key!r}")
+        src = self.sources.pop(sid)
+        self._explicit_weights.pop(sid, None)
+        self._refresh_weights()
+        self._emit(
+            "mix_source_remove", severity="info", source=src.name, sid=sid,
+            remaining=len(self.sources),
+        )
+
+    def _demote(self, sid: int, reason: str) -> None:
+        src = self.sources.pop(sid)
+        self._explicit_weights.pop(sid, None)
+        self.demoted[sid] = reason
+        self._refresh_weights()
+        self._emit(
+            "mix_demote", severity="error", source=src.name, sid=sid,
+            reason=reason, failures=self.fail_counts.get(sid, 0),
+            remaining=len(self.sources),
+        )
+        print(
+            f"[hydragnn_tpu.mix] source {src.name!r} (id {sid}) quarantine-"
+            f"demoted after {self.fail_counts.get(sid, 0)} draw-time "
+            f"validation failures ({reason}); {len(self.sources)} source(s) "
+            "remain active",
+            file=sys.stderr,
+        )
+
+    def _refresh_weights(self) -> None:
+        sizes = {sid: len(s.graphs) for sid, s in self.sources.items()}
+        self.weights = temperature_weights(
+            sizes, self.temperature, self._explicit_weights
+        ) if sizes else {}
+        self._publish_gauges()
+
+    # -- observability -------------------------------------------------------
+
+    def _emit(self, kind: str, severity: str = "info", **attrs) -> None:
+        try:
+            from ..obs.events import emit as _emit_event
+
+            _emit_event(kind, severity=severity, **attrs)
+        except Exception:
+            pass
+
+    def _publish_gauges(self) -> None:
+        try:
+            from ..obs.registry import registry
+
+            g_w = registry().gauge(
+                "hydragnn_mix_source_weight",
+                "Normalized temperature-sampled draw probability per source",
+                labelnames=("source",),
+            )
+            for sid, s in self.sources.items():
+                g_w.set(self.weights.get(sid, 0.0), source=s.name)
+            registry().gauge(
+                "hydragnn_mix_active_sources",
+                "Mixture sources currently in the active set",
+            ).set(len(self.sources))
+            registry().gauge(
+                "hydragnn_mix_demoted_sources",
+                "Mixture sources quarantine-demoted out of the active set",
+            ).set(len(self.demoted))
+        except Exception:
+            pass
+
+    def _count_draw(self, sid: int) -> None:
+        self.epoch_draws[sid] = self.epoch_draws.get(sid, 0) + 1
+        try:
+            from ..obs.registry import registry
+
+            registry().counter(
+                "hydragnn_mix_draws_total",
+                "Samples drawn from each mixture source",
+                labelnames=("source",),
+            ).inc(source=self.sources[sid].name)
+        except Exception:
+            pass
+
+    def _count_skip(self, sid: int, name: str) -> None:
+        self.epoch_skips[sid] = self.epoch_skips.get(sid, 0) + 1
+        try:
+            from ..obs.registry import registry
+
+            registry().counter(
+                "hydragnn_mix_skips_total",
+                "Draw-time validation failures per mixture source",
+                labelnames=("source",),
+            ).inc(source=name)
+        except Exception:
+            pass
+
+    # -- loader surface ------------------------------------------------------
+
+    @property
+    def graphs(self) -> List[Graph]:
+        """Flat view over the active sources (loader-compat: consumers size
+        plots/ladders off ``loader.graphs``)."""
+        return [g for s in self.sources.values() for g in s.graphs]
+
+    def _epoch_draw_budget(self) -> int:
+        if self.draws_per_epoch > 0:
+            return self.draws_per_epoch
+        return sum(len(s.graphs) for s in self.sources.values())
+
+    def __len__(self) -> int:
+        return max(self._epoch_draw_budget() // self.batch_size, 1)
+
+    def set_epoch(self, epoch: int) -> None:
+        """Per-epoch reseed. The first call after ``resume()`` keeps the
+        armed (epoch, cursor); later calls map the local epoch counter
+        through the resume offset so a restarted process CONTINUES the
+        original run's epoch sequence instead of replaying epoch 0."""
+        if self._resume is not None:
+            self.epoch, self.start_batch = self._resume
+            self._resume = None
+        else:
+            self.epoch = int(epoch) + self._epoch_offset
+            self.start_batch = 0
+            self._armed_cursors = None
+            self._armed_draw = None
+
+    def resume(self, epoch: int, next_batch: int) -> None:
+        """Arm deterministic resume at absolute mixture position
+        (``epoch``, ``next_batch``) — applied immediately AND kept through
+        the loop's next ``set_epoch`` (the GraphLoader one-shot contract);
+        later epochs continue the absolute numbering."""
+        self.epoch = int(epoch)
+        self.start_batch = int(next_batch)
+        self._resume = (int(epoch), int(next_batch))
+        self._epoch_offset = int(epoch)
+
+    def state_dict(self, next_batch: int = 0) -> Dict[str, Any]:
+        """Loader-state record (train/state.LoaderState shape) extended
+        with the mixture snapshot — what the mid-epoch preemption sidecar
+        persists (docs/GFM.md "Resume")."""
+        return {
+            "seed": int(self.seed),
+            "epoch": int(self.epoch),
+            "next_batch": int(next_batch),
+            "num_batches": int(len(self)),
+            "mixture": self.mixture_state_dict(next_batch=int(next_batch)),
+        }
+
+    def mixture_state_dict(self, next_batch: Optional[int] = None) -> Dict[str, Any]:
+        """Durable mixture snapshot: active/demoted sets, explicit weights,
+        per-source cursors, absolute (epoch, draw). Saved beside every
+        checkpoint (api.py save_fn -> train/checkpoint.save_mixture_state).
+        ``next_batch`` selects the journal entry whose cursors produce
+        exactly that batch — NOT the live cursors, which device_prefetch's
+        lookahead may have advanced past the checkpointed step."""
+        draw = None
+        cursors = self.cursors
+        if next_batch is not None and int(next_batch) in self._journal:
+            entry = self._journal[int(next_batch)]
+            draw = int(entry["draw"])
+            cursors = entry["cursors"]
+        return {
+            "epoch": int(self.epoch),
+            "next_batch": int(next_batch) if next_batch is not None else None,
+            "draw": draw,
+            "active": sorted(self.sources),
+            "demoted": {str(k): v for k, v in sorted(self.demoted.items())},
+            "weights": {str(k): float(v) for k, v in self._explicit_weights.items()},
+            "cursors": {
+                str(sid): list(c.to_list()) for sid, c in sorted(cursors.items())
+            },
+            # failure accounting rides the snapshot: without it a resumed
+            # run's demotion would fire demote_after NEW failures later
+            # than the uninterrupted run's, diverging the draw sequence
+            "fail_counts": {
+                str(k): int(v) for k, v in sorted(self.fail_counts.items())
+            },
+            "fail_seen": sorted([int(s), int(i)] for s, i in self._fail_seen),
+            "names": {str(sid): s.name for sid, s in sorted(self.sources.items())},
+        }
+
+    def restore_mixture(self, snap: Dict[str, Any],
+                        mid_epoch: bool = False) -> None:
+        """Re-arm the plane from a durable snapshot.
+
+        ``mid_epoch=True`` (the loader-state sidecar path) additionally
+        restores the per-source cursors + draw index AT the cursor, so the
+        armed (epoch, next_batch) resumes without any skip-replay;
+        otherwise (the epoch-boundary ``mixture_state.json`` path) only the
+        source topology is restored — the next epoch starts at
+        ``snap['epoch'] + 1``, cursors fresh (they are epoch-scoped)."""
+        if not snap:
+            return
+        active = {int(s) for s in snap.get("active", [])}
+        missing = active - set(self.sources) - set(
+            int(k) for k in snap.get("demoted", {})
+        )
+        if missing:
+            raise ValueError(
+                f"mixture snapshot names source ids {sorted(missing)} that "
+                "are not registered in this run — the source fleet changed "
+                "incompatibly; delete the mixture sidecar to start fresh"
+            )
+        # replay removals/demotions the snapshot had already taken
+        for sid in list(self.sources):
+            if sid not in active:
+                reason = snap.get("demoted", {}).get(str(sid))
+                self.sources.pop(sid)
+                self._explicit_weights.pop(sid, None)
+                if reason is not None:
+                    self.demoted[sid] = str(reason)
+        for k, v in (snap.get("weights") or {}).items():
+            if int(k) in self.sources:
+                self._explicit_weights[int(k)] = float(v)
+        for k, v in (snap.get("demoted") or {}).items():
+            self.demoted.setdefault(int(k), str(v))
+        for k, v in (snap.get("fail_counts") or {}).items():
+            self.fail_counts[int(k)] = max(
+                self.fail_counts.get(int(k), 0), int(v)
+            )
+        for s, i in snap.get("fail_seen") or []:
+            self._fail_seen.add((int(s), int(i)))
+        self._refresh_weights()
+        if mid_epoch:
+            if snap.get("draw") is not None:
+                self._armed_cursors = {
+                    int(k): SourceCursor.from_list(v)
+                    for k, v in (snap.get("cursors") or {}).items()
+                }
+                self._armed_draw = int(snap["draw"])
+            # a snapshot without a draw index (journal miss) falls back to
+            # deterministic skip-replay from the epoch start — slower, but
+            # the same sequence by purity
+            if self._resume is None and snap.get("next_batch") is not None:
+                self.resume(int(snap["epoch"]), int(snap["next_batch"]))
+        else:
+            # epoch-boundary snapshot: continue the absolute epoch sequence
+            self._epoch_offset = int(snap.get("epoch", -1)) + 1
+            self.epoch = self._epoch_offset
+
+    # -- the draw/batch stream ----------------------------------------------
+
+    def _draw_one(self, epoch: int, draw: int, cursors: Dict[int, SourceCursor]):
+        """One scheduler draw -> (sid, graph) after validation, or
+        (sid, None) for a skipped draw (the draw index is consumed either
+        way — that is what keeps resume exact across skips)."""
+        if not self.sources:
+            raise MixtureExhaustedError(
+                "every mixture source was removed or quarantine-demoted "
+                f"(demotions: {self.demoted or 'none'}); nothing left to draw"
+            )
+        ids = sorted(self.sources)
+        probs = [self.weights[sid] for sid in ids]
+        sid = draw_source(self.seed, epoch, draw, ids, probs)
+        src = self.sources[sid]
+        cur = cursors.setdefault(sid, SourceCursor())
+        idx = cur.next_index(
+            self.seed, sid, epoch, len(src.graphs),
+            cache=self._perm_caches.setdefault(sid, {}),
+        )
+        g = src.graphs[idx]
+        if self.validator is not None:
+            from ..data.validate import validate_graph
+
+            reason = validate_graph(
+                g, max_nodes=self.spec.n_nodes - 1, max_edges=self.spec.n_edges
+            )
+            if reason is not None:
+                self.validator.reject(
+                    g, idx, reason, source=f"mix:{src.name}",
+                    detail=f"draw-time validation, epoch {epoch} draw {draw}",
+                )
+                self._count_skip(sid, src.name)
+                # plane-level failure accounting dedups per sample so a
+                # small source's one bad graph redrawn every epoch does not
+                # demote it by repetition alone
+                key = (sid, idx)
+                if key not in self._fail_seen:
+                    self._fail_seen.add(key)
+                    self.fail_counts[sid] = self.fail_counts.get(sid, 0) + 1
+                    if (
+                        self.demote_after
+                        and self.fail_counts[sid] >= self.demote_after
+                    ):
+                        self._demote(sid, reason)
+                return sid, None
+        self._count_draw(sid)
+        return sid, g
+
+    def _trip_count_of(self, g: Graph) -> int:
+        got = self._trip_memo.get(id(g))
+        if got is None:
+            got = _triplet_count(g)
+            self._trip_memo[id(g)] = got
+        return got
+
+    def _fill_batch(self, epoch: int, draw: int,
+                    cursors: Dict[int, SourceCursor], build: bool):
+        """Consume draws until ``batch_size`` valid samples accumulated.
+        Returns (graphs, sids, draw'); ``build=False`` advances position
+        only (the skip-replay path of a cursor-less resume — validation,
+        demotion, and tallies still run so the replay reproduces the
+        original run's side effects deterministically)."""
+        graphs: List[Graph] = []
+        sids: List[int] = []
+        filled = 0
+        # safety valve: with demotion disabled (demote_after=0) a fully
+        # rotted fleet would otherwise skip-draw forever
+        budget = self.batch_size + max(
+            20 * sum(len(s.graphs) for s in self.sources.values()), 1000
+        )
+        attempts = 0
+        while filled < self.batch_size:
+            if attempts > budget:
+                raise MixtureExhaustedError(
+                    f"{attempts} consecutive draws produced only {filled} "
+                    f"valid samples (skips per source: {self.epoch_skips}); "
+                    "the active sources are effectively all-invalid — fix "
+                    "the data or enable Mixture.demote_after"
+                )
+            attempts += 1
+            sid, g = self._draw_one(epoch, draw, cursors)
+            draw += 1
+            if g is not None:
+                filled += 1
+                if build:
+                    graphs.append(g)
+                    sids.append(sid)
+        return graphs, sids, draw
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        epoch = self.epoch
+        n_batches = len(self)
+        start = max(int(self.start_batch), 0)
+        self._journal = {}
+        if self._armed_cursors is not None:
+            # sidecar resume: cursors + draw restored AT the armed batch
+            cursors = {k: SourceCursor(*c.to_list())
+                       for k, c in self._armed_cursors.items()}
+            draw = int(self._armed_draw or 0)
+            self._armed_cursors = None
+            self._armed_draw = None
+        else:
+            cursors = {sid: SourceCursor() for sid in self.sources}
+            draw = 0
+            for _ in range(start):  # cursor-less resume: replay, don't build
+                _, _, draw = self._fill_batch(epoch, draw, cursors, build=False)
+        self.cursors = cursors
+        for b in range(start, n_batches):
+            self._journal[b] = {
+                "draw": draw,
+                "cursors": {k: SourceCursor(*c.to_list())
+                            for k, c in cursors.items()},
+            }
+            graphs, sids, draw = self._fill_batch(epoch, draw, cursors, True)
+            # the position AFTER this batch too: a preemption cursor can
+            # point one past the last batch built (lookahead == 0)
+            self._journal[b + 1] = {
+                "draw": draw,
+                "cursors": {k: SourceCursor(*c.to_list())
+                            for k, c in cursors.items()},
+            }
+            spec = self.ladder.select(
+                sum(g.num_nodes for g in graphs),
+                sum(g.num_edges for g in graphs),
+                sum(self._trip_count_of(g) for g in graphs)
+                if self.spec.n_triplets
+                else 0,
+            )
+            if self._fingerprint:
+                print(
+                    f"MIXBATCH e{epoch} b{b} {_fingerprint(graphs, sids)}",
+                    flush=True,
+                )
+            yield batch_graphs(graphs, spec, sort_edges=self.sort_edges)
+
+    def spec_template_batches(self) -> List[Tuple[PadSpec, GraphBatch]]:
+        """Warm-up templates over the ladder levels any mixture batch can
+        select — every source contributes its fitting graphs, so a level
+        only one small source can reach is still covered (the compile
+        plane's zero-retrace contract)."""
+        return _module_templates(
+            self.graphs, self.ladder, sort_edges=self.sort_edges
+        )
+
+    # -- epoch boundary hook (train/loop.py) ---------------------------------
+
+    def mixture_epoch_hook(self, epoch: int, tasks: Dict[str, float],
+                           writer=None, verbosity: int = 0,
+                           log_name: str = "run") -> None:
+        """Called by the epoch loop after each training epoch: logs the
+        per-source draw/skip tally, feeds the per-branch losses (the
+        ``branch<i>`` task scalars the balanced loss emits) into the drift
+        monitor, and mirrors both into the metrics writer."""
+        tally = ", ".join(
+            f"{self.sources[sid].name}={self.epoch_draws.get(sid, 0)}"
+            + (
+                f"(-{self.epoch_skips[sid]} skipped)"
+                if self.epoch_skips.get(sid)
+                else ""
+            )
+            for sid in sorted(self.sources)
+        )
+        demoted = (
+            f"; demoted: {[self.demoted[k] for k in sorted(self.demoted)]}"
+            if self.demoted
+            else ""
+        )
+        if verbosity > 0 or self.epoch_skips or self.demoted:
+            print(
+                f"[{log_name}] epoch {epoch}: mixture draws: "
+                f"{tally or 'none'}{demoted}",
+                file=sys.stderr,
+            )
+        if writer is not None:
+            for sid in sorted(self.sources):
+                name = self.sources[sid].name
+                writer.add_scalar(
+                    f"mix/draws_{name}", float(self.epoch_draws.get(sid, 0)),
+                    epoch,
+                )
+                writer.add_scalar(
+                    f"mix/weight_{name}", float(self.weights.get(sid, 0.0)),
+                    epoch,
+                )
+        branch_losses = {
+            int(k[len("branch"):]): float(v)
+            for k, v in tasks.items()
+            if k.startswith("branch") and k[len("branch"):].isdigit()
+        }
+        if branch_losses:
+            self.drift.update(epoch, branch_losses, writer=writer)
+        self.epoch_draws = {}
+        self.epoch_skips = {}

@@ -1,0 +1,121 @@
+"""Deterministic temperature-sampled source scheduler (docs/GFM.md).
+
+Every random decision is a pure function of small integers, never of
+process history:
+
+- the source picked at draw ``k`` of epoch ``e`` depends only on
+  ``(seed, e, k)`` plus the active source set/weights AT that draw;
+- the within-source sample order is a pure permutation of
+  ``(seed, source id, e, pass)`` — a source drawn more often than its size
+  wraps into its next reshuffled pass.
+
+That purity is what makes mixture resume exact (docs/GFM.md "Resume"):
+given the sidecar's (epoch, draw, per-source cursors, active set, weights),
+any process replays the remaining draw sequence bit-for-bit — there is no
+RNG object whose hidden state a SIGKILL could lose.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def temperature_weights(
+    sizes: Dict[int, int],
+    temperature: float,
+    explicit: Optional[Dict[int, float]] = None,
+) -> Dict[int, float]:
+    """Normalized draw probabilities over sources: p_i ∝ w_i^(1/T), with
+    w_i = m_i * |D_i| where ``explicit`` supplies per-source MULTIPLIERS
+    m_i (default 1) — ``{"ds2": 5.0}`` means 5x ds2's natural share, so a
+    user-scale knob never competes against the other sources' raw sample
+    counts. Renormalization over exactly the keys of ``sizes`` is how
+    weights track sources coming and going (hot add/remove/demotion)."""
+    if not sizes:
+        return {}
+    inv_t = 1.0 / float(temperature)
+    raw = {}
+    for sid, n in sizes.items():
+        base = float(n)
+        if explicit and sid in explicit:
+            base *= float(explicit[sid])
+        raw[sid] = max(base, 0.0) ** inv_t
+    total = sum(raw.values())
+    if total <= 0:
+        raise ValueError(
+            f"all mixture source weights collapsed to zero: sizes={sizes}"
+        )
+    return {sid: w / total for sid, w in raw.items()}
+
+
+def draw_source(
+    seed: int, epoch: int, draw: int, ids: Sequence[int],
+    probs: Sequence[float],
+) -> int:
+    """The source drawn at position ``draw`` of epoch ``epoch`` — pure in
+    (seed, epoch, draw) given the active (ids, probs). ``ids``/``probs``
+    must be aligned; ids order matters and callers pass them sorted so
+    every process agrees."""
+    u = np.random.default_rng(
+        [int(seed) & 0x7FFFFFFF, int(epoch), int(draw)]
+    ).random()
+    acc = 0.0
+    for sid, p in zip(ids, probs):
+        acc += p
+        if u < acc:
+            return int(sid)
+    return int(ids[-1])  # float-sum tail
+
+
+def source_permutation(
+    seed: int, sid: int, epoch: int, pass_idx: int, n: int
+) -> np.ndarray:
+    """Within-source sample order for one pass — pure in its arguments, so
+    a cursor (pass, offset) fully locates the next sample."""
+    rng = np.random.default_rng(
+        [int(seed) & 0x7FFFFFFF, 0x5EED, int(sid), int(epoch), int(pass_idx)]
+    )
+    return rng.permutation(int(n))
+
+
+class SourceCursor:
+    """Position inside one source's (epoch-scoped) sample stream."""
+
+    __slots__ = ("pass_idx", "offset")
+
+    def __init__(self, pass_idx: int = 0, offset: int = 0):
+        self.pass_idx = int(pass_idx)
+        self.offset = int(offset)
+
+    def to_list(self) -> Tuple[int, int]:
+        return (self.pass_idx, self.offset)
+
+    @staticmethod
+    def from_list(v) -> "SourceCursor":
+        return SourceCursor(int(v[0]), int(v[1]))
+
+    def next_index(
+        self, seed: int, sid: int, epoch: int, n: int, cache: Optional[dict] = None
+    ) -> int:
+        """Sample index of the next draw from this source; advances the
+        cursor (wrapping into a fresh pure-permutation pass). ``cache`` is
+        a PER-SOURCE dict memoizing the live pass's permutation so a draw
+        costs O(1) after the first of its pass (stale passes are evicted —
+        only the live one is ever re-read)."""
+        if n <= 0:
+            raise ValueError(f"source {sid} is empty")
+        if self.offset >= n:
+            self.pass_idx += 1
+            self.offset = 0
+        key = (int(sid), int(epoch), self.pass_idx)
+        perm = cache.get(key) if cache is not None else None
+        if perm is None or len(perm) != n:
+            perm = source_permutation(seed, sid, epoch, self.pass_idx, n)
+            if cache is not None:
+                cache.clear()  # one live pass per source is enough
+                cache[key] = perm
+        idx = int(perm[self.offset])
+        self.offset += 1
+        return idx

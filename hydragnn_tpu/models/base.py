@@ -68,6 +68,13 @@ class ModelConfig:
     graph_head: Optional[GraphHeadConfig] = None
     node_head: Optional[NodeHeadConfig] = None
     num_branches: int = 1
+    # static per-branch loss balancing (GFM mixture training, mix/balance.py;
+    # planted into the Architecture section by the Mixture config section):
+    # every graph's loss contribution is weighted by its branch's entry
+    # (normalized to mean 1), and branch_loss_metrics adds per-branch loss
+    # scalars (`branch<i>` task entries) for the drift monitor
+    branch_loss_weights: Optional[Tuple[float, ...]] = None
+    branch_loss_metrics: bool = False
     activation: str = "relu"
     loss_function_type: str = "mse"
     # --- GPS global attention

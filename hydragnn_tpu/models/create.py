@@ -99,6 +99,12 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         graph_head=graph_head,
         node_head=node_head,
         num_branches=num_branches,
+        branch_loss_weights=(
+            tuple(float(w) for w in arch["branch_loss_weights"])
+            if arch.get("branch_loss_weights")
+            else None
+        ),
+        branch_loss_metrics=bool(arch.get("branch_loss_metrics", False)),
         activation=arch.get("activation_function", "relu"),
         loss_function_type=loss_type,
         global_attn_engine=arch.get("global_attn_engine") or "",

@@ -44,12 +44,17 @@ EV_DRAIN = "serve_drain"                  # graceful drain initiated
 EV_RELOAD_SWAP = "reload_swap"            # hot reload installed a checkpoint
 EV_RELOAD_REJECT = "reload_reject"        # hot reload rejected a candidate
 EV_FLIGHT_DUMP = "flightrec_dump"         # the recorder itself dumped
+EV_MIX_SOURCE_ADD = "mix_source_add"      # mixture source hot-added
+EV_MIX_SOURCE_REMOVE = "mix_source_remove"  # mixture source hot-removed
+EV_MIX_DEMOTE = "mix_demote"              # source quarantine-demoted (mix/)
+EV_MIX_DRIFT = "mix_drift"                # per-branch loss diverged past threshold
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
     EV_RETRACE_VIOLATION, EV_CACHE_MISS, EV_LOADER_STALL, EV_CKPT_WRITE,
     EV_SHED, EV_QUEUE_FULL, EV_DEADLINE, EV_WEDGE, EV_DRAIN,
     EV_RELOAD_SWAP, EV_RELOAD_REJECT, EV_FLIGHT_DUMP,
+    EV_MIX_SOURCE_ADD, EV_MIX_SOURCE_REMOVE, EV_MIX_DEMOTE, EV_MIX_DRIFT,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")

@@ -125,8 +125,15 @@ def _bank_size(params) -> int:
 def _local_model(model, b_local: int):
     """Rebuild the model for a local branch slice. Works for any model whose
     decoders are branch BANKS (HydraModel heads, MACEModel readouts) —
-    identical module tree, bank leaves sliced by the shard_map specs."""
-    cfg = dataclasses.replace(model.cfg, num_branches=b_local)
+    identical module tree, bank leaves sliced by the shard_map specs.
+    Branch-loss balancing is stripped from the LOCAL cfg: the global weight
+    vector does not slice with the remapped local dataset ids, so the mesh
+    step applies balancing to the decoder gradient scales instead (the
+    per-branch effective-LR equivalent; see make_branch_parallel_train_step)."""
+    cfg = dataclasses.replace(
+        model.cfg, num_branches=b_local,
+        branch_loss_weights=None, branch_loss_metrics=False,
+    )
     return type(model)(cfg=cfg)
 
 
@@ -230,6 +237,13 @@ def make_branch_parallel_train_step(
         scale_dec_vec = (
             n * mesh.shape[DATA_AXIS] / jnp.maximum(branch_tot, 1.0)
         )
+        if cfg.branch_loss_weights:
+            # static per-branch loss balancing (Mixture.branch_loss_weights,
+            # mix/balance.py): scale each branch's decoder gradient by its
+            # weight — this device's b_local-slice of the global vector
+            w_all = jnp.asarray(cfg.branch_loss_weights, jnp.float32)
+            w_local = jax.lax.dynamic_slice(w_all, (br * b_local,), (b_local,))
+            scale_dec_vec = scale_dec_vec * w_local
         grads = _mixed_pmean(grads, scale_enc, scale_dec_vec)
         tot = jax.lax.pmean(tot * scale_enc, _BOTH)
         tasks = jax.lax.pmean(
@@ -370,11 +384,18 @@ class BranchRoutedLoader:
     """Stacked-batch loader whose shard rows are grouped by branch block.
 
     Wraps one ``GraphLoader`` per branch (each over that branch's graphs,
-    with ``rows = num_shards / branch_count`` device rows) and concatenates
-    their stacked batches in branch-major order — matching the (branch,
-    data) mesh flattening, so shard row ``r`` lands on mesh position
-    ``(r // data_size, r % data_size)``. The per-branch loaders share one
-    worst-case PadSpec so rows stack into one array.
+    with ``rows = num_shards / branch_count`` device rows) and stacks their
+    rows in branch-major order — matching the (branch, data) mesh
+    flattening, so shard row ``r`` lands on mesh position
+    ``(r // data_size, r % data_size)``.
+
+    ``spec`` may be a single worst-case ``PadSpec`` (every batch padded to
+    it — the pre-r10 behavior) or a ``SpecLadder``: each batch is then
+    padded to the smallest level fitting its LARGEST row, so small-graph
+    steps stop paying worst-case padding. Single-host only — every row of
+    a batch must share one static shape, and on multi-host runs the level
+    choice would have to agree across processes without a collective, so
+    ``host_count > 1`` collapses the ladder to its worst level.
 
     The analog of the reference's per-branch datasets + uneven process
     groups (examples/multibranch/train.py:166-213).
@@ -435,17 +456,26 @@ class BranchRoutedLoader:
         served = sorted(set(row_branch))
         by_branch = {i: [g for g in graphs if g.dataset_id == i] for i in ids}
         n_max = max(len(b) for b in by_branch.values())
-        # one shared worst-case spec so all branch rows stack; per-shard
-        # graph count is identical for every row by construction. Callers
-        # building train/val/test loaders should pass ONE ``spec`` computed
-        # over all splits so eval reuses the train step's compilation.
+        # per-shard graph count is identical for every row by construction.
+        # Callers building train/val/test loaders should pass ONE ``spec``
+        # (ladder) computed over all splits so eval reuses the train step's
+        # compilations.
         assert batch_size % L == 0
         per_row_bs = batch_size // L
         if spec is None:
-            ladder = SpecLadder.for_dataset(
+            spec = SpecLadder.for_dataset(
                 list(graphs), max(per_row_bs, 1), num_buckets=1
             )
-            spec = ladder.specs[-1]
+        if not isinstance(spec, SpecLadder):
+            spec = SpecLadder((spec,))
+        if host_count > 1 and len(spec.specs) > 1:
+            # per-batch level selection is a per-host decision; across hosts
+            # the collective step needs identical global shapes, and
+            # agreeing on max-over-all-hosts would cost a collective per
+            # batch — multi-host keeps the worst-case single level
+            spec = SpecLadder((spec.specs[-1],))
+        self.ladder = spec
+        spec = spec.specs[-1]  # worst case: sub-loader budget + validator cap
         self.loaders: List = []
         for b in served:
             rows_b = row_branch.count(b)  # local rows serving branch b
@@ -472,6 +502,9 @@ class BranchRoutedLoader:
                 )
             )
         self.graphs = list(graphs)
+        # per-graph triplet counts, memoized by id (DimeNet ladders budget
+        # the triplet channel; _triplet_count is O(E) interpreted python)
+        self._trip_memo: dict = {}
         self.batch_size = batch_size
         self.num_shards = L
         self.host_count = host_count
@@ -493,30 +526,76 @@ class BranchRoutedLoader:
         self._len = max(steps)
         self._templates: dict = {}
 
-    def _empty_rows(self, rows_b: int):
-        """All-padding stacked rows [rows_b, ...]: masks false, edges/nodes
-        parked on the dummy slots (the GraphLoader stacked-path template
-        convention, data/pipeline.py _make)."""
-        if rows_b not in self._templates:
-            from ..data.graph import batch_graphs_np, graph_batch_from_np
+    def _trip_count_of(self, g) -> int:
+        from ..data.graph import _triplet_count
 
-            arrs = batch_graphs_np([self.graphs[0]], self.spec)
-            z = {k: np.zeros_like(v) for k, v in arrs.items()}
-            z["senders"] = np.full_like(arrs["senders"], self.spec.n_nodes - 1)
-            z["receivers"] = z["senders"].copy()
-            z["node_graph"] = np.full_like(
-                arrs["node_graph"], self.spec.n_graphs - 1
+        got = self._trip_memo.get(id(g))
+        if got is None:
+            got = _triplet_count(g)
+            self._trip_memo[id(g)] = got
+        return got
+
+    def _filler_arrs(self, spec):
+        """One all-padding row's array dict at ``spec``: masks false,
+        edges/nodes parked on the dummy slots (the GraphLoader stacked-path
+        template convention, data/pipeline.py _make_stacked)."""
+        from ..data.graph import batch_graphs_np
+
+        key = spec
+        if key not in self._templates:
+            g = next(
+                (
+                    c
+                    for c in self.graphs
+                    if c.num_nodes <= spec.n_nodes - 1
+                    and c.num_edges <= spec.n_edges
+                ),
+                self.graphs[0],
             )
-            stacked = {k: np.stack([v] * rows_b) for k, v in z.items()}
-            self._templates[rows_b] = graph_batch_from_np(stacked)
-        return self._templates[rows_b]
+            arrs = batch_graphs_np([g], spec)
+            z = {k: np.zeros_like(v) for k, v in arrs.items()}
+            z["senders"] = np.full_like(arrs["senders"], spec.n_nodes - 1)
+            z["receivers"] = z["senders"].copy()
+            z["node_graph"] = np.full_like(arrs["node_graph"], spec.n_graphs - 1)
+            self._templates[key] = z
+        return self._templates[key]
+
+    def _stack_rows(self, rows, spec):
+        """Stack per-row padded batches (branch-major row order preserved);
+        empty rows become all-padding fillers at the same spec."""
+        from ..data.graph import batch_graphs_np, graph_batch_from_np
+
+        arr_list = [
+            batch_graphs_np(r, spec, sort_edges=self.sort_edges)
+            if r
+            else self._filler_arrs(spec)
+            for r in rows
+        ]
+        stacked = {
+            k: np.stack([a[k] for a in arr_list]) for k in arr_list[0]
+        }
+        return graph_batch_from_np(stacked)
 
     def spec_template_batches(self):
-        """Compile-plane warm-up template (train/compile_plane.py): one
-        shared worst-case spec means ONE stacked specialization; the
-        all-padding row template has exactly the shapes/dtypes of a real
-        branch-routed batch."""
-        return [(self.spec, self._empty_rows(self.num_shards))]
+        """Compile-plane warm-up templates (train/compile_plane.py): one
+        stacked specialization per ladder level ANY branch can land a row
+        in. Pre-r10 this was the single worst-case spec for all branches —
+        warm-up then missed every smaller level a branch's batches actually
+        select, and the first small-graph step of each level retraced.
+        Filler rows fit any level, so the cover is the UNION of the
+        per-branch selectable sets (data/pipeline.selectable_levels)."""
+        from ..data.pipeline import selectable_levels
+
+        by_level = {}
+        for l in self.loaders:
+            for li, g in selectable_levels(l.graphs, self.ladder):
+                by_level.setdefault(li, g)
+        out = []
+        for li in sorted(by_level):
+            spec = self.ladder.specs[li]
+            rows = [[by_level[li]]] + [[] for _ in range(self.num_shards - 1)]
+            out.append((spec, self._stack_rows(rows, spec)))
+        return out
 
     def set_epoch(self, epoch: int) -> None:
         for l in self.loaders:
@@ -526,20 +605,31 @@ class BranchRoutedLoader:
         return self._len
 
     def __iter__(self) -> Iterator:
-        its = [iter(l) for l in self.loaders]
-        for _ in range(len(self)):
+        # sub-loaders contribute their deterministic (seed, epoch) index
+        # streams; rows are built HERE so one ladder level can be selected
+        # per stacked batch (the smallest level fitting the largest row)
+        streams = []
+        for l in self.loaders:
+            idx = l._local_indices()
+            streams.append((l, idx, len(idx) // l.batch_size))
+        for step in range(len(self)):
             rows = []
-            for it, loader in zip(its, self.loaders):
-                nxt = next(it, None)
-                if nxt is None:  # branch exhausted: zero-weight filler rows
-                    nxt = self._empty_rows(loader.num_shards)
-                elif loader.num_shards == 1:
-                    # a single-row sub-loader emits unstacked batches
-                    # (GraphLoader contract); restore the row axis
-                    nxt = jax.tree_util.tree_map(
-                        lambda x: np.asarray(x)[None], nxt
-                    )
-                rows.append(nxt)
-            yield jax.tree_util.tree_map(
-                lambda *xs: np.concatenate(xs, axis=0), *rows
+            for l, idx, n_full in streams:
+                rows_b = l.num_shards
+                if step < n_full:
+                    sl = idx[step * l.batch_size : (step + 1) * l.batch_size]
+                    graphs = [l.graphs[i] for i in sl]
+                    rows.extend(graphs[s::rows_b] for s in range(rows_b))
+                else:  # branch exhausted: zero-weight filler rows
+                    rows.extend([] for _ in range(rows_b))
+            spec = self.ladder.select(
+                max((sum(g.num_nodes for g in r) for r in rows if r), default=0),
+                max((sum(g.num_edges for g in r) for r in rows if r), default=0),
+                max(
+                    (sum(self._trip_count_of(g) for g in r) for r in rows if r),
+                    default=0,
+                )
+                if self.spec.n_triplets
+                else 0,
             )
+            yield self._stack_rows(rows, spec)

@@ -46,6 +46,7 @@ from .state import InferenceState, LoaderState, TrainState
 
 _EPOCH_RE = re.compile(r"_epoch(\d+)\.msgpack$")
 _LOADER_STATE_FILE = "loader_state.json"
+_MIXTURE_STATE_FILE = "mixture_state.json"
 
 
 def _run_dir(log_name: str, path: str = "./logs") -> str:
@@ -320,6 +321,52 @@ def load_loader_state(
         warnings.warn(
             f"loader-state sidecar {fname} is unreadable ({e}); resuming at "
             "epoch granularity instead of mid-epoch",
+            stacklevel=2,
+        )
+        return None
+
+
+def save_mixture_state(
+    snapshot: dict, log_name: str, path: str = "./logs"
+) -> str:
+    """Publish the mixture-plane snapshot (``mixture_state.json``) beside
+    the checkpoint (docs/GFM.md "Resume"): active/demoted source sets,
+    explicit weights, per-source cursors, absolute (epoch, draw). Unlike
+    the loader-state sidecar it is NOT cleared at epoch boundaries — a
+    SIGKILL at any point resumes the source topology from the last
+    committed save (the sampler itself is pure, mix/sampler.py). Written
+    atomically; rank-gated like the other sidecars."""
+    import json
+
+    import jax
+
+    if jax.process_index() != 0:
+        return ""
+    d = _run_dir(log_name, path)
+    fname = os.path.join(d, _MIXTURE_STATE_FILE)
+    atomic_write(fname, json.dumps(snapshot).encode("utf-8"))
+    return fname
+
+
+def load_mixture_state(log_name: str, path: str = "./logs") -> Optional[dict]:
+    """Read a run's mixture snapshot, or None (no mixture / fresh run). A
+    malformed snapshot degrades to fresh mixture topology with a warning —
+    it must never block the model restore."""
+    import json
+
+    fname = os.path.join(path, log_name, _MIXTURE_STATE_FILE)
+    if not os.path.exists(fname):
+        return None
+    try:
+        with open(fname, encoding="utf-8") as f:
+            snap = json.load(f)
+        if not isinstance(snap, dict):
+            raise ValueError(f"expected a JSON object, got {type(snap).__name__}")
+        return snap
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        warnings.warn(
+            f"mixture-state sidecar {fname} is unreadable ({e}); resuming "
+            "with the fresh source topology instead",
             stacklevel=2,
         )
         return None

@@ -259,18 +259,39 @@ def device_prefetch(iterator, depth: int = 2, device=None):
         stop.set()
 
 
-def _maybe_device_prefetch(iterator):
+def _maybe_device_prefetch(iterator, depth: Optional[int] = None):
     """Wrap with device_prefetch on single-device runs (multi-device batch
-    placement belongs to the parallel step); HYDRAGNN_DEVICE_PREFETCH=0
-    disables, a positive value sets the queue depth."""
-    depth = int(os.getenv("HYDRAGNN_DEVICE_PREFETCH", "2"))
-    if depth <= 0 or jax.local_device_count() > 1 or jax.process_count() > 1:
+    placement belongs to the parallel step). ``depth`` comes from
+    ``Training.double_buffer`` (true = 2, false = off, an int = that
+    queue depth); the HYDRAGNN_DEVICE_PREFETCH env always wins (0
+    disables), and None means "no config reached here" — the historical
+    env-or-2 default, so direct callers keep their behavior."""
+    env = os.getenv("HYDRAGNN_DEVICE_PREFETCH")
+    if env is not None:
+        depth = int(env)
+    elif depth is None:
+        depth = 2
+    active = (
+        depth > 0
+        and jax.local_device_count() == 1
+        and jax.process_count() == 1
+    )
+    try:  # the telemetry smoke's A/B assertion reads this gauge
+        from ..obs.registry import registry
+
+        registry().gauge(
+            "hydragnn_device_prefetch_depth",
+            "Double-buffered device_put queue depth (0 = staging inline)",
+        ).set(float(depth if active else 0))
+    except Exception:
+        pass
+    if not active:
         return iterator
     return device_prefetch(iterator, depth=depth)
 
 
 def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
-                telemetry=None, tracer=None):
+                telemetry=None, tracer=None, prefetch_depth=None):
     """One training epoch. Returns ``(state, tot, tasks, rng, cursor)``:
     ``cursor`` is None when the epoch completed, or the next-batch offset
     (loader-absolute) when a SIGTERM arrived between steps — the mid-epoch
@@ -305,7 +326,7 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
     check_preempt = jax.process_count() == 1
     cursor = None
     consumed = 0
-    it = _maybe_device_prefetch(iter(loader))
+    it = _maybe_device_prefetch(iter(loader), depth=prefetch_depth)
     for i in range(len(loader)):
         # dataload span covers host batching + H2D staging (the reference's
         # per-step data.to(device), train_validate_test.py:506-514; here the
@@ -382,9 +403,9 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
     return state, tot, tasks, rng, cursor
 
 
-def evaluate(loader, eval_fn, state):
+def evaluate(loader, eval_fn, state, prefetch_depth=None):
     entries = []
-    for batch in _maybe_device_prefetch(iter(loader)):
+    for batch in _maybe_device_prefetch(iter(loader), depth=prefetch_depth):
         tot, tasks, _ = eval_fn(state, batch)
         n = int(np.asarray(batch.graph_mask).sum())
         entries.append((tot, tasks, n))
@@ -614,6 +635,11 @@ def train_validate_test(
     # steady state. The plateau scheduler only engages after the ramp.
     warmup_epochs = int(training.get("warmup_epochs", 0))
     base_lr = float(state.learning_rate)
+    # Training.double_buffer -> device-staging queue depth (ROADMAP #3 H2D
+    # overlap): true = depth 2, false = inline device_put, int = depth.
+    # HYDRAGNN_DEVICE_PREFETCH still wins inside _maybe_device_prefetch.
+    db = training.get("double_buffer", True)
+    prefetch_depth = 0 if not db else (2 if db is True else int(db))
     # data-plane skip tally dedup: log at the epoch boundary only when the
     # run-level count changed (ingest skips report once, at epoch 0)
     reported_skips = 0
@@ -644,9 +670,18 @@ def train_validate_test(
             with tr.timer("train"):
                 state, tr_loss, tr_tasks, rng, cursor = train_epoch(
                     train_loader, step_fn, state, rng, telemetry=telemetry,
-                    tracer=tracer,
+                    tracer=tracer, prefetch_depth=prefetch_depth,
                 )
             hist["train"].append(tr_loss)
+            # mixture plane (mix/plane.py): per-source draw/skip tallies +
+            # the per-branch loss drift monitor, at the epoch boundary the
+            # loop already syncs on
+            mix_hook = getattr(train_loader, "mixture_epoch_hook", None)
+            if mix_hook is not None:
+                mix_hook(
+                    epoch, tr_tasks, writer=writer, verbosity=verbosity,
+                    log_name=log_name,
+                )
             # data-plane skip tally (data/validate.py): whenever the run's
             # validator has dropped samples, say so at the epoch boundary —
             # silent data loss is not an option (docs/ROBUSTNESS.md)
@@ -775,9 +810,15 @@ def train_validate_test(
 
             if do_valtest:
                 with tr.timer("validate"):
-                    va_loss, _ = evaluate(val_loader, eval_fn, state)
+                    va_loss, _ = evaluate(
+                        val_loader, eval_fn, state,
+                        prefetch_depth=prefetch_depth,
+                    )
                 with tr.timer("test"):
-                    te_loss, _ = evaluate(test_loader, eval_fn, state)
+                    te_loss, _ = evaluate(
+                        test_loader, eval_fn, state,
+                        prefetch_depth=prefetch_depth,
+                    )
             else:
                 va_loss = te_loss = tr_loss
             hist["val"].append(va_loss)

@@ -31,8 +31,19 @@ def _elementwise(loss_type: str, err: jnp.ndarray) -> jnp.ndarray:
     )
 
 
-def masked_mean(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+def masked_mean(
+    values: jnp.ndarray, mask: jnp.ndarray, row_weights=None
+) -> jnp.ndarray:
+    """Mean over real rows. ``row_weights`` (optional, per-row) turns it
+    into the weighted mean Σ w·m·v / Σ w·m·C — the per-branch loss
+    balancing hook (docs/GFM.md): with all weights 1 (or None) the
+    computation is byte-identical to the unweighted path."""
     m = mask.reshape(mask.shape + (1,) * (values.ndim - mask.ndim)).astype(values.dtype)
+    if row_weights is not None:
+        w = row_weights.reshape(
+            row_weights.shape + (1,) * (values.ndim - row_weights.ndim)
+        ).astype(values.dtype)
+        m = m * w
     denom = jnp.maximum(jnp.sum(m) * values.shape[-1], 1.0)
     return jnp.sum(values * m) / denom
 
@@ -42,9 +53,10 @@ def head_loss(
     target: jnp.ndarray,
     mask: jnp.ndarray,
     loss_type: str,
+    row_weights=None,
 ) -> jnp.ndarray:
     per_elem = _elementwise(loss_type, pred - target)
-    loss = masked_mean(per_elem, mask)
+    loss = masked_mean(per_elem, mask, row_weights)
     if loss_type.lower() == "rmse":
         loss = jnp.sqrt(loss)
     return loss
@@ -56,13 +68,38 @@ def gaussian_nll(
     target: jnp.ndarray,
     mask: jnp.ndarray,
     eps: float = 1e-6,
+    row_weights=None,
 ) -> jnp.ndarray:
     """Gaussian negative log likelihood with predicted variance
     (torch GaussianNLLLoss semantics, full=False; reference wires the variance
     head via var_output, Base.py:92-96 and the `headvar = out**2` split)."""
     v = jnp.maximum(var, eps)
     per_elem = 0.5 * (jnp.log(v) + (pred - target) ** 2 / v)
-    return masked_mean(per_elem, mask)
+    return masked_mean(per_elem, mask, row_weights)
+
+
+def _per_branch_head_loss(
+    per_elem: jnp.ndarray,
+    mask: jnp.ndarray,
+    branch_of_row: jnp.ndarray,
+    num_branches: int,
+    loss_type: str,
+) -> jnp.ndarray:
+    """[num_branches] masked mean of one head's per-element loss, reduced
+    per branch — the in-graph per-branch loss census the mixture drift
+    monitor consumes (mix/balance.py). Costs two segment-sums per head."""
+    m = mask.reshape(
+        mask.shape + (1,) * (per_elem.ndim - mask.ndim)
+    ).astype(per_elem.dtype)
+    row_num = jnp.sum(per_elem * m, axis=tuple(range(1, per_elem.ndim)))
+    row_den = jnp.sum(m, axis=tuple(range(1, m.ndim))) * per_elem.shape[-1]
+    seg = jnp.clip(branch_of_row.astype(jnp.int32), 0, num_branches - 1)
+    num = jax.ops.segment_sum(row_num, seg, num_segments=num_branches)
+    den = jax.ops.segment_sum(row_den, seg, num_segments=num_branches)
+    out = num / jnp.maximum(den, 1.0)
+    if loss_type.lower() == "rmse":
+        out = jnp.sqrt(out)
+    return out
 
 
 def compute_loss(
@@ -199,23 +236,65 @@ def multitask_loss(
     cfg: ModelConfig,
 ) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
     """Total weighted loss + per-task unweighted losses
-    (reference: loss_hpweighted, Base.py:659-686)."""
+    (reference: loss_hpweighted, Base.py:659-686).
+
+    Multibranch models with ``cfg.branch_loss_weights`` set (planted by
+    the Mixture config section, mix/balance.py) weight every graph's loss
+    contribution by its branch's static weight — the in-graph half of
+    per-branch loss balancing; ``cfg.branch_loss_metrics`` additionally
+    emits per-branch total-loss scalars as ``branch<i>`` task entries, so
+    the drift monitor gets its census through the loop's existing
+    device-side bookkeeping (no extra host syncs)."""
     weights = cfg.normalized_task_weights
+    B = int(cfg.num_branches)
+    blw = cfg.branch_loss_weights if B > 1 else None
+    graph_branch = batch.dataset_id.astype(jnp.int32)
+    gw = None
+    if blw:
+        w_arr = jnp.asarray(blw, jnp.float32)
+        gw = w_arr[jnp.clip(graph_branch, 0, B - 1)]
+    want_branch = B > 1 and cfg.branch_loss_metrics
     tot = 0.0
     tasks: Dict[str, jnp.ndarray] = {}
+    branch_tot = jnp.zeros((B,), jnp.float32) if want_branch else None
     for name, t, w in zip(cfg.output_names, cfg.output_type, weights):
         pred = outputs[name]
         if t == "graph":
             target = batch.graph_targets[name]
             mask = batch.graph_mask
+            branch_of_row = graph_branch
         else:
             target = batch.node_targets[name]
             mask = batch.node_mask
+            branch_of_row = graph_branch[batch.node_graph]
         target = target.reshape(pred.shape)
+        row_w = None if gw is None else (
+            gw if t == "graph" else gw[batch.node_graph]
+        )
         if cfg.var_output:
-            task = gaussian_nll(pred, outputs[f"{name}__var"], target, mask)
+            task = gaussian_nll(
+                pred, outputs[f"{name}__var"], target, mask, row_weights=row_w
+            )
         else:
-            task = head_loss(pred, target, mask, cfg.loss_function_type)
+            task = head_loss(
+                pred, target, mask, cfg.loss_function_type, row_weights=row_w
+            )
         tasks[name] = task
         tot = tot + w * task
+        if want_branch:
+            if cfg.var_output:
+                # gaussian-NLL census: same per-element formula the head
+                # loss reduces, never the rmse sqrt
+                v = jnp.maximum(outputs[f"{name}__var"], 1e-6)
+                per_elem = 0.5 * (jnp.log(v) + (pred - target) ** 2 / v)
+                per_branch_type = "mse"
+            else:
+                per_elem = _elementwise(cfg.loss_function_type, pred - target)
+                per_branch_type = cfg.loss_function_type
+            branch_tot = branch_tot + w * _per_branch_head_loss(
+                per_elem, mask, branch_of_row, B, per_branch_type
+            )
+    if want_branch:
+        for b in range(B):
+            tasks[f"branch{b}"] = branch_tot[b]
     return tot, tasks

@@ -102,9 +102,17 @@ class LoaderState:
     next_batch: int
     seed: int = 0
     num_batches: int = 0
+    # mixture extension (mix/plane.py MixturePlane.state_dict): active
+    # source set, explicit weights, per-source cursors, and the absolute
+    # draw index — everything the temperature sampler needs to replay the
+    # remaining draw sequence exactly. None for plain GraphLoaders.
+    mixture: Optional[Dict[str, Any]] = None
 
-    def to_dict(self) -> Dict[str, int]:
-        return dataclasses.asdict(self)
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        if d.get("mixture") is None:
+            d.pop("mixture", None)
+        return d
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "LoaderState":
@@ -113,4 +121,5 @@ class LoaderState:
             next_batch=int(d["next_batch"]),
             seed=int(d.get("seed", 0)),
             num_batches=int(d.get("num_batches", 0)),
+            mixture=d.get("mixture") or None,
         )

@@ -24,6 +24,14 @@ trajectory with one:
    ``--write-trace-baseline``). A stage whose p50 or p99 exceeds
    baseline × (1 + ``--trace-threshold``) fails the gate.
 
+3. **mixture cells** (opt-in: ``--mix-cells logs/mix_cells.jsonl``) — the
+   newest ``BENCH_MIX`` record (bench.py main_mix) vs the previous one:
+   every ``*graphs_per_sec*`` key is higher-is-better (same threshold as
+   the bench cells), every ``*drift*`` key is LOWER-is-better (a
+   per-branch loss-drift maximum that grows past the threshold means a
+   branch is starving under the mixture weights). Fewer than two records
+   is "nothing to compare" (fails only under ``--strict``).
+
 Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 ``--strict`` additionally fails (exit 1) when there is nothing comparable
 (fewer than two valid rounds / empty cell intersection), so a wiring bug
@@ -141,6 +149,82 @@ def gate_bench(
 
 
 # ---------------------------------------------------------------------------
+# mixture cells (bench.py main_mix -> logs/mix_cells.jsonl)
+# ---------------------------------------------------------------------------
+
+MIX_HIGHER_RE = re.compile(r"graphs_per_sec")
+MIX_LOWER_RE = re.compile(r"drift")
+
+
+def load_mix_records(path: str) -> List[Dict[str, float]]:
+    """Parsed numeric cells of every valid mix_cells.jsonl record, in file
+    order (one record per BENCH_MIX invocation)."""
+    out: List[Dict[str, float]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            cells = {
+                k: float(v)
+                for k, v in rec.items()
+                if _is_number(v)
+                and (MIX_HIGHER_RE.search(k) or MIX_LOWER_RE.search(k))
+            }
+            if cells:
+                out.append(cells)
+    return out
+
+
+def gate_mix(
+    records: List[Dict[str, float]], threshold: float
+) -> Tuple[List[str], List[str]]:
+    """Newest mixture record vs the previous one: throughput keys must not
+    drop, drift keys must not grow, beyond ``threshold``."""
+    report: List[str] = []
+    if len(records) < 2:
+        report.append(
+            f"bench_gate[mix]: {len(records)} record(s) — nothing to compare"
+        )
+        return [], report
+    cand, base = records[-1], records[-2]
+    failures: List[str] = []
+    for key in sorted(set(cand) & set(base)):
+        have, want = cand[key], base[key]
+        if want <= 0:
+            continue
+        if MIX_LOWER_RE.search(key):
+            growth = (have - want) / want
+            line = (
+                f"bench_gate[mix]: {key!r} = {have:g} vs {want:g} "
+                f"({growth:+.1%}, lower is better)"
+            )
+            bad = growth > threshold
+        else:
+            drop = (want - have) / want
+            line = (
+                f"bench_gate[mix]: {key!r} = {have:g} vs {want:g} ({-drop:+.1%})"
+            )
+            bad = drop > threshold
+        if bad:
+            failures.append(
+                line + f" — REGRESSION beyond the {threshold:.0%} threshold"
+            )
+        else:
+            report.append(line + " ok")
+    if not (set(cand) & set(base)):
+        report.append(
+            "bench_gate[mix]: no shared cell between the newest two records "
+            "— nothing compared"
+        )
+    return failures, report
+
+
+# ---------------------------------------------------------------------------
 # trace-derived stage timings
 # ---------------------------------------------------------------------------
 
@@ -227,6 +311,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="max tolerated relative drop per bench cell")
     ap.add_argument("--strict", action="store_true",
                     help="fail when nothing was comparable")
+    ap.add_argument("--mix-cells", default=None, metavar="PATH",
+                    help="mix_cells.jsonl (BENCH_MIX) to gate mixture "
+                         "throughput/drift: newest record vs the previous; "
+                         "missing file is skipped (first CI run)")
+    ap.add_argument("--mix-threshold", type=float, default=None,
+                    help="max tolerated relative change per mixture cell "
+                         "(default: --threshold)")
     ap.add_argument("--trace", default=None,
                     help="trace.jsonl to gate stage timings from")
     ap.add_argument("--trace-baseline", default=None,
@@ -247,6 +338,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     compared_something |= bool(bench_failures)
     for line in report:
         print(line)
+
+    if args.mix_cells is not None:
+        if os.path.exists(args.mix_cells):
+            records = load_mix_records(args.mix_cells)
+            m_failures, m_report = gate_mix(
+                records,
+                args.mix_threshold
+                if args.mix_threshold is not None
+                else args.threshold,
+            )
+            failures.extend(m_failures)
+            compared_something |= any(" ok" in l for l in m_report) or bool(
+                m_failures
+            )
+            for line in m_report:
+                print(line)
+        else:
+            print(
+                f"bench_gate[mix]: {args.mix_cells!r} not found — skipped "
+                "(no BENCH_MIX round banked yet)"
+            )
 
     if args.trace is not None:
         if not os.path.exists(args.trace):

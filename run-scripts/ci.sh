@@ -46,6 +46,9 @@ python run-scripts/chaos_smoke.py
 echo "== data-plane chaos smoke (NaN samples/skip tally, error policy, socket drops, mid-epoch kill+resume order) =="
 python run-scripts/data_chaos_smoke.py
 
+echo "== mixture chaos smoke (26-family churn + quarantine demotion under error-mode sentinel; SIGKILL bit-exact resume; SIGTERM cursor resume) =="
+python run-scripts/mix_chaos_smoke.py
+
 echo "== serve-plane chaos smoke (zero-retrace load, corrupt-request isolation, wedged step, hot reload, SIGTERM drain) =="
 python run-scripts/serve_chaos_smoke.py
 
@@ -55,8 +58,14 @@ python run-scripts/telemetry_smoke.py
 echo "== tracing smoke (span parentage train+serve, queue-wait latency contract, flight-recorder dump on injected wedge, <=2% tracing overhead A/B, bench-gate self-check) =="
 python run-scripts/trace_smoke.py
 
-echo "== bench regression gate (newest committed round vs prior; BENCH_r05.json) =="
-python run-scripts/bench_gate.py
+echo "== BENCH_MIX cells (mixture stream + balanced-train goodput, per-source graphs/sec, loss drift) =="
+BENCH_MIX=1 BENCH_MIX_EPOCHS=2 BENCH_MIX_CONFIGS=120 python bench.py
+
+echo "== bench regression gate (newest committed round vs prior; + mixture cells round-over-round) =="
+# mixture cells are host-path throughput on a shared CI box (~±12% noise);
+# the 50% threshold catches real collapses, drift gates tighter via the
+# same knob because the drift cells are seed-deterministic
+python run-scripts/bench_gate.py --mix-cells logs/mix_cells.jsonl --mix-threshold 0.5
 
 echo "== BENCH_SERVE cells (p50/p99 latency vs offered load, throughput at SLO, shed rate) =="
 BENCH_SERVE=1 BENCH_SERVE_SECS=2 python bench.py

@@ -20,6 +20,11 @@ end-to-end and asserts the acceptance contract of the r7 tentpole:
 3. **overhead A/B**: the same step loop driven with telemetry on vs off
    must show <= 2% mean step-time regression (min-of-means over
    interleaved trials, so machine drift hits both legs).
+4. **double-buffer A/B**: ``Training.double_buffer`` on vs off through
+   the same loop — the prefetch-depth gauge must read the configured
+   depth in each leg (the knob reaches the staging path) and the
+   double-buffered leg must stay within 1.5x of the inline one (the
+   thread handoff is bounded; its H2D win is a hardware-round number).
 
 Exit 0 = telemetry plane healthy; nonzero with a diagnostic otherwise.
 """
@@ -264,12 +269,103 @@ assert best <= 1.02, (
 print("TELEMETRY_SMOKE_OK", flush=True)
 """
 
+# ---- leg 4 child: Training.double_buffer A/B --------------------------------
+# its OWN subprocess on ONE CPU device: the staging path deactivates on
+# multi-device processes, so under ci.sh's forced 8-device mesh the main
+# child's gauge would read 0 in both legs and the A/B would be vacuous —
+# legs 1-3 keep their historical 8-device environment untouched
+_DB_CHILD = """
+import os
+import sys
+import time
 
-def _env(workdir):
+sys.path.insert(0, {repo!r})
+import jax
+import numpy as np
+
+from hydragnn_tpu.data import (
+    GraphLoader, MinMax, VariablesOfInterest, deterministic_graph_dataset,
+    extract_variables,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.obs.registry import registry
+from hydragnn_tpu.train import TrainState, make_optimizer
+from hydragnn_tpu.train.loop import make_train_step, train_epoch
+from hydragnn_tpu.config import update_config
+
+assert jax.local_device_count() == 1, jax.devices()
+graphs = MinMax.fit(g := deterministic_graph_dataset(64, seed=3)).apply(g)
+voi = VariablesOfInterest([0], ["s"], ["graph"], [0], [1, 1, 1], [1])
+graphs = [extract_variables(x, voi) for x in graphs]
+cfg = {{
+    "Dataset": {{"node_features": {{"dim": [1, 1, 1]}},
+                 "graph_features": {{"dim": [1]}}}},
+    "NeuralNetwork": {{
+        "Architecture": {{"mpnn_type": "GIN", "hidden_dim": 8,
+                          "num_conv_layers": 2, "task_weights": [1.0],
+                          "output_heads": {{"graph": {{
+                              "num_sharedlayers": 1, "dim_sharedlayers": 8,
+                              "num_headlayers": 2, "dim_headlayers": [8, 8]}}}}}},
+        "Variables_of_interest": {{"input_node_features": [0],
+                                   "output_names": ["s"], "output_index": [0],
+                                   "type": ["graph"]}},
+        "Training": {{"batch_size": 8,
+                      "Optimizer": {{"type": "AdamW",
+                                     "learning_rate": 0.01}}}},
+    }},
+}}
+cfg = update_config(cfg, graphs, graphs[:4], graphs[:4])
+loader = GraphLoader(graphs, 8, seed=0, prefetch=0)
+model = create_model(cfg)
+variables = init_model(model, next(iter(loader)), seed=0)
+tx = make_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+step = make_train_step(model, tx)
+state = TrainState.create(variables, tx)
+rng = jax.random.PRNGKey(0)
+state, _, _, rng, _ = train_epoch(loader, step, state, rng)  # compile warm
+n_batches = len(loader)
+os.environ.pop("HYDRAGNN_DEVICE_PREFETCH", None)  # let the knob decide
+times = {{}}
+for leg, depth in (("off", 0), ("on", 2)):
+    samples = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        state, _, _, rng, _ = train_epoch(
+            loader, step, state, rng, prefetch_depth=depth,
+        )
+        samples.append((time.perf_counter() - t0) / n_batches)
+    times[leg] = float(np.median(samples))
+    gauge = registry().get("hydragnn_device_prefetch_depth")
+    assert gauge is not None and gauge.value() == float(depth), (
+        "double_buffer leg %r: prefetch-depth gauge reads %s, wanted %d "
+        "— the config knob did not reach the staging path"
+        % (leg, gauge and gauge.value(), depth)
+    )
+ratio = times["on"] / max(times["off"], 1e-12)
+print("LEG4_DB off=%.3fms on=%.3fms ratio=%.3f"
+      % (times["off"] * 1e3, times["on"] * 1e3, ratio), flush=True)
+assert ratio <= 1.5, (
+    "double-buffered staging is %.2fx the inline loop — the staging "
+    "thread is costing far more than a queue handoff should" % ratio
+)
+print("LEG4_DOUBLE_BUFFER_OK", flush=True)
+"""
+
+
+def _env(workdir, single_device=False):
     env = {
         k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
     }
     env["JAX_PLATFORMS"] = "cpu"
+    if single_device:
+        # the double-buffer child needs ONE device (the staging path
+        # deactivates on multi-device processes); strip ci.sh's forced
+        # 8-device mesh flag
+        env["XLA_FLAGS"] = " ".join(
+            f
+            for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        )
     env["PYTHONPATH"] = ":".join(
         p
         for p in [_REPO] + env.get("PYTHONPATH", "").split(":")
@@ -296,8 +392,22 @@ def main() -> int:
             f"telemetry_smoke FAIL (rc={proc.returncode}):\n{out[-4000:]}"
         )
         return 1
-    for line in out.splitlines():
-        if line.startswith(("LEG1_", "LEG2_", "LEG3_", "TELEMETRY_")):
+    db_script = os.path.join(workdir, "db_child.py")
+    with open(db_script, "w") as f:
+        f.write(_DB_CHILD.format(repo=_REPO))
+    db = subprocess.run(
+        [sys.executable, db_script], cwd=workdir,
+        env=_env(workdir, single_device=True),
+        capture_output=True, text=True, timeout=600,
+    )
+    db_out = db.stdout + db.stderr
+    if db.returncode != 0 or "LEG4_DOUBLE_BUFFER_OK" not in db_out:
+        print(
+            f"telemetry_smoke FAIL leg4 (rc={db.returncode}):\n{db_out[-3000:]}"
+        )
+        return 1
+    for line in (out + db_out).splitlines():
+        if line.startswith(("LEG1_", "LEG2_", "LEG3_", "LEG4_", "TELEMETRY_")):
             print(line)
     return 0
 

@@ -364,6 +364,54 @@ def pytest_pack_resume_len_reflects_armed_epoch():
     assert res.start_batch == 0 and len(res) == lens[0]
 
 
+def pytest_pack_resume_recipe_guard_on_shrunk_dataset():
+    """A run checkpoints a pack-mode cursor, then the dataset SHRINKS
+    between runs (files deleted, a source recalled): pack-mode batch counts
+    are both epoch- and dataset-dependent, so the api recipe guard
+    (num_batches mismatch AFTER arming the sidecar's epoch) must detect the
+    drift, disarm, and leave the loader serving a clean full epoch 0 —
+    silently replaying the old cursor against the new stream would skip the
+    wrong batches. Only the same-size case was exercised before."""
+    gs = _graphs(30)
+    kw = dict(
+        shuffle=True, seed=5, pack=True,
+        spec=PadSpec(n_nodes=24, n_edges=1024, n_graphs=4),
+    )
+    ref = GraphLoader(gs, 4, **kw)
+    ref.set_epoch(2)
+    sidecar = ref.state_dict(next_batch=2)  # what the preemption stop saved
+    assert sidecar["num_batches"] == len(ref)
+
+    # same-size dataset: the guard passes and the tail replays (baseline)
+    same = GraphLoader(gs, 4, **kw)
+    same.resume(sidecar["epoch"], sidecar["next_batch"])
+    assert len(same) == sidecar["num_batches"]
+    same.set_epoch(0)
+    ref.set_epoch(2)
+    tail, full = list(same), list(ref)
+    assert len(tail) == len(full) - 2
+
+    # shrunk dataset: fewer graphs -> different pack count at the SAME
+    # epoch; the api guard sequence must disarm
+    shrunk = GraphLoader(gs[:-6], 4, **kw)
+    shrunk.resume(sidecar["epoch"], sidecar["next_batch"])
+    assert len(shrunk) != sidecar["num_batches"], (
+        "packing the shrunk dataset happened to yield the same count — "
+        "pick a different shrink for a meaningful guard test"
+    )
+    shrunk.resume(0, 0)  # the api disarm path (api.py Training.continue)
+    shrunk.set_epoch(0)
+    fresh = GraphLoader(gs[:-6], 4, **kw)
+    fresh.set_epoch(0)
+    a, b = list(shrunk), list(fresh)
+    assert len(a) == len(b) == len(fresh)
+    for ba, bb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(ba.x), np.asarray(bb.x))
+    # one-shot disarm: epoch 1 is a normal epoch too
+    shrunk.set_epoch(1)
+    assert shrunk.start_batch == 0 and shrunk.epoch == 1
+
+
 def pytest_loader_state_sidecar_roundtrip(tmp_path):
     from hydragnn_tpu.train import (
         LoaderState,

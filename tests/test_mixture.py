@@ -1,0 +1,616 @@
+"""GFM mixture plane (hydragnn_tpu/mix/; docs/GFM.md): temperature
+sampling math, deterministic draws/resume, hot add/remove, quarantine
+demotion, per-branch loss balancing + drift monitoring, config
+validation, and the branch-routed loader's per-branch ladder warm-up."""
+
+import dataclasses
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data.pipeline import (
+    MinMax,
+    VariablesOfInterest,
+    extract_variables,
+    selectable_levels,
+    split_dataset,
+)
+from hydragnn_tpu.data.synthetic import deterministic_graph_dataset
+from hydragnn_tpu.data.validate import SampleValidator
+from hydragnn_tpu.mix import (
+    DriftMonitor,
+    MixturePlane,
+    branch_loss_weights_from,
+    draw_source,
+    resolve_mixture,
+    source_permutation,
+    sources_from_graphs,
+    temperature_weights,
+)
+
+
+def _mix_dataset(families=3, n=96, seed=11):
+    raw = deterministic_graph_dataset(n, seed=seed)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest([0], ["s"], ["graph"], [0], [1, 1, 1], [1])
+    return [
+        dataclasses.replace(extract_variables(g, voi), dataset_id=i % families)
+        for i, g in enumerate(raw)
+    ]
+
+
+def _plane(graphs, batch_size=8, settings=None, seed=7, **kw):
+    settings = resolve_mixture({"Mixture": dict(settings or {})})
+    return MixturePlane(
+        sources_from_graphs(graphs), batch_size, settings=settings,
+        seed=seed, **kw
+    )
+
+
+def _epoch_sums(plane, epoch=0):
+    plane.set_epoch(epoch)
+    return [float(np.asarray(b.x).sum()) for b in plane]
+
+
+# ---------------------------------------------------------------------------
+# sampler math
+# ---------------------------------------------------------------------------
+
+
+def pytest_temperature_weights_law():
+    sizes = {0: 100, 1: 25}
+    # T=1: proportional to size
+    w1 = temperature_weights(sizes, 1.0)
+    assert w1[0] == pytest.approx(0.8) and w1[1] == pytest.approx(0.2)
+    # T->inf flattens toward uniform; T=2 sits in between (sqrt law)
+    w2 = temperature_weights(sizes, 2.0)
+    assert 0.5 < w2[0] < 0.8
+    assert w2[0] == pytest.approx(10.0 / 15.0)
+    # explicit weights MULTIPLY the size base (user-scale knob, never
+    # competing against other sources' raw counts) and renormalize
+    we = temperature_weights(sizes, 1.0, explicit={1: 4.0})
+    assert we[1] == pytest.approx(0.5)  # 25*4 == 100
+    assert we[0] == pytest.approx(0.5)
+    # renormalization over exactly the present keys = hot-remove math
+    w_rm = temperature_weights({0: 100}, 1.0)
+    assert w_rm[0] == pytest.approx(1.0)
+
+
+def pytest_sampler_is_pure_in_seed_epoch_draw():
+    ids, probs = (0, 1, 2), (0.5, 0.3, 0.2)
+    a = [draw_source(7, 1, k, ids, probs) for k in range(200)]
+    b = [draw_source(7, 1, k, ids, probs) for k in range(200)]
+    assert a == b
+    assert set(a) == {0, 1, 2}  # every source drawn at these shares
+    # different epoch / seed => different sequence
+    assert a != [draw_source(7, 2, k, ids, probs) for k in range(200)]
+    assert a != [draw_source(8, 1, k, ids, probs) for k in range(200)]
+    # permutations: pure, and a pass covers every index exactly once
+    p0 = source_permutation(7, 3, 1, 0, 10)
+    assert sorted(p0.tolist()) == list(range(10))
+    assert (p0 == source_permutation(7, 3, 1, 0, 10)).all()
+    assert (p0 != source_permutation(7, 3, 1, 1, 10)).any()
+
+
+# ---------------------------------------------------------------------------
+# plane: determinism, resume, churn, demotion
+# ---------------------------------------------------------------------------
+
+
+def pytest_plane_epochs_deterministic_and_distinct():
+    graphs = _mix_dataset()
+    p1 = _plane(graphs, num_buckets=3)
+    p2 = _plane(graphs, num_buckets=3)
+    assert _epoch_sums(p1, 0) == _epoch_sums(p2, 0)
+    assert _epoch_sums(p1, 1) == _epoch_sums(p2, 1)
+    assert _epoch_sums(p2, 0) != _epoch_sums(p2, 1)
+    # iterating the same epoch twice replays identically (probe-batch safe)
+    assert _epoch_sums(p1, 3) == _epoch_sums(p1, 3)
+
+
+def pytest_plane_temperature_shifts_draw_shares():
+    graphs = _mix_dataset(families=2, n=90)
+    # make source 1 three times smaller
+    graphs = [g for g in graphs if g.dataset_id == 0] + [
+        g for g in graphs if g.dataset_id == 1
+    ][:15]
+    hot = _plane(graphs, settings={"temperature": 1.0})
+    flat = _plane(graphs, settings={"temperature": 100.0})
+    assert hot.weights[0] > 0.7  # proportional-to-size
+    assert abs(flat.weights[0] - 0.5) < 0.02  # near-uniform
+    flat.set_epoch(0)
+    for _ in flat:
+        pass
+    draws = flat.epoch_draws
+    # near-uniform weights: the small source oversamples (wraps passes)
+    assert draws[1] > 0.5 * draws[0]
+
+
+def pytest_plane_mid_epoch_state_dict_resume():
+    graphs = _mix_dataset()
+    ref = _plane(graphs, num_buckets=3)
+    want = _epoch_sums(ref, 0)
+
+    src = _plane(graphs, num_buckets=3)
+    src.set_epoch(0)
+    it = iter(src)
+    for _ in range(4):
+        next(it)
+    sd = src.state_dict(4)
+    assert sd["mixture"]["draw"] is not None
+    assert sd["mixture"]["cursors"]
+
+    # sidecar path: cursors restored directly, no replay
+    res = _plane(graphs, num_buckets=3)
+    res.resume(sd["epoch"], sd["next_batch"])
+    res.restore_mixture(sd["mixture"], mid_epoch=True)
+    res.set_epoch(0)  # one-shot keep (the loop's per-epoch reseed)
+    assert [float(np.asarray(b.x).sum()) for b in res] == want[4:]
+    # later epochs continue the absolute sequence
+    assert _epoch_sums(res, 1) == _epoch_sums(ref, 1)
+
+    # cursor-less path: deterministic skip-replay
+    res2 = _plane(graphs, num_buckets=3)
+    res2.resume(0, 4)
+    res2.set_epoch(0)
+    assert [float(np.asarray(b.x).sum()) for b in res2] == want[4:]
+
+
+def pytest_plane_epoch_boundary_restore_continues_sequence():
+    graphs = _mix_dataset()
+    ref = _plane(graphs)
+    e1 = _epoch_sums(ref, 1)
+    snap = ref.mixture_state_dict()  # epoch 1 completed
+    res = _plane(graphs)
+    res.restore_mixture(snap)  # SIGKILL-style topology restore
+    assert res.epoch == 2  # continues the absolute sequence, not epoch 0
+    assert _epoch_sums(res, 0) == _epoch_sums(ref, 2)  # continues, not replays
+
+
+def pytest_plane_hot_add_remove_renormalizes():
+    graphs = _mix_dataset(families=3)
+    plane = _plane(graphs, settings={"temperature": 100.0})
+    assert len(plane.sources) == 3
+    plane.remove_source("ds1")
+    assert sorted(plane.weights) == [0, 2]
+    assert sum(plane.weights.values()) == pytest.approx(1.0)
+    extra = [dataclasses.replace(g, dataset_id=9) for g in graphs[:12]]
+    sid = plane.add_source("extra", extra)
+    assert sid not in (0, 1, 2)
+    assert sum(plane.weights.values()) == pytest.approx(1.0)
+    assert len(plane.weights) == 3
+    # removed source never drawn; added source is
+    plane.set_epoch(0)
+    for _ in plane:
+        pass
+    assert 1 not in plane.epoch_draws
+    assert plane.epoch_draws.get(sid, 0) > 0
+    with pytest.raises(KeyError):
+        plane.remove_source("nope")
+
+
+def pytest_plane_quarantine_demotion_on_draw_time_rot():
+    graphs = _mix_dataset(families=3)
+    validator = SampleValidator("warn_skip")
+    plane = _plane(
+        graphs, settings={"demote_after": 2}, validator=validator
+    )
+    # post-ingest rot: poison most of source 1's samples AFTER registration
+    for g in plane.sources[1].graphs[: len(plane.sources[1].graphs) - 1]:
+        np.asarray(g.x)[0, 0] = np.nan
+    from hydragnn_tpu.obs.events import events as _events
+
+    plane.set_epoch(0)
+    budget = len(plane)  # frozen before demotion shrinks the active set
+    batches = list(plane)
+    assert len(batches) == budget  # batch budget met despite the rot
+    assert 1 in plane.demoted and plane.demoted[1] == "nonfinite_features"
+    assert 1 not in plane.sources
+    assert sum(plane.weights.values()) == pytest.approx(1.0)
+    kinds = [e["kind"] for e in _events().snapshot()]
+    assert "mix_demote" in kinds
+    # every emitted batch is clean
+    for b in batches:
+        assert np.isfinite(np.asarray(b.x)).all()
+    # demotion state rides the snapshot
+    snap = plane.mixture_state_dict()
+    res = _plane(graphs, settings={"demote_after": 2})
+    res.restore_mixture(snap)
+    assert 1 in res.demoted and 1 not in res.sources
+
+
+def pytest_plane_exhaustion_is_typed():
+    from hydragnn_tpu.mix import MixtureExhaustedError
+
+    graphs = _mix_dataset(families=2)
+    plane = _plane(graphs)
+    plane.remove_source("ds0")
+    plane.remove_source("ds1")
+    plane.set_epoch(0)
+    with pytest.raises(MixtureExhaustedError):
+        next(iter(plane))
+
+
+def pytest_plane_templates_cover_emitted_levels():
+    graphs = _mix_dataset()
+    plane = _plane(graphs, num_buckets=4)
+    templates = plane.spec_template_batches()
+    assert templates, "no warm-up templates"
+    covered = {t[0] for t in templates}
+    plane.set_epoch(0)
+    emitted = set()
+    for b in plane:
+        emitted.add(
+            plane.ladder.select(
+                int(np.asarray(b.node_mask).sum()),
+                int(np.asarray(b.edge_mask).sum()),
+            )
+        )
+    assert emitted <= covered, (emitted, covered)
+    # template shapes match real batches at the same level
+    spec0, tmpl = templates[0]
+    assert np.asarray(tmpl.x).shape[0] == spec0.n_nodes
+
+
+# ---------------------------------------------------------------------------
+# balancing + drift
+# ---------------------------------------------------------------------------
+
+
+def pytest_branch_loss_weights_resolution():
+    assert branch_loss_weights_from({"balance": False}, 3) is None
+    w = branch_loss_weights_from({"balance": True}, 3)
+    assert w == (1.0, 1.0, 1.0)
+    w = branch_loss_weights_from(
+        {"balance": True, "branch_loss_weights": [1.0, 2.0, 3.0]}, 3
+    )
+    assert sum(w) / 3 == pytest.approx(1.0)  # normalized to mean 1
+    assert w[2] / w[0] == pytest.approx(3.0)  # ratios preserved
+    w = branch_loss_weights_from(
+        {"balance": True, "branch_loss_weights": {1: 4.0}}, 2
+    )
+    assert w[1] / w[0] == pytest.approx(4.0)
+    with pytest.raises(ValueError):
+        branch_loss_weights_from(
+            {"balance": True, "branch_loss_weights": [1.0]}, 3
+        )
+    with pytest.raises(ValueError):
+        branch_loss_weights_from(
+            {"balance": True, "branch_loss_weights": {7: 1.0}}, 3
+        )
+
+
+def pytest_balanced_multitask_loss_and_branch_metrics():
+    """In-graph balancing: equal weights reproduce the unweighted loss
+    EXACTLY; unequal weights tilt it; branch metrics match per-branch
+    recomputation."""
+    from hydragnn_tpu.models.create import create_model, init_model
+    from hydragnn_tpu.train.loss import compute_loss
+
+    graphs = _mix_dataset(families=2)
+    tr, va, te = split_dataset(graphs, 0.7, seed=0)
+    gh = {"num_sharedlayers": 1, "dim_sharedlayers": 8,
+          "num_headlayers": 2, "dim_headlayers": [8, 8]}
+    config = {
+        "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                    "graph_features": {"dim": [1]}},
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "hidden_dim": 8, "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {"graph": [
+                    {"type": "branch-0", "architecture": dict(gh)},
+                    {"type": "branch-1", "architecture": dict(gh)},
+                ]},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0], "output_names": ["s"],
+                "output_index": [0], "type": ["graph"],
+            },
+            "Training": {"batch_size": 8,
+                         "Optimizer": {"type": "AdamW",
+                                       "learning_rate": 0.01}},
+        },
+        "Mixture": {"temperature": 1.0},
+    }
+    config = update_config(config, tr, va, te)
+    assert config["NeuralNetwork"]["Architecture"]["branch_loss_weights"] == [
+        1.0, 1.0,
+    ]
+    model = create_model(config)
+    assert model.cfg.branch_loss_weights == (1.0, 1.0)
+    assert model.cfg.branch_loss_metrics
+
+    from hydragnn_tpu.data.graph import SpecLadder, batch_graphs
+
+    ladder = SpecLadder.for_dataset(tr, 8, num_buckets=1)
+    batch = batch_graphs(tr[:8], ladder.specs[-1])
+    variables = init_model(model, batch, seed=0)
+
+    tot_eq, tasks_eq, _, _ = compute_loss(
+        model, variables, batch, model.cfg, False, None, False
+    )
+    # equal weights == unweighted path, bit for bit
+    plain_cfg = dataclasses.replace(
+        model.cfg, branch_loss_weights=None, branch_loss_metrics=False
+    )
+    tot_plain, tasks_plain, _, _ = compute_loss(
+        model, variables, batch, plain_cfg, False, None, False
+    )
+    assert float(tot_eq) == float(tot_plain)
+    assert "branch0" in tasks_eq and "branch1" in tasks_eq
+    assert "branch0" not in tasks_plain
+    # branch metrics match a per-branch masked recomputation
+    ds = np.asarray(batch.dataset_id)
+    gm = np.asarray(batch.graph_mask)
+    pred = model.apply(variables, batch, train=False)["s"]
+    err2 = (np.asarray(pred) - np.asarray(batch.graph_targets["s"])) ** 2
+    for b in range(2):
+        sel = gm & (ds == b)
+        want = err2[sel].mean() if sel.any() else 0.0
+        assert float(tasks_eq[f"branch{b}"]) == pytest.approx(
+            float(want), rel=1e-5
+        )
+    # unequal weights tilt the total toward the up-weighted branch
+    tilt_cfg = dataclasses.replace(
+        model.cfg, branch_loss_weights=(0.2, 1.8)
+    )
+    tot_tilt, _, _, _ = compute_loss(
+        model, variables, batch, tilt_cfg, False, None, False
+    )
+    b0, b1 = float(tasks_eq["branch0"]), float(tasks_eq["branch1"])
+    assert float(tot_tilt) != float(tot_eq)
+    if b1 > b0:
+        assert float(tot_tilt) > float(tot_eq)
+    elif b1 < b0:
+        assert float(tot_tilt) < float(tot_eq)
+
+
+def pytest_drift_monitor_ema_and_event():
+    from hydragnn_tpu.obs.events import events as _events
+
+    mon = DriftMonitor(decay=0.5, threshold=2.0)
+    r = mon.update(0, {0: 1.0, 1: 1.0, 2: 1.0})
+    assert all(v == pytest.approx(1.0) for v in r.values())
+    assert mon.alarms == 0
+    # branch 2 diverges; EMA smooths, then crosses the threshold
+    mon.update(1, {0: 1.0, 1: 1.0, 2: 3.0})
+    assert mon.alarms == 0  # EMA at 2.0: not yet past 2x median
+    before = len(_events().snapshot())
+    r = mon.update(2, {0: 1.0, 1: 1.0, 2: 9.0})
+    assert r[2] > 2.0
+    assert mon.alarms == 1
+    ev = [e for e in _events().snapshot() if e["kind"] == "mix_drift"]
+    assert ev and ev[-1]["branch"] == 2
+
+
+# ---------------------------------------------------------------------------
+# sidecars
+# ---------------------------------------------------------------------------
+
+
+def pytest_mixture_sidecars_roundtrip(tmp_path):
+    from hydragnn_tpu.train.checkpoint import (
+        load_loader_state,
+        load_mixture_state,
+        save_loader_state,
+        save_mixture_state,
+    )
+    from hydragnn_tpu.train.state import LoaderState
+
+    graphs = _mix_dataset()
+    plane = _plane(graphs)
+    plane.set_epoch(1)
+    it = iter(plane)
+    next(it)
+    # the loader-state sidecar carries the mixture extension
+    sd = plane.state_dict(1)
+    st = LoaderState.from_dict(sd)
+    assert st.mixture is not None and st.mixture["draw"] is not None
+    save_loader_state(st, "runM", path=str(tmp_path))
+    got = load_loader_state("runM", path=str(tmp_path))
+    assert got.mixture == st.mixture
+    # plain records round-trip with no mixture key at all
+    plain = LoaderState(epoch=1, next_batch=2, seed=0, num_batches=5)
+    assert "mixture" not in plain.to_dict()
+    # the standalone mixture snapshot (epoch-boundary / SIGKILL path)
+    save_mixture_state(plane.mixture_state_dict(), "runM", path=str(tmp_path))
+    snap = load_mixture_state("runM", path=str(tmp_path))
+    assert snap["active"] == sorted(plane.sources)
+    assert load_mixture_state("runX", path=str(tmp_path)) is None
+    # malformed snapshot degrades with a warning, never raises
+    with open(tmp_path / "runM" / "mixture_state.json", "w") as f:
+        f.write("[not an object]")
+    with pytest.warns(UserWarning, match="mixture-state sidecar"):
+        assert load_mixture_state("runM", path=str(tmp_path)) is None
+    # incompatible topology: snapshot naming unknown source ids is refused
+    bad = dict(snap, active=snap["active"] + [99])
+    with pytest.raises(ValueError, match="not registered"):
+        _plane(graphs).restore_mixture(bad)
+
+
+# ---------------------------------------------------------------------------
+# config section + lint
+# ---------------------------------------------------------------------------
+
+
+def pytest_mixture_config_validation():
+    assert resolve_mixture({})["temperature"] == 1.0
+    out = resolve_mixture({"Mixture": {"temperature": 3.0, "demote_after": 0}})
+    assert out["temperature"] == 3.0 and out["demote_after"] == 0
+    for bad in (
+        {"temperature": 0},
+        {"temperature": -1},
+        {"draws_per_epoch": -5},
+        {"weights": {}},
+        {"weights": {"a": -1}},
+        {"drift_ema_decay": 1.0},
+        {"drift_threshold": 0.5},
+        {"demote_after": -1},
+        {"branch_loss_weights": "x"},
+        {"branch_loss_weights": [0.0]},
+    ):
+        with pytest.raises(ValueError):
+            resolve_mixture({"Mixture": bad})
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = resolve_mixture({"Mixture": {"tempurature": 2.0}})
+    assert any("tempurature" in str(x.message) for x in w)
+    assert out["temperature"] == 1.0  # typo dropped, default kept
+
+
+def pytest_mixture_lint_rows():
+    from hydragnn_tpu.config.lint import lint_config
+
+    findings = lint_config(
+        {
+            "Mixture": {
+                "temperature": 2.0,
+                "weights": {"oc20": 3.0},
+                "demote_after": 4,
+                "branch_loss_weights": [1, 2],
+            }
+        }
+    )
+    by = {f.path: f.status for f in findings}
+    assert by["Mixture.temperature"] == "handled"
+    assert by["Mixture.weights"] == "handled"
+    assert by["Mixture.demote_after"] == "handled"
+    assert "Mixture.weights.oc20" not in by  # opaque: free-form mapping
+    bad = lint_config({"Mixture": {"temperatur": 1.0}})
+    assert any(
+        f.path == "Mixture.temperatur" and f.status == "unknown" for f in bad
+    )
+
+
+# ---------------------------------------------------------------------------
+# branch-routed loader: per-branch ladder (satellite 1)
+# ---------------------------------------------------------------------------
+
+
+def _branch_world():
+    graphs = _mix_dataset(families=2, n=96)
+    tr, va, te = split_dataset(graphs, 0.7, seed=0)
+    return tr
+
+
+def pytest_branch_routed_ladder_levels_and_zero_retraces():
+    """BranchRoutedLoader with a SpecLadder: batches select per-level specs,
+    warm-up templates cover every level ANY branch can reach, and driving
+    the real mesh train step over a 4-family mixture after template warm-up
+    adds ZERO retraces under the error-mode sentinel."""
+    from hydragnn_tpu.data.graph import SpecLadder
+    from hydragnn_tpu.models.create import create_model, init_model
+    from hydragnn_tpu.parallel import make_mesh
+    from hydragnn_tpu.parallel.branch import (
+        BranchRoutedLoader,
+        make_branch_parallel_train_step,
+        place_branch_state,
+    )
+    from hydragnn_tpu.train.compile_plane import _SENTINEL
+    from hydragnn_tpu.train.optimizer import make_optimizer
+    from hydragnn_tpu.train.state import TrainState
+
+    families = 4  # >= the issue's 3-family bar; 8 devices: (branch=4, data=2)
+    graphs = _mix_dataset(families=families, n=120)
+    tr, va, te = split_dataset(graphs, 0.7, seed=0)
+    ladder = SpecLadder.for_dataset(tr + va + te, 2, num_buckets=3)
+    loader = BranchRoutedLoader(
+        tr, batch_size=16, branch_count=families, num_shards=8, spec=ladder
+    )
+    assert len(loader.ladder.specs) == len(ladder.specs)
+    templates = loader.spec_template_batches()
+    assert len(templates) >= 1
+    covered = {t[0] for t in templates}
+    # every level the per-branch census names is covered
+    for l in loader.loaders:
+        for li, _ in selectable_levels(l.graphs, ladder):
+            assert ladder.specs[li] in covered
+    # iteration: each batch's row shapes match a covered level, rows stay
+    # branch-routed
+    loader.set_epoch(0)
+    seen_specs = set()
+    for batch in loader:
+        n_nodes = np.asarray(batch.x).shape[1]
+        spec = next(s for s in ladder.specs if s.n_nodes == n_nodes)
+        seen_specs.add(spec)
+        ds = np.asarray(batch.dataset_id)
+        gm = np.asarray(batch.graph_mask)
+        for r in range(8):
+            want = r // (8 // families)
+            assert (ds[r][gm[r]] == want).all()
+    assert seen_specs <= covered
+
+    # zero retraces: warm the step on the templates, then train for real
+    gh = {"num_sharedlayers": 1, "dim_sharedlayers": 8,
+          "num_headlayers": 2, "dim_headlayers": [8, 8]}
+    config = {
+        "Dataset": {"node_features": {"dim": [1, 1, 1]},
+                    "graph_features": {"dim": [1]}},
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN", "hidden_dim": 8, "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {"graph": [
+                    {"type": f"branch-{b}", "architecture": dict(gh)}
+                    for b in range(families)
+                ]},
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0], "output_names": ["s"],
+                "output_index": [0], "type": ["graph"],
+            },
+            "Training": {"batch_size": 16,
+                         "Optimizer": {"type": "AdamW",
+                                       "learning_rate": 0.01}},
+        },
+    }
+    config = update_config(config, tr, va, te)
+    mesh = make_mesh(branch_size=families)
+    model = create_model(config)
+    one = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0], next(iter(loader))
+    )
+    variables = init_model(model, one, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+    state = place_branch_state(TrainState.create(variables, tx), tx, mesh)
+    step = make_branch_parallel_train_step(model, tx, mesh)
+    rng = jax.random.PRNGKey(0)
+    # warm every template level through the REAL jit object
+    for _, tmpl in templates:
+        state, _, _ = step(state, tmpl, rng)
+    counts0 = dict(_SENTINEL.counts())
+    _SENTINEL.arm("error")
+    try:
+        for epoch in range(2):
+            loader.set_epoch(epoch)
+            for b in loader:
+                rng, sub = jax.random.split(rng)
+                state, tot, _ = step(state, b, sub)
+    finally:
+        _SENTINEL.disarm()
+    assert dict(_SENTINEL.counts()) == counts0, (
+        "branch-routed mixture epochs retraced after template warm-up"
+    )
+    assert np.isfinite(float(tot))
+
+
+def pytest_branch_routed_single_spec_backward_compat():
+    """A plain PadSpec still means one worst-case specialization."""
+    from hydragnn_tpu.data.graph import SpecLadder
+    from hydragnn_tpu.parallel.branch import BranchRoutedLoader
+
+    tr = _branch_world()
+    ladder = SpecLadder.for_dataset(tr, 2, num_buckets=1)
+    loader = BranchRoutedLoader(
+        tr, batch_size=16, branch_count=2, num_shards=8,
+        spec=ladder.specs[-1],
+    )
+    assert len(loader.ladder.specs) == 1
+    assert len(loader.spec_template_batches()) == 1
+    loader.set_epoch(0)
+    shapes = {np.asarray(b.x).shape for b in loader}
+    assert len(shapes) == 1
