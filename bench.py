@@ -337,6 +337,52 @@ def _model_cell_workload(model_name: str, mixed_precision=None):
     return _oc20_workload(arch, batch_size, num_configs, mixed_precision)
 
 
+def _pna_cell_workload(spec: str, mixed_precision=None):
+    """PNA-family cells (BENCH_PNA=1): the multi-output fused aggregation
+    kernel's A/B (ops/pallas_multi_agg.py — the r11 tentpole). ``spec`` is
+    ``"<model>_<route>"``: PNA_dense / PNA_fused / PNAPlus_dense /
+    PNAPlus_fused. Both routes run ON the sorted route (sorted aggregation
+    pinned on) so the ONLY moving part is the moment kernel vs the four
+    dense segment reductions; same OC20-shaped data + energy/forces heads
+    as every other cell, so graphs/sec/chip + MFU land in
+    logs/ab_matrix.jsonl next to them with a ``multi_agg`` banked field."""
+    if mixed_precision is None:
+        mixed_precision = _default_mp()
+    model_name, route = spec.rsplit("_", 1)
+    assert model_name in ("PNA", "PNAPlus") and route in ("dense", "fused"), spec
+    batch_size = int(os.getenv("BENCH_PNA_BATCH_SIZE", "16"))
+    hidden = int(os.getenv("BENCH_PNA_HIDDEN", "256"))
+    arch = {
+        "mpnn_type": model_name,
+        "hidden_dim": hidden,
+        "num_conv_layers": 4,
+        "radius": 5.0,
+        "max_neighbours": 20,
+        # both cells ride the sorted route — the kernel-vs-dense delta must
+        # not be confounded with the (already-banked) sorted-vs-scatter one
+        "use_sorted_aggregation": True,
+        "use_fused_edge_kernel": route == "fused",
+        "task_weights": [1.0, 100.0],
+        "output_heads": {
+            "graph": {
+                "num_sharedlayers": 2,
+                "dim_sharedlayers": 50,
+                "num_headlayers": 2,
+                "dim_headlayers": [256, 256],
+            },
+            "node": {
+                "num_headlayers": 2,
+                "dim_headlayers": [256, 256],
+                "type": "mlp",
+            },
+        },
+    }
+    if model_name == "PNAPlus":
+        arch.update(num_radial=5, envelope_exponent=5)
+    num_configs = int(os.getenv("BENCH_NUM_CONFIGS", str(max(4 * batch_size, 128))))
+    return _oc20_workload(arch, batch_size, num_configs, mixed_precision)
+
+
 def _gps_cell_workload(attn_variant: str, mixed_precision=None):
     """GPS global-attention cells (BENCH_GPS=1) — the fork's headline
     feature (SURVEY §0 pillar 5) finally gets banked graphs/sec/chip + MFU
@@ -418,6 +464,8 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
             config, loader = _gps_cell_workload(
                 workload.split("_", 1)[1], mixed_precision
             )
+        elif workload.startswith("PNA"):
+            config, loader = _pna_cell_workload(workload, mixed_precision)
         else:
             config, loader = _model_cell_workload(workload, mixed_precision)
     finally:
@@ -553,12 +601,21 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
         "compile_time_s": mdelta["backend_compile_s"],
         "cache_hits": int(mdelta["cache_hits"]),
         "cache_misses": int(mdelta["cache_misses"]),
-        # the route that can actually engage, not the raw flag: the fused
-        # path needs sorted receivers + a degree bound AND an EGNN stack
-        # (models/egnn.py is the only consumer — a MACE/DimeNet cell with
-        # the auto-following flag set must bank fused_edge=false)
+        # the routes that can actually engage, not the raw flag: both fused
+        # paths need sorted receivers + a degree bound, and each has its own
+        # consumer set — EGNN's single-consumer messages ride the
+        # gather->dense->sum kernel (fused_edge), the PNA family's
+        # multi-consumer messages ride the multi-output moment kernel
+        # (multi_agg, ops/pallas_multi_agg.py). A MACE/DimeNet cell with the
+        # auto-following flag set must bank both false.
         "fused_edge": bool(
             arch_done.get("mpnn_type") == "EGNN"
+            and arch_done.get("use_fused_edge_kernel", False)
+            and arch_done.get("use_sorted_aggregation", False)
+            and int(arch_done.get("max_in_degree") or 0) > 0
+        ),
+        "multi_agg": bool(
+            arch_done.get("mpnn_type") in ("PNA", "PNAPlus", "PNAEq")
             and arch_done.get("use_fused_edge_kernel", False)
             and arch_done.get("use_sorted_aggregation", False)
             and int(arch_done.get("max_in_degree") or 0) > 0
@@ -749,6 +806,24 @@ def main_ab():
             {"mp": True, "sorted": False, "model": "GPS_performer",
              "tag": "gps_performer"},
         ]
+    if os.getenv("BENCH_PNA", "0") == "1":
+        # multi-output fused PNA aggregation A/B (the r11 tentpole,
+        # ops/pallas_multi_agg.py): moment kernel vs the four dense segment
+        # reductions, both ON the sorted route, for PNA and PNAPlus (the
+        # rbf-gated variant streams the gate through the kernel). Dense
+        # first per cell discipline: a mid-matrix wedge keeps the baseline.
+        # Pinned for the ROADMAP item 4 hardware round; the CPU-side
+        # fused==dense proof is BENCH_PNA_SMOKE (ci.sh).
+        cells += [
+            {"mp": True, "sorted": True, "model": "PNA_dense",
+             "tag": "pna_dense"},
+            {"mp": True, "sorted": True, "model": "PNA_fused",
+             "tag": "pna_fused"},
+            {"mp": True, "sorted": True, "model": "PNAPlus_dense",
+             "tag": "pnaplus_dense"},
+            {"mp": True, "sorted": True, "model": "PNAPlus_fused",
+             "tag": "pnaplus_fused"},
+        ]
     if os.getenv("BENCH_COMPILE", "0") == "1":
         # cold-vs-warm persistent-cache A/B (the r8 compile-plane tentpole):
         # the SAME production-shaped cell twice — first against a scrubbed
@@ -775,7 +850,10 @@ def main_ab():
         # outer-process environment (ADVICE r5 #2: _bench_production applies
         # env_overrides around the workload build, so a future model cell
         # setting BENCH_CELL_SORTED via env would otherwise bank wrong)
-        if "model" in cell:
+        if "model" in cell and not cell["model"].startswith("PNA"):
+            # PNA cells pin sorted aggregation ON inside their workload
+            # builder (the kernel-vs-dense A/B must not be confounded), so
+            # only the MACE/DimeNet/GPS cells route via BENCH_CELL_SORTED
             sorted_agg = cell.get("env", {}).get(
                 "BENCH_CELL_SORTED", os.environ.get("BENCH_CELL_SORTED", "0")
             ) == "1"
@@ -837,6 +915,7 @@ def main_ab():
                 "mixed_precision": mp,
                 "sorted_aggregation": sorted_agg,
                 "fused_edge": prod["fused_edge"],
+                "multi_agg": prod["multi_agg"],
                 "equivariance": prod["equivariance"],
                 "step_guard": prod["step_guard"],
                 "flash_attention": prod["flash_attention"],
@@ -942,6 +1021,79 @@ def smoke_gps():
         "metric": "BENCH_GPS smoke (CPU, one step per attention variant)",
         "losses": {k: round(v, 6) for k, v in losses.items()},
         "flash_vs_dense_delta": delta,
+        "ok": True,
+    }))
+
+
+def smoke_pna():
+    """BENCH_PNA_SMOKE=1: CPU-runnable proof that every BENCH_PNA cell
+    builds and trains — one jitted step per (model, route) at tiny shapes,
+    with the fused cells FORCED through the multi-moment Pallas kernel
+    (interpret mode, HYDRAGNN_PALLAS_MULTIAGG=1) and asserted loss-equal
+    to the dense cells from identical init. This is the CI tier's guard
+    that the bench cells cannot rot between hardware rounds
+    (run-scripts/ci.sh invokes it)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    os.environ.setdefault("BENCH_PNA_BATCH_SIZE", "4")
+    os.environ.setdefault("BENCH_PNA_HIDDEN", "32")
+    os.environ.setdefault("BENCH_NUM_CONFIGS", "24")
+    report = {}
+    for model_name in ("PNA", "PNAPlus"):
+        losses = {}
+        variables = None
+        for route in ("dense", "fused"):
+            config, loader = _pna_cell_workload(
+                f"{model_name}_{route}", mixed_precision=False
+            )
+            batch = next(iter(loader))
+            model = create_model(config)
+            if variables is None:
+                variables = init_model(model, batch, seed=0)
+            # the fused route runs the interpret-mode kernel; the dense
+            # route is the oracle — identical init, one step each
+            flips = [("", None)] if route == "dense" else [
+                ("", "1"), ("_dense_fallback", "0"),
+            ]
+            for suffix, flag in flips:
+                if flag is not None:
+                    os.environ["HYDRAGNN_PALLAS_MULTIAGG"] = flag
+                try:
+                    state = TrainState.create(
+                        jax.tree_util.tree_map(
+                            lambda x: jnp.array(x, copy=True), variables
+                        ),
+                        tx := make_optimizer(
+                            config["NeuralNetwork"]["Training"]["Optimizer"]
+                        ),
+                    )
+                    _, tot, _ = make_train_step(model, tx)(
+                        state, batch, jax.random.PRNGKey(0)
+                    )
+                    jax.block_until_ready(tot)
+                finally:
+                    os.environ.pop("HYDRAGNN_PALLAS_MULTIAGG", None)
+                losses[route + suffix] = float(tot)
+                assert np.isfinite(losses[route + suffix]), (
+                    model_name, route, losses
+                )
+        delta = abs(losses["fused"] - losses["dense"])
+        assert delta <= 1e-4 * max(1.0, abs(losses["dense"])), (
+            model_name, losses
+        )
+        report[model_name] = {
+            "losses": {k: round(v, 6) for k, v in losses.items()},
+            "fused_vs_dense_delta": delta,
+        }
+    print(json.dumps({
+        "metric": "BENCH_PNA smoke (CPU, one step per model x route; "
+                  "fused==dense)",
+        **report,
         "ok": True,
     }))
 
@@ -1394,6 +1546,9 @@ def main():
         return
     if os.getenv("BENCH_GUARD_SMOKE", "0") == "1":
         smoke_guard()
+        return
+    if os.getenv("BENCH_PNA_SMOKE", "0") == "1":
+        smoke_pna()
         return
     if os.getenv("BENCH_SERVE", "0") == "1":
         main_serve()

@@ -278,14 +278,16 @@ def update_config(
         arch["max_in_degree"] = int(supplied or top)
     arch.setdefault("max_in_degree", 0)
 
-    # ---- fused edge hot path (gather -> edge dense -> segment sum in one
-    # VMEM-resident Pallas kernel, ops/pallas_fused_edge.py): auto-on
-    # wherever sorted aggregation is on — it shares the sorted-receivers +
-    # max_in_degree contract and falls back to the identical dense
-    # computation off-TPU (ops/segment.py routing), so the flag is safe to
-    # carry on any backend. Consumed today by the EGNN stack's
-    # single-consumer edge messages (models/egnn.py); explicit true/false
-    # wins for A/B (bench.py BENCH_FUSED).
+    # ---- fused edge hot path: auto-on wherever sorted aggregation is on —
+    # it shares the sorted-receivers + max_in_degree contract and falls
+    # back to the identical dense computation off-TPU (ops/segment.py
+    # routing), so the flag is safe to carry on any backend. ONE knob, two
+    # kernels: EGNN's single-consumer messages ride the gather -> dense ->
+    # segment-sum kernel (ops/pallas_fused_edge.py, models/egnn.py); the
+    # PNA family's multi-consumer messages ride the multi-output moment
+    # kernel (ops/pallas_multi_agg.py, models/pna*.py — one pass emits
+    # sum/count/min/max/sumsq, HYDRAGNN_PALLAS_MULTIAGG overrides).
+    # Explicit true/false wins for A/B (bench.py BENCH_FUSED / BENCH_PNA).
     if ("use_fused_edge_kernel" not in arch
             or arch["use_fused_edge_kernel"] is None):
         arch["use_fused_edge_kernel"] = bool(arch["use_sorted_aggregation"])
@@ -375,6 +377,19 @@ def update_config(
     arch.setdefault("max_neighbours", None)
     arch.setdefault("num_conv_layers", 1)
     training.setdefault("conv_checkpointing", False)
+    # ---- rematerialization policy (docs/PERFORMANCE.md "Multi-aggregate
+    # kernel"): which save rule every remat wrap uses — the kernel call
+    # sites (fused edge / multi-agg / flash attention) and the whole-loss
+    # conv_checkpointing wrap. Default 'full' preserves the historical
+    # bare-jax.checkpoint behavior at every site.
+    training.setdefault("remat_policy", "full")
+    from ..ops.remat import REMAT_POLICIES
+
+    if training["remat_policy"] not in REMAT_POLICIES:
+        raise ValueError(
+            f"Training.remat_policy {training['remat_policy']!r} must be "
+            f"one of {REMAT_POLICIES}"
+        )
     training.setdefault("loss_function_type", "mse")
     training.setdefault("batch_size", 32)
     training.setdefault("num_epoch", 1)

@@ -140,6 +140,7 @@ _HANDLED = {
     "NeuralNetwork.Training.retrace_policy",
     "NeuralNetwork.Training.compute_grad_energy",
     "NeuralNetwork.Training.conv_checkpointing",
+    "NeuralNetwork.Training.remat_policy",
     "NeuralNetwork.Training.Optimizer",
     "NeuralNetwork.Training.mixed_precision",
     "NeuralNetwork.Training.pack_batches",

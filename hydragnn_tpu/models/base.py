@@ -129,14 +129,21 @@ class ModelConfig:
     # scatter (ops/segment.py segment_sum; loader sort_edges=True)
     sorted_aggregation: bool = False
     max_in_degree: int = 0
-    # fused gather->edge-dense->segment-sum Pallas kernel for the edge hot
-    # path (Architecture.use_fused_edge_kernel; auto-on with sorted
-    # aggregation in config completion). Consumed by convs whose per-edge
-    # messages have a single consumer — today the EGNN stack's
-    # non-equivariant layers (models/egnn.py); multi-aggregator convs
-    # (PNA family) and gated two-projection convs (CGCNN) materialize
-    # messages for other consumers, so the flag is inert there.
+    # fused edge-hot-path Pallas kernels (Architecture.use_fused_edge_kernel;
+    # auto-on with sorted aggregation in config completion). Consumed by the
+    # EGNN stack's single-consumer messages (gather -> dense -> segment sum,
+    # ops/pallas_fused_edge.py) AND by the PNA family's multi-consumer
+    # messages through the multi-output moment kernel
+    # (ops/pallas_multi_agg.py — one pass emits sum/count/min/max/sumsq, so
+    # "four aggregators need [E, C] in HBM" no longer holds). Gated
+    # two-projection convs (CGCNN) still materialize messages for their
+    # second consumer, so the flag is inert there.
     fused_edge_kernel: bool = False
+    # Training.remat_policy (none|dots|names|full): the save rule every
+    # remat wrap uses — kernel call sites and the whole-loss
+    # conv_checkpointing wrap (ops/remat.py). 'full' = the historical bare
+    # jax.checkpoint at every site.
+    remat_policy: str = "full"
     # --- decoder seed-robustness knobs (Architecture.decoder_mirror_init /
     # Architecture.decoder_recovery_slope). Defaults are the seed-robust
     # behavior (mirrored (w,-w) decoder init + leaky-ReLU(0.1) decoder hidden
@@ -268,6 +275,7 @@ class HydraModel(nn.Module):
                     attn_type=cfg.global_attn_type or "multihead",
                     max_nodes_per_graph=cfg.max_nodes_per_graph,
                     use_flash_attention=cfg.use_flash_attention,
+                    remat_policy=cfg.remat_policy,
                 )
             convs.append(mpnn)
         self.graph_convs = convs

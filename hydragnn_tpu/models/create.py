@@ -143,6 +143,7 @@ def model_config_from(config: Dict[str, Any]) -> ModelConfig:
         num_nodes=arch.get("num_nodes"),
         var_output=loss_type == "GaussianNLLLoss",
         conv_checkpointing=bool(training.get("conv_checkpointing", False)),
+        remat_policy=str(training.get("remat_policy", "full")),
         freeze_conv_layers=bool(arch.get("freeze_conv_layers", False)),
         sorted_aggregation=bool(arch.get("use_sorted_aggregation", False)),
         max_in_degree=int(arch.get("max_in_degree") or 0),

@@ -59,6 +59,8 @@ class EGCL(nn.Module):
     # layers feed edge_feat to the coordinate gate too, so they keep the
     # materialized path (see the ceiling analysis in docs/PERFORMANCE.md).
     fused_edge: bool = False
+    # Training.remat_policy save rule at the kernel call site (ops/remat.py)
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -83,6 +85,7 @@ class EGCL(nn.Module):
                 self.hidden_dim, inv, batch, "edge_lin_recv",
                 "edge_lin_send", "edge_lin2", terms,
                 max_in_degree=self.max_in_degree,
+                remat_policy=self.remat_policy,
             )
         else:
             # matmul-before-gather first edge-MLP layer
@@ -129,4 +132,5 @@ def make_egnn(cfg, in_dim, out_dim, last_layer):
         sorted_agg=cfg.sorted_aggregation,
         max_in_degree=cfg.max_in_degree,
         fused_edge=cfg.fused_edge_kernel,
+        remat_policy=cfg.remat_policy,
     )

@@ -61,6 +61,8 @@ class MultiheadSelfAttention(nn.Module):
     dropout: float = 0.0
     max_nodes_per_graph: int = 0
     use_flash_attention: bool = False
+    # Training.remat_policy save rule at the kernel call site (ops/remat.py)
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, x, batch: GraphBatch, train: bool = False):
@@ -87,16 +89,20 @@ class MultiheadSelfAttention(nn.Module):
             Nmax = self.max_nodes_per_graph
             interpret = jax.default_backend() != "tpu"
 
-            # jax.checkpoint keeps the tangent rule's residuals (per-graph
-            # probability blocks) out of the training forward: the forward
-            # stays VMEM-resident, the backward recomputes gathered-dense
+            # remat per Training.remat_policy (ops/remat.py; default =
+            # bare jax.checkpoint) keeps the tangent rule's residuals
+            # (per-graph probability blocks) out of the training forward:
+            # the forward stays VMEM-resident, the backward recomputes
+            # gathered-dense
+            from ..ops.remat import kernel_remat, tag as remat_tag
+
             def attend(qf, kf, vf):
-                return flash_self_attention(
+                return remat_tag(flash_self_attention(
                     qf, kf, vf, batch.node_graph, batch.node_mask,
                     batch.num_graphs, Nmax, interpret=interpret,
-                )
+                ), "flash_attention_out")
 
-            out = jax.checkpoint(attend)(
+            out = kernel_remat(attend, self.remat_policy)(
                 q.reshape(N, H, d), k.reshape(N, H, d), v.reshape(N, H, d)
             ).reshape(N, C)
             # same poison contract as the gathered layout below: a graph
@@ -269,6 +275,7 @@ class GPSConv(nn.Module):
     attn_type: str = "multihead"
     max_nodes_per_graph: int = 0
     use_flash_attention: bool = False
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, inv, equiv, batch: GraphBatch, train: bool = False):
@@ -302,6 +309,7 @@ class GPSConv(nn.Module):
                 0.0 if self.use_flash_attention else self.dropout,
                 self.max_nodes_per_graph,
                 use_flash_attention=self.use_flash_attention,
+                remat_policy=self.remat_policy,
             )(inv, batch, train)
         else:
             raise ValueError(f"attn_type {self.attn_type!r} not supported")

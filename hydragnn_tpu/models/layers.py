@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from ..ops.remat import kernel_remat, tag as remat_tag
 from ..ops.segment import fused_edge_message_sum as _fused_edge_message_sum
 
 
@@ -160,6 +161,28 @@ class MaskedBatchNorm(nn.Module):
         return y * scale + bias
 
 
+def pair_message_factored(dim, inv, batch, name_recv, name_send, edge_terms=()):
+    """The factored first edge-MLP layer, distributed over its concat
+    inputs: a NODE-sized receiver projection (``[N, C]``, carrying the one
+    bias — same total as the post-concat layer) and ONE edge-aligned
+    operand (bias-free sender projection gathered by ``senders``, plus a
+    bias-free projection per ``edge_terms`` entry). Returns
+    ``(node_recv [N, C], edge_in [E, C])``.
+
+    This is the SINGLE spelling of the recv-bias/send-no-bias parameter
+    convention — ``hoisted_pair_dense``, ``fused_pair_dense_sum`` and the
+    PNA family's pre-message (models/pna.py) all build on it, which is
+    what keeps their parameter trees checkpoint-interchangeable. Keeping
+    ``node_recv`` un-gathered is what lets the fused kernels run the
+    receiver gather in-register (ops/pallas_fused_edge.py,
+    ops/pallas_multi_agg.py)."""
+    node_recv = nn.Dense(dim, name=name_recv)(inv)
+    edge_in = nn.Dense(dim, use_bias=False, name=name_send)(inv)[batch.senders]
+    for name, arr in edge_terms:
+        edge_in = edge_in + nn.Dense(dim, use_bias=False, name=name)(arr)
+    return node_recv, edge_in
+
+
 def hoisted_pair_dense(dim, inv, batch, name_recv, name_send, edge_terms=()):
     """First edge-MLP layer distributed over its concat inputs and computed
     on node-sized operands BEFORE the edge gather:
@@ -167,11 +190,11 @@ def hoisted_pair_dense(dim, inv, batch, name_recv, name_send, edge_terms=()):
         Dense(concat[x_i, x_j, e...]) == Dense_r(x)_i + Dense_s(x)_j
                                           + sum_k Dense_k(e_k)
 
-    (bias kept only on the receiver projection — one bias total, same as the
-    post-concat layer). The node-side matmuls run on [N, C] instead of
-    [E, 2C]: at degree ~20 that is ~20x fewer MXU FLOPs and half the gather
-    bytes for this layer, with identical function class to the reference's
-    post-concat edge MLPs (e.g. EGCLStack.py:238-247, PNAPlusStack.py:268).
+    (parameters via ``pair_message_factored`` above). The node-side
+    matmuls run on [N, C] instead of [E, 2C]: at degree ~20 that is ~20x
+    fewer MXU FLOPs and half the gather bytes for this layer, with
+    identical function class to the reference's post-concat edge MLPs
+    (e.g. EGCLStack.py:238-247, PNAPlusStack.py:268).
 
     ``edge_terms`` is an iterable of (name, [E, d] array) extra edge-aligned
     operands, each getting its own bias-free projection.
@@ -181,11 +204,10 @@ def hoisted_pair_dense(dim, inv, batch, name_recv, name_send, edge_terms=()):
     ``fused_pair_dense_sum`` below: same parameters, but the whole chain
     runs in one VMEM-resident Pallas kernel on TPU.
     """
-    out = nn.Dense(dim, name=name_recv)(inv)[batch.receivers]
-    out = out + nn.Dense(dim, use_bias=False, name=name_send)(inv)[batch.senders]
-    for name, arr in edge_terms:
-        out = out + nn.Dense(dim, use_bias=False, name=name)(arr)
-    return out
+    node_recv, edge_in = pair_message_factored(
+        dim, inv, batch, name_recv, name_send, edge_terms
+    )
+    return node_recv[batch.receivers] + edge_in
 
 
 class _FusedEdgeDense(nn.Module):
@@ -193,14 +215,18 @@ class _FusedEdgeDense(nn.Module):
     and initialized exactly like ``nn.Dense`` so the fused and unfused
     routes share one checkpoint format) + the fused Pallas/dense call.
 
-    ``jax.checkpoint`` wraps the op so the plain-jnp tangent rule's
-    residuals (pre-activation, relu masks — [E, C] arrays) are recomputed
-    in the backward instead of materialized in the forward: the training
-    forward stays VMEM-resident, which is the point of the fusion.
+    The op is remat-wrapped per ``Training.remat_policy`` (ops/remat.py;
+    default ``full`` = the historical bare ``jax.checkpoint``) so the
+    plain-jnp tangent rule's residuals (pre-activation, relu masks —
+    [E, C] arrays) are recomputed in the backward instead of materialized
+    in the forward: the training forward stays VMEM-resident, which is
+    the point of the fusion. The output carries the ``fused_edge_sum``
+    checkpoint-name tag for the ``names`` policy's save set.
     """
 
     features: int
     max_in_degree: int
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, node_recv, edge_in, receivers, num_segments):
@@ -213,16 +239,19 @@ class _FusedEdgeDense(nn.Module):
         max_degree = self.max_in_degree
 
         def call(nr, ei, w, b):
-            return _fused_edge_message_sum(
+            return remat_tag(_fused_edge_message_sum(
                 nr.astype(dtype), ei.astype(dtype), w.astype(dtype),
                 b.astype(dtype), receivers, num_segments, max_degree,
-            )
+            ), "fused_edge_sum")
 
-        return jax.checkpoint(call)(node_recv, edge_in, kernel, bias)
+        return kernel_remat(call, self.remat_policy)(
+            node_recv, edge_in, kernel, bias
+        )
 
 
 def fused_pair_dense_sum(dim, inv, batch, name_recv, name_send, name_out,
-                         edge_terms=(), max_in_degree: int = 0):
+                         edge_terms=(), max_in_degree: int = 0,
+                         remat_policy: str = "full"):
     """Fused counterpart of the whole EGNN-style edge hot path:
 
         hoisted_pair_dense -> relu -> Dense(name_out) -> relu -> segment_sum
@@ -240,10 +269,9 @@ def fused_pair_dense_sum(dim, inv, batch, name_recv, name_send, name_out,
     ``segment_sum(sorted_ids=True)``; padding edges land on the dummy node,
     whose garbage row every consumer already masks (data/graph.py).
     """
-    node_recv = nn.Dense(dim, name=name_recv)(inv)
-    edge_in = nn.Dense(dim, use_bias=False, name=name_send)(inv)[batch.senders]
-    for name, arr in edge_terms:
-        edge_in = edge_in + nn.Dense(dim, use_bias=False, name=name)(arr)
-    return _FusedEdgeDense(dim, max_in_degree, name=name_out)(
+    node_recv, edge_in = pair_message_factored(
+        dim, inv, batch, name_recv, name_send, edge_terms
+    )
+    return _FusedEdgeDense(dim, max_in_degree, remat_policy, name=name_out)(
         node_recv, edge_in, batch.receivers, batch.num_nodes
     )

@@ -29,6 +29,13 @@ class PNAEqConv(nn.Module):
     last_layer: bool = False
     sorted_agg: bool = False
     max_in_degree: int = 0
+    # multi-output fused aggregation (cfg.fused_edge_kernel): the scalar
+    # message here is post-MLP/post-gate (not factorable into the kernel's
+    # in-kernel gather), so [E, C] exists once — but the four aggregation
+    # moments still fuse into ONE pass over it instead of four separate
+    # segment reductions re-reading it (ops/pallas_multi_agg.py)
+    multi_agg: bool = False
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -65,7 +72,9 @@ class PNAEqConv(nn.Module):
 
         # PNA aggregation of scalar messages (aggregators x scalers)
         scaled = pna_aggregate(msg_s, batch, self.deg_hist,
-                               self.sorted_agg, self.max_in_degree)
+                               self.sorted_agg, self.max_in_degree,
+                               multi_agg=self.multi_agg,
+                               remat_policy=self.remat_policy)
         delta = nn.Dense(self.node_size)(jnp.concatenate([x, scaled], axis=-1))
         x = x + delta
 
@@ -85,4 +94,6 @@ def make_pna_eq(cfg, in_dim, out_dim, last_layer):
         last_layer=last_layer,
         sorted_agg=cfg.sorted_aggregation,
         max_in_degree=cfg.max_in_degree,
+        multi_agg=cfg.fused_edge_kernel,
+        remat_policy=cfg.remat_policy,
     )

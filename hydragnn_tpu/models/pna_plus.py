@@ -14,8 +14,7 @@ from flax import linen as nn
 
 from ..ops.radial import bessel_basis_enveloped, edge_vectors
 from .base import register_conv
-from .layers import hoisted_pair_dense
-from .pna import pna_aggregate
+from .pna import pna_aggregate, pna_pre_message
 
 
 class PNAPlusConv(nn.Module):
@@ -27,6 +26,12 @@ class PNAPlusConv(nn.Module):
     edge_dim: int = 0
     sorted_agg: bool = False
     max_in_degree: int = 0
+    # multi-output fused aggregation (cfg.fused_edge_kernel): the gated
+    # message and all four aggregation moments run in one Pallas pass —
+    # the rbf Hadamard gate rides the kernel's ``gate`` operand, so the
+    # gated [E, C] message never exists in HBM (ops/pallas_multi_agg.py)
+    multi_agg: bool = False
+    remat_policy: str = "full"
 
     @nn.compact
     def __call__(self, inv, equiv, batch, train: bool = False):
@@ -41,19 +46,21 @@ class PNAPlusConv(nn.Module):
             e = nn.Dense(f_in)(jnp.concatenate([batch.edge_attr, rbf_emb], axis=-1))
         else:
             e = rbf_emb
-        # pre-MLP as a matmul-before-gather layer (layers.hoisted_pair_dense)
-        msg = hoisted_pair_dense(
-            f_in, inv, batch, "pre_recv", "pre_send", [("pre_edge", e)]
+        # pre-MLP (pre_layers=1), factored so the fused route can gather
+        # the receiver projection in-kernel (models/pna.py pna_pre_message)
+        node_recv, edge_in = pna_pre_message(
+            f_in, inv, batch, [("pre_edge", e)]
         )
-        # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276).
-        # Like PNA, this path does NOT use the fused edge kernel
-        # (cfg.fused_edge_kernel): the gated message feeds four aggregators
-        # (mean/min/max/std), so [E, C] must exist in HBM anyway and fusion
-        # removes no traffic — see models/pna.py for the decision record.
-        msg = msg * nn.Dense(f_in, use_bias=False)(rbf)
+        # Hadamard gate by the raw rbf projection (PNAPlusStack.py:268-276),
+        # applied inside pna_aggregate: the fused route streams it as the
+        # kernel's gate operand, the dense oracle multiplies post-gather
+        gate = nn.Dense(f_in, use_bias=False)(rbf)
 
-        scaled = pna_aggregate(msg, batch, self.deg_hist,
-                               self.sorted_agg, self.max_in_degree)
+        scaled = pna_aggregate(
+            edge_in, batch, self.deg_hist, self.sorted_agg,
+            self.max_in_degree, node_recv=node_recv, gate=gate,
+            multi_agg=self.multi_agg, remat_policy=self.remat_policy,
+        )
         out = nn.Dense(self.output_dim)(jnp.concatenate([inv, scaled], axis=-1))
         out = nn.Dense(self.output_dim)(out)
         return out, equiv
@@ -70,4 +77,6 @@ def make_pna_plus(cfg, in_dim, out_dim, last_layer):
         edge_dim=cfg.edge_dim,
         sorted_agg=cfg.sorted_aggregation,
         max_in_degree=cfg.max_in_degree,
+        multi_agg=cfg.fused_edge_kernel,
+        remat_policy=cfg.remat_policy,
     )

@@ -2,6 +2,7 @@ from .segment import (
     fused_edge_message_sum,
     masked_global_mean_pool,
     masked_global_sum_pool,
+    multi_moment_agg,
     segment_count,
     segment_max,
     segment_mean,
@@ -13,6 +14,7 @@ from .segment import (
 
 __all__ = [
     "fused_edge_message_sum",
+    "multi_moment_agg",
     "masked_global_mean_pool",
     "masked_global_sum_pool",
     "segment_count",

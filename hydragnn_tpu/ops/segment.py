@@ -131,6 +131,59 @@ def fused_edge_message_sum(
     )
 
 
+def _multiagg_route_enabled() -> bool:
+    """Whether ``multi_moment_agg`` routes to the fused multi-moment Pallas
+    kernel. ``HYDRAGNN_PALLAS_MULTIAGG=0/1`` is the dedicated override;
+    unset, the decision falls through to ``HYDRAGNN_PALLAS_SEGMENT`` /
+    the TPU-backend default, so one env flip drives every sorted kernel
+    in an A/B (the multichip dryrun relies on that)."""
+    pref = os.getenv("HYDRAGNN_PALLAS_MULTIAGG")
+    if pref is not None:
+        return pref == "1"
+    return _pallas_route_enabled()
+
+
+def multi_moment_agg(
+    edge_in,
+    segment_ids,
+    num_segments,
+    node_recv=None,
+    gate=None,
+    mask=None,
+    sorted_ids: bool = False,
+    max_degree: int = 0,
+):
+    """Multi-moment aggregation of ``(node_recv[ids] + edge_in) * gate``:
+    the five moments ``(sum, count, min, max, sumsq)`` every PNA-family
+    aggregate-and-scale derives from, in ONE pass — f32 each,
+    ``node_recv``/``gate`` optional (None).
+
+    Routing mirrors ``segment_sum``: receiver-sorted ids + a static
+    in-degree bound on a TPU jit target go through the multi-output
+    Pallas kernel (ops/pallas_multi_agg.py) — the [E, C] messages never
+    round-trip HBM; ``HYDRAGNN_PALLAS_MULTIAGG=1`` (or the shared
+    ``HYDRAGNN_PALLAS_SEGMENT=1``) forces the route off-TPU in interpret
+    mode; any other backend falls back to the dense plain-jnp reference,
+    which is the same function. Both routes differentiate to arbitrary
+    order (the kernel's tangent rule is plain jnp), so energy-force
+    training composes. ``mask`` is honored only on the dense route — the
+    sorted layout neutralizes padding edges by construction (they all
+    land on the final dummy node, masked downstream)."""
+    if sorted_ids and os.getenv("HYDRAGNN_DEBUG_SORTED") == "1":
+        _debug_check_sorted(segment_ids)
+    from .pallas_multi_agg import fused_multi_agg, reference_multi_agg
+
+    if (sorted_ids and max_degree and edge_in.ndim == 2
+            and _multiagg_route_enabled()):
+        return fused_multi_agg(
+            node_recv, edge_in, gate, segment_ids, num_segments, max_degree,
+            interpret=jax.default_backend() != "tpu",
+        )
+    return reference_multi_agg(
+        node_recv, edge_in, gate, segment_ids, num_segments, mask=mask
+    )
+
+
 def segment_count(segment_ids, num_segments, mask=None):
     ones = jnp.ones(segment_ids.shape[:1], jnp.float32)
     if mask is not None:
@@ -174,11 +227,19 @@ def segment_min(messages, segment_ids, num_segments, mask=None):
 
 
 def segment_std(messages, segment_ids, num_segments, mask=None, eps: float = 1e-5):
-    """Population std per segment (PNA 'std' aggregator semantics)."""
-    mean = segment_mean(messages, segment_ids, num_segments, mask)
-    mean_sq = segment_mean(messages**2, segment_ids, num_segments, mask)
+    """Population std per segment (PNA 'std' aggregator semantics).
+
+    Guarded against catastrophic cancellation: the moments accumulate in
+    f32 regardless of the message dtype, and the E[x²]−E[x]² variance is
+    clamped at zero BEFORE the sqrt — a bf16 near-constant segment
+    otherwise yields a small negative variance (E[x²] and E[x]² agree to
+    ~8 bits and the subtraction is pure rounding noise) and a NaN std
+    that poisons the whole PNA step."""
+    m = messages.astype(jnp.float32)
+    mean = segment_mean(m, segment_ids, num_segments, mask)
+    mean_sq = segment_mean(m * m, segment_ids, num_segments, mask)
     var = jnp.maximum(mean_sq - mean**2, 0.0)
-    return jnp.sqrt(var + eps)
+    return jnp.sqrt(var + eps).astype(messages.dtype)
 
 
 def segment_softmax(logits, segment_ids, num_segments, mask=None):

@@ -178,7 +178,9 @@ def make_branch_parallel_train_step(
         return tot.astype(jnp.float32), (tasks, mutated)
 
     if cfg.conv_checkpointing:
-        per_device_loss = jax.checkpoint(per_device_loss)
+        from ..ops.remat import loss_remat
+
+        per_device_loss = loss_remat(per_device_loss, cfg.remat_policy)
 
     def _mixed_pmean(tree, scale_enc, scale_dec_vec):
         """pmean with decoder subtrees reduced over data only (per-BRANCH

@@ -87,7 +87,9 @@ def make_parallel_train_step(
         return tot.astype(jnp.float32), (tasks, mutated)
 
     if cfg.conv_checkpointing:
-        per_device_loss = jax.checkpoint(per_device_loss)
+        from ..ops.remat import loss_remat
+
+        per_device_loss = loss_remat(per_device_loss, cfg.remat_policy)
 
     def sharded_grads(params, batch_stats, batch, rng):
         # batch leaves arrive with leading axis [D_local=1, ...] inside the

@@ -524,6 +524,7 @@ class CompilePlane:
         mode: str = "background",
         retrace_policy: str = "warn",
         log_name: str = "run",
+        remat_policy: str = "full",
     ):
         if mode not in PRECOMPILE_MODES:
             raise ValueError(
@@ -537,6 +538,11 @@ class CompilePlane:
         self.mode = mode
         self.retrace_policy = retrace_policy
         self.log_name = log_name
+        # Training.remat_policy, carried so the flops/MFU accounting below
+        # records WHICH recompute schedule its XLA-counted step FLOPs were
+        # measured under (remat changes the counted FLOPs — a policy A/B
+        # without this field would bank incomparable MFU numbers)
+        self.remat_policy = remat_policy
         self.cache_dir: Optional[str] = None
         self.jobs: List[Tuple[str, Callable]] = []
         self.compiled: List[Tuple[str, float]] = []  # (label, secs)
@@ -746,6 +752,7 @@ class CompilePlane:
         return {
             "mode": self.mode,
             "cache_dir": self.cache_dir,
+            "remat_policy": self.remat_policy,
             "specializations": len(self.jobs),
             "precompiled": len(self.compiled),
             "compile_time_s": round(
@@ -770,6 +777,7 @@ def format_report(rep: Dict[str, Any]) -> str:
     ttfs = rep.get("time_to_first_step")
     return (
         f"compile plane: mode={rep['mode']} "
+        f"remat={rep.get('remat_policy', 'full')} "
         f"precompiled={rep['precompiled']}/{rep['specializations']} "
         f"compile_time_s={rep['compile_time_s']} "
         f"cache_hits={rep['cache_hits']} cache_misses={rep['cache_misses']} "

@@ -134,8 +134,12 @@ def make_train_step(
 
     if cfg.conv_checkpointing:
         # rematerialize the forward during backward (reference: per-conv torch
-        # checkpoint, Base.py:459-465; jax.checkpoint trades FLOPs for HBM)
-        loss_fn = jax.checkpoint(loss_fn)
+        # checkpoint, Base.py:459-465), with the save rule picked by
+        # Training.remat_policy (ops/remat.py — 'names' keeps the Pallas
+        # kernel outputs instead of re-running the kernels in the backward)
+        from ..ops.remat import loss_remat
+
+        loss_fn = loss_remat(loss_fn, cfg.remat_policy)
 
     from .compile_plane import note_trace
 
@@ -588,6 +592,7 @@ def train_validate_test(
         mode=str(training.get("precompile", "background")),
         retrace_policy=str(training.get("retrace_policy", "warn")),
         log_name=log_name,
+        remat_policy=str(training.get("remat_policy", "full")),
     )
     step_fn = plane.launch(
         step_fn,

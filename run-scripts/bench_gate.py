@@ -126,6 +126,16 @@ def gate_bench(
     for key, val in cells_of(cand_parsed).items():
         base = baseline.get(key)
         if base is None:
+            # a cell name introduced THIS round (e.g. a new kernel's A/B
+            # cells) has no prior-round counterpart: report it as skipped —
+            # visibly, so a typo'd cell name can't silently drop out of the
+            # gate forever — and never crash or fail on it; it becomes a
+            # baseline for the next round
+            report.append(
+                f"bench_gate: r{cand_n:02d} {key!r} = {val:g} has no "
+                "prior-round counterpart — skipped (new cell, gated from "
+                "the next round)"
+            )
             continue
         base_n, base_val = base
         compared += 1

@@ -37,6 +37,9 @@ BENCH_GPS_SMOKE=1 python bench.py
 echo "== BENCH_GUARD smoke (guarded==unguarded loss, f32+bf16; step-time A/B shape) =="
 BENCH_GUARD_SMOKE=1 python bench.py
 
+echo "== BENCH_PNA smoke (PNA multi-agg bench cells build + train on CPU; fused==dense) =="
+BENCH_PNA_SMOKE=1 python bench.py
+
 echo "== compile-plane smoke (background precompile + error-mode retrace sentinel; cold -> warm cache) =="
 python run-scripts/compile_smoke.py
 

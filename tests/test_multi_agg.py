@@ -1,0 +1,523 @@
+"""Multi-output fused PNA aggregation kernel (interpret mode on CPU) vs the
+dense reference: forward, grad, grad-of-grad, f32/bf16 under jit, ragged /
+empty-segment / singleton / overflow-poison paddings, routing + config +
+lint wiring, remat policies, the segment_std cancellation guard, and
+model-level PNA-family fused==unfused loss equality
+(ops/pallas_multi_agg.py, ops/segment.py, ops/remat.py, models/pna*.py).
+"""
+
+import copy
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hydragnn_tpu.ops.pallas_multi_agg import (
+    fused_multi_agg,
+    reference_multi_agg,
+)
+from test_pallas_segment import _sorted_capped_receivers
+
+MOMENTS = ("sum", "count", "min", "max", "sumsq")
+
+
+def _operands(rng, e, n, c, dtype=np.float32, use_recv=True, use_gate=False):
+    nr = (
+        jnp.asarray(rng.normal(size=(n, c)).astype(dtype)) if use_recv else None
+    )
+    ei = jnp.asarray(rng.normal(size=(e, c)).astype(dtype))
+    g = (
+        jnp.asarray(rng.normal(size=(e, c)).astype(dtype)) if use_gate else None
+    )
+    return nr, ei, g
+
+
+def _assert_moments_close(out, ref, rtol, atol):
+    for o, r, name in zip(out, ref, MOMENTS):
+        np.testing.assert_allclose(
+            np.asarray(o), np.asarray(r), rtol=rtol, atol=atol,
+            err_msg=f"moment {name!r} diverges",
+        )
+
+
+@pytest.mark.parametrize(
+    "e,n,c,max_degree,use_recv,use_gate",
+    [
+        (300, 50, 7, 16, True, False),    # odd width, PNA shape (recv only)
+        (1000, 128, 64, 20, True, True),  # PNAPlus shape (recv + rbf gate)
+        (37, 400, 3, 4, False, False),    # PNAEq shape (pre-built message),
+                                          # tiny ragged tail, many empty rows
+        (512, 64, 130, 16, False, True),  # >1 lane block, gate without recv
+        (1, 1, 1, 1, True, False),        # singleton segment, singleton edge
+    ],
+)
+def pytest_forward_matches_dense(e, n, c, max_degree, use_recv, use_gate):
+    rng = np.random.default_rng(e + n)
+    recv = jnp.asarray(_sorted_capped_receivers(rng, e, n, max_degree))
+    nr, ei, g = _operands(rng, e, n, c, use_recv=use_recv, use_gate=use_gate)
+    out = jax.jit(
+        lambda nr, ei, g: fused_multi_agg(
+            nr, ei, g, recv, n, max_degree, interpret=True
+        )
+    )(nr, ei, g)
+    ref = reference_multi_agg(nr, ei, g, recv, n)
+    assert all(o.dtype == jnp.float32 for o in out)
+    _assert_moments_close(out, ref, 3e-5, 3e-5)
+
+
+def pytest_bf16_streams_with_f32_moments():
+    rng = np.random.default_rng(11)
+    recv = jnp.asarray(_sorted_capped_receivers(rng, 400, 64, 16))
+    nr, ei, g = _operands(rng, 400, 64, 32, use_gate=True)
+    cast = lambda x: None if x is None else x.astype(jnp.bfloat16)
+    out = fused_multi_agg(
+        cast(nr), cast(ei), cast(g), recv, 64, 16, interpret=True
+    )
+    # moments are f32 regardless of the stream dtype — the std's
+    # E[x²]−E[x]² subtraction needs the bits bf16 would have dropped
+    assert all(o.dtype == jnp.float32 for o in out)
+    ref = reference_multi_agg(nr, ei, g, recv, 64)
+    _assert_moments_close(out, ref, 4e-2, 4e-2)
+
+
+def pytest_empty_and_trailing_segments_are_zero():
+    """Segments with no edges (incl. a trailing run past the last edge)
+    come out zero in EVERY moment — the +/-BIG min/max accumulator
+    sentinels never leak into edge-less rows."""
+    rng = np.random.default_rng(2)
+    recv = jnp.asarray(np.array([2, 2, 5], np.int32))
+    nr, ei, g = _operands(rng, 3, 64, 4)
+    out = fused_multi_agg(nr, ei, None, recv, 64, 8, interpret=True)
+    ref = reference_multi_agg(nr, ei, None, recv, 64)
+    _assert_moments_close(out, ref, 1e-5, 1e-5)
+    mask = np.ones(64, bool)
+    mask[[2, 5]] = False
+    for o, name in zip(out, MOMENTS):
+        vals = np.asarray(o)
+        vals = vals[mask] if vals.ndim == 1 else vals[mask]
+        assert np.abs(vals).max() == 0.0, name
+
+
+def pytest_degree_spill_in_final_segment_is_contained():
+    """Over-cap blast radius pinned to the framework's padded layout: the
+    FINAL (dummy-node) segment holds several edge windows of spill; every
+    preceding segment must stay exact in all five moments."""
+    rng = np.random.default_rng(3)
+    n, max_degree = 40, 4
+    recv = np.concatenate([
+        np.repeat(np.arange(n, dtype=np.int32), max_degree - 1),
+        np.full(1500, n - 1, np.int32),
+    ])
+    recv = jnp.asarray(np.sort(recv).astype(np.int32))
+    e = recv.shape[0]
+    nr, ei, g = _operands(rng, e, n, 9, use_gate=True)
+    out = fused_multi_agg(nr, ei, g, recv, n, max_degree, interpret=True)
+    ref = reference_multi_agg(nr, ei, g, recv, n)
+    for o, r, name in zip(out, ref, MOMENTS):
+        np.testing.assert_allclose(
+            np.asarray(o)[: n - 1], np.asarray(r)[: n - 1],
+            rtol=3e-5, atol=3e-5, err_msg=f"moment {name!r} (pre-spill rows)",
+        )
+
+
+def _pna_style_loss(probe):
+    """The exact derivation pna_aggregate performs on the five moments."""
+
+    def loss(nr, ei, g, agg):
+        s, cnt, mn, mx, ssq = agg(nr, ei, g)
+        cnt1 = jnp.maximum(cnt, 1.0)[:, None]
+        mean = s / cnt1
+        std = jnp.sqrt(jnp.maximum(ssq / cnt1 - mean**2, 0.0) + 1e-5)
+        return jnp.sum(probe * jnp.tanh(mean + mn + mx + std))
+
+    return loss
+
+
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 3e-5), (jnp.bfloat16, 5e-2)])
+def pytest_gradients_match_dense(dtype, tol):
+    """First-order grads w.r.t. every differentiable operand, f32 and bf16
+    under jit: the custom-JVP tangent (the dense reference through jax.jvp)
+    transposes into the recompute backward."""
+    rng = np.random.default_rng(5)
+    n, e, c, max_degree = 48, 220, 12, 12
+    recv = jnp.asarray(_sorted_capped_receivers(rng, e, n, max_degree))
+    nr, ei, g = _operands(rng, e, n, c, use_gate=True)
+    cast = lambda x: x.astype(dtype)
+    nr, ei, g = cast(nr), cast(ei), cast(g)
+    probe = jnp.asarray(rng.normal(size=(n, c)).astype(np.float32))
+    loss = _pna_style_loss(probe)
+
+    fp = lambda nr, ei, g: fused_multi_agg(
+        nr, ei, g, recv, n, max_degree, interpret=True
+    )
+    fd = lambda nr, ei, g: reference_multi_agg(nr, ei, g, recv, n)
+    gp = jax.jit(jax.grad(loss, argnums=(0, 1, 2)), static_argnums=3)(
+        nr, ei, g, fp
+    )
+    gd = jax.jit(jax.grad(loss, argnums=(0, 1, 2)), static_argnums=3)(
+        nr, ei, g, fd
+    )
+    for a, b in zip(gp, gd):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=tol, atol=tol,
+        )
+
+
+@pytest.mark.parametrize("dtype,tol", [(np.float32, 2e-5), (jnp.bfloat16, 5e-2)])
+def pytest_grad_of_grad_force_style(dtype, tol):
+    """Force-style second order under jit: energy built through the fused
+    moments, forces = -dE/dpos via an inner jax.grad, outer training grad
+    w.r.t. projection weights and positions — the composition energy-force
+    PNA-family configs route through."""
+    rng = np.random.default_rng(7)
+    n, e, c, max_degree = 32, 150, 8, 10
+    recv = _sorted_capped_receivers(rng, e, n, max_degree)
+    send = rng.integers(0, n, e).astype(np.int32)
+    recv_j = jnp.asarray(recv)
+    pos = jnp.asarray(rng.normal(size=(n, 3)).astype(np.float32)).astype(dtype)
+    proj = jnp.asarray(rng.normal(size=(3, c)).astype(np.float32)).astype(dtype)
+
+    def energy(pos, proj, agg):
+        nr = pos @ proj
+        ei = (pos[send] - pos[recv]) @ proj
+        s, cnt, mn, mx, ssq = agg(nr, ei, None)
+        cnt1 = jnp.maximum(cnt, 1.0)[:, None]
+        mean = s / cnt1
+        std = jnp.sqrt(jnp.maximum(ssq / cnt1 - mean**2, 0.0) + 1e-5)
+        return jnp.sum((mean + std + mn * mx) ** 2)
+
+    def force_loss(proj, pos, agg):
+        f = -jax.grad(energy, argnums=0)(pos, proj, agg)
+        return jnp.sum(f**2) + energy(pos, proj, agg)
+
+    fp = lambda nr, ei, g: fused_multi_agg(
+        nr, ei, g, recv_j, n, max_degree, interpret=True
+    )
+    fd = lambda nr, ei, g: reference_multi_agg(nr, ei, g, recv_j, n)
+    for argnums in (0, 1):  # d(force loss)/dproj and /dpos — both 2nd order
+        gp = jax.jit(
+            jax.grad(force_loss, argnums=argnums), static_argnums=2
+        )(proj, pos, fp)
+        gd = jax.jit(
+            jax.grad(force_loss, argnums=argnums), static_argnums=2
+        )(proj, pos, fd)
+        scale = max(float(jnp.abs(gd.astype(jnp.float32)).max()), 1.0)
+        np.testing.assert_allclose(
+            np.asarray(gp, np.float32) / scale,
+            np.asarray(gd, np.float32) / scale, rtol=tol, atol=tol,
+        )
+
+
+def pytest_routing_override_and_fallback(monkeypatch):
+    """ops/segment.py multi_moment_agg routing: MULTIAGG=0 forces the dense
+    reference (bit-identical), =1 forces the kernel in interpret mode
+    off-TPU; unset, the shared HYDRAGNN_PALLAS_SEGMENT flag drives it (one
+    env flip for every sorted kernel — the dryrun's contract)."""
+    from hydragnn_tpu.ops.segment import multi_moment_agg
+
+    rng = np.random.default_rng(9)
+    n, e, max_degree = 30, 90, 8
+    recv = jnp.asarray(_sorted_capped_receivers(rng, e, n, max_degree))
+    nr, ei, _ = _operands(rng, e, n, 6)
+    ref = reference_multi_agg(nr, ei, None, recv, n)
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_MULTIAGG", "0")
+    out = multi_moment_agg(ei, recv, n, node_recv=nr, sorted_ids=True,
+                           max_degree=max_degree)
+    for o, r in zip(out, ref):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(r))
+
+    # =1 forces the kernel — PROVEN to engage, not inferred from closeness
+    import hydragnn_tpu.ops.pallas_multi_agg as pma
+
+    calls = {"n": 0}
+    real = pma.fused_multi_agg
+
+    def counting(*a, **kw):
+        calls["n"] += 1
+        return real(*a, **kw)
+
+    monkeypatch.setattr(pma, "fused_multi_agg", counting)
+    monkeypatch.setenv("HYDRAGNN_PALLAS_MULTIAGG", "1")
+    out_k = multi_moment_agg(ei, recv, n, node_recv=nr, sorted_ids=True,
+                             max_degree=max_degree)
+    assert calls["n"] == 1, "MULTIAGG=1 did not route to the Pallas kernel"
+    _assert_moments_close(out_k, ref, 3e-5, 3e-5)
+
+    # the shared segment flag reaches the multi-agg route when the
+    # dedicated override is unset
+    monkeypatch.delenv("HYDRAGNN_PALLAS_MULTIAGG", raising=False)
+    monkeypatch.setenv("HYDRAGNN_PALLAS_SEGMENT", "1")
+    out_s = multi_moment_agg(ei, recv, n, node_recv=nr, sorted_ids=True,
+                             max_degree=max_degree)
+    _assert_moments_close(out_s, ref, 3e-5, 3e-5)
+
+    # unsorted (or unbounded) calls can never reach the kernel
+    monkeypatch.setenv("HYDRAGNN_PALLAS_MULTIAGG", "1")
+    out_u = multi_moment_agg(ei, recv, n, node_recv=nr, sorted_ids=False,
+                             max_degree=0)
+    for o, r in zip(out_u, ref):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(r))
+
+
+def pytest_segment_std_constant_segment_regression():
+    """The cancellation guard (satellite): a CONSTANT-feature segment's
+    E[x²]−E[x]² is pure rounding noise — in bf16 it lands negative and an
+    unguarded sqrt yields NaN. segment_std must clamp at zero and return
+    sqrt(eps) exactly, in f32 AND bf16, and the fused route's std
+    derivation (moments in f32, clamped) must agree."""
+    from hydragnn_tpu.ops.segment import multi_moment_agg, segment_std
+
+    ids = jnp.asarray(np.array([0, 0, 0, 1, 1, 2], np.int32))
+    # large constant value maximizes the relative rounding noise
+    const = 333.25
+    for dtype in (jnp.float32, jnp.bfloat16):
+        msg = jnp.full((6, 4), const, dtype)
+        std = segment_std(msg, ids, 3)
+        assert std.dtype == dtype
+        vals = np.asarray(std, np.float32)
+        assert np.isfinite(vals).all(), vals
+        np.testing.assert_allclose(vals, np.sqrt(1e-5), rtol=1e-2)
+        # fused-route derivation from the five moments
+        s, cnt, mn, mx, ssq = multi_moment_agg(
+            msg, ids, 3, sorted_ids=True, max_degree=4
+        )
+        cnt1 = jnp.maximum(cnt, 1.0)[:, None]
+        mean = s / cnt1
+        var = jnp.maximum(ssq / cnt1 - mean**2, 0.0)
+        fused_std = np.asarray(jnp.sqrt(var + 1e-5))
+        assert np.isfinite(fused_std).all()
+        np.testing.assert_allclose(fused_std[:2], np.sqrt(1e-5), rtol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# config completion + lint + remat policy wiring
+# ---------------------------------------------------------------------------
+
+
+def _pna_config(mpnn_type="PNA", use_sorted=True):
+    return {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": mpnn_type,
+                "radius": 5.0,
+                "max_neighbours": 10,
+                "hidden_dim": 16,
+                "num_conv_layers": 2,
+                "use_sorted_aggregation": use_sorted,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 16,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [16, 16],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["energy"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": 8,
+                "num_epoch": 1,
+                "Optimizer": {"type": "AdamW", "learning_rate": 5e-3},
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 3]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+
+
+def _shaped_graphs():
+    from hydragnn_tpu.data import oc20_shaped_dataset, split_dataset
+
+    graphs = oc20_shaped_dataset(24, mean_atoms=20, min_atoms=10,
+                                 max_atoms=40, max_neighbours=10)
+    out = []
+    for g in graphs:
+        out.append(dataclasses.replace(
+            g, x=np.asarray(g.z, np.float32)[:, None], graph_y=None
+        ))
+    return split_dataset(out, 0.8, seed=0)
+
+
+def pytest_remat_policy_completion_and_lint():
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.config.lint import lint_config
+    from hydragnn_tpu.models import create_model
+
+    tr, va, te = _shaped_graphs()
+    # default preserves today's per-call behavior
+    done = update_config(copy.deepcopy(_pna_config()), tr, va, te)
+    assert done["NeuralNetwork"]["Training"]["remat_policy"] == "full"
+
+    # every named policy completes and threads into the ModelConfig
+    for policy in ("none", "dots", "names", "full"):
+        cfg = copy.deepcopy(_pna_config())
+        cfg["NeuralNetwork"]["Training"]["remat_policy"] = policy
+        done = update_config(cfg, tr, va, te)
+        model = create_model(done)
+        assert model.cfg.remat_policy == policy
+
+    # a typo'd policy fails at load time, not mid-training
+    bad = copy.deepcopy(_pna_config())
+    bad["NeuralNetwork"]["Training"]["remat_policy"] = "sometimes"
+    with pytest.raises(ValueError, match="remat_policy"):
+        update_config(bad, tr, va, te)
+
+    # the lint classifies the key as handled, not unknown
+    findings = {
+        f.path: f.status
+        for f in lint_config(
+            {"NeuralNetwork": {"Training": {"remat_policy": "names"}}}
+        )
+    }
+    assert findings["NeuralNetwork.Training.remat_policy"] == "handled"
+
+
+def pytest_remat_policies_are_numerics_neutral(monkeypatch):
+    """Every remat_policy value gives the SAME training-step loss on the
+    kernel route — the policy moves residuals between forward and
+    backward, never the math."""
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data import GraphLoader
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_MULTIAGG", "1")
+    tr, va, te = _shaped_graphs()
+    base = update_config(copy.deepcopy(_pna_config()), tr, va, te)
+    loader = GraphLoader(tr, 8, seed=0, drop_last=True, sort_edges=True)
+    batch = next(iter(loader))
+    losses = {}
+    variables = None
+    for policy in ("full", "none", "dots", "names"):
+        c = copy.deepcopy(base)
+        c["NeuralNetwork"]["Training"]["remat_policy"] = policy
+        model = create_model(c)
+        if variables is None:
+            variables = init_model(model, batch, seed=0)
+        tx = make_optimizer(c["NeuralNetwork"]["Training"]["Optimizer"])
+        state = TrainState.create(
+            jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                   variables), tx,
+        )
+        step = make_train_step(model, tx)
+        _, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+        losses[policy] = float(tot)
+        assert np.isfinite(losses[policy]), (policy, losses)
+    ref = losses["full"]
+    for policy, v in losses.items():
+        assert abs(v - ref) <= 1e-6 * max(1.0, abs(ref)), losses
+
+
+def pytest_conv_checkpointing_composes_with_policies():
+    """The whole-loss conv_checkpointing wrap under each policy trains and
+    matches the unwrapped loss (remat never changes values)."""
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data import GraphLoader
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    tr, va, te = _shaped_graphs()
+    base = update_config(copy.deepcopy(_pna_config()), tr, va, te)
+    loader = GraphLoader(tr, 8, seed=0, drop_last=True, sort_edges=True)
+    batch = next(iter(loader))
+    losses = {}
+    variables = None
+    for tag, ckpt, policy in (
+        ("off", False, "full"),
+        ("full", True, "full"),
+        ("names", True, "names"),
+        ("dots", True, "dots"),
+    ):
+        c = copy.deepcopy(base)
+        c["NeuralNetwork"]["Training"]["conv_checkpointing"] = ckpt
+        c["NeuralNetwork"]["Training"]["remat_policy"] = policy
+        model = create_model(c)
+        if variables is None:
+            variables = init_model(model, batch, seed=0)
+        tx = make_optimizer(c["NeuralNetwork"]["Training"]["Optimizer"])
+        state = TrainState.create(
+            jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True),
+                                   variables), tx,
+        )
+        step = make_train_step(model, tx)
+        _, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+        losses[tag] = float(tot)
+    ref = losses["off"]
+    for tag, v in losses.items():
+        assert abs(v - ref) <= 1e-6 * max(1.0, abs(ref)), losses
+
+
+def pytest_compile_plane_reports_remat_policy():
+    from hydragnn_tpu.train.compile_plane import CompilePlane, format_report
+
+    plane = CompilePlane(mode="off", remat_policy="names")
+    rep = plane.report()
+    assert rep["remat_policy"] == "names"
+    assert "remat=names" in format_report(rep)
+
+
+# ---------------------------------------------------------------------------
+# model level: the fused route is the same function and the same parameter
+# tree as the dense spelling, for every PNA-family conv
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mpnn_type", ["PNA", "PNAPlus", "PNAEq"])
+@pytest.mark.parametrize("route_env", ["0", "1"])
+def pytest_pna_family_fused_equals_unfused(monkeypatch, mpnn_type, route_env):
+    """One training step on a real sorted batch: identical init param
+    trees, loss agreement between the multi-agg route and the dense
+    four-reduction spelling, on BOTH the dense fallback (env 0) and the
+    interpret kernel (env 1)."""
+    from hydragnn_tpu.config import update_config
+    from hydragnn_tpu.data import GraphLoader
+    from hydragnn_tpu.models import create_model, init_model
+    from hydragnn_tpu.train import TrainState, make_optimizer, make_train_step
+
+    monkeypatch.setenv("HYDRAGNN_PALLAS_MULTIAGG", route_env)
+    tr, va, te = _shaped_graphs()
+    config = update_config(
+        copy.deepcopy(_pna_config(mpnn_type)), tr, va, te
+    )
+    assert config["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"]
+    loader = GraphLoader(tr, 8, seed=0, drop_last=True, sort_edges=True)
+    batch = next(iter(loader))
+    losses, params0, sig0 = {}, None, None
+    for fused in (True, False):
+        c = copy.deepcopy(config)
+        c["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] = fused
+        model = create_model(c)
+        variables = init_model(model, batch, seed=0)
+        sig = tuple(sorted(
+            str(p) for p, _ in jax.tree_util.tree_leaves_with_path(variables)
+        ))
+        if sig0 is None:
+            params0, sig0 = variables, sig
+        else:
+            assert sig == sig0, f"{mpnn_type} fused/unfused param trees differ"
+        tx = make_optimizer(c["NeuralNetwork"]["Training"]["Optimizer"])
+        state = TrainState.create(
+            jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), params0),
+            tx,
+        )
+        step = make_train_step(model, tx)
+        _, tot, _ = step(state, batch, jax.random.PRNGKey(0))
+        losses[fused] = float(tot)
+    assert np.isfinite(losses[True]) and np.isfinite(losses[False])
+    assert abs(losses[True] - losses[False]) <= 1e-4 * max(
+        1.0, abs(losses[False])
+    ), (mpnn_type, losses)

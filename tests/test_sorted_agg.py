@@ -187,6 +187,44 @@ def pytest_kernel_on_real_batch_layout():
     )
 
 
+@pytest.mark.parametrize("mpnn_type", ["PNA", "PNAPlus", "PNAEq"])
+def pytest_pna_family_auto_enables_multi_agg(mpnn_type):
+    """ONE knob: PNA-family configs with sorted_aggregation auto-enable the
+    multi-agg route through the SAME use_fused_edge_kernel completion that
+    EGNN's fused edge path follows — no extra config key, and the model
+    factory threads the flag into the conv as its ``multi_agg`` switch
+    (models/pna*.py; an explicit false opts out, same as EGNN)."""
+    import copy
+
+    from hydragnn_tpu.models import create_model
+    from hydragnn_tpu.models.base import get_conv_ctor
+
+    tr, va, te = _graphs()
+    cfg = _config(True)
+    cfg["NeuralNetwork"]["Architecture"]["mpnn_type"] = mpnn_type
+    cfg["NeuralNetwork"]["Architecture"]["equivariance"] = (
+        mpnn_type == "PNAEq"
+    )
+    config = update_config(copy.deepcopy(cfg), tr, va, te)
+    arch = config["NeuralNetwork"]["Architecture"]
+    assert arch["use_fused_edge_kernel"] is True  # follows sorted-agg
+    assert arch["max_in_degree"] > 0
+    model = create_model(config)
+    assert model.cfg.fused_edge_kernel is True
+    _, ctor = get_conv_ctor(mpnn_type)
+    conv = ctor(model.cfg, 16, 16, True)
+    assert conv.multi_agg is True
+    assert conv.sorted_agg is True and conv.max_in_degree > 0
+
+    # explicit opt-out stays one flag too
+    off = copy.deepcopy(cfg)
+    off["NeuralNetwork"]["Architecture"]["use_fused_edge_kernel"] = False
+    done_off = update_config(off, tr, va, te)
+    model_off = create_model(done_off)
+    conv_off = ctor(model_off.cfg, 16, 16, True)
+    assert conv_off.multi_agg is False
+
+
 def pytest_sorted_agg_allowed_for_grad_energy(monkeypatch):
     """r6 inversion of the r5 guard: the sorted kernels now differentiate
     through a custom-JVP with plain-jnp tangents (ops/pallas_segment.py,

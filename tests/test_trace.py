@@ -391,6 +391,40 @@ def pytest_bench_gate_pass_fail_and_skips(tmp_path):
     assert bg.main(["--repo", d3]) == 0
 
 
+def pytest_bench_gate_new_cell_is_skipped_not_failed(tmp_path, capsys):
+    """A newest-round cell name with no prior-round counterpart (e.g. the
+    r11 BENCH_PNA cells on their first hardware round) must be REPORTED as
+    skipped — not crash, not fail the gate, and not silently vanish."""
+    bg = _bench_gate()
+    d = str(tmp_path)
+    cell = {"metric": "prod shape", "value": 100.0, "mfu": 0.2,
+            "vs_baseline": 2.0}
+    _write_round(d, 1, cell)
+    # the new round adds a brand-new auxiliary throughput cell AND a cell
+    # under a metric string no prior round carried
+    _write_round(d, 2, {**cell,
+                        "pna_fused_graphs_per_sec": 123.0})
+    assert bg.main(["--repo", d]) == 0
+    out = capsys.readouterr().out
+    assert "'pna_fused_graphs_per_sec'" in out
+    assert "skipped (new cell" in out
+    # the known cells still compared
+    assert "'prod shape :: value'" in out and " ok" in out
+    # strict mode is satisfied by the real comparisons, not the skips
+    assert bg.main(["--repo", d, "--strict"]) == 0
+    capsys.readouterr()  # drain the strict run's repeat output
+    # a round that is ONLY new cells still passes (nothing comparable) and
+    # reports every one of them as skipped rather than crashing
+    d2 = str(tmp_path / "allnew")
+    os.makedirs(d2)
+    _write_round(d2, 1, cell)
+    _write_round(d2, 2, {"metric": "brand new metric", "value": 5.0,
+                         "mfu": 0.1, "vs_baseline": 1.0})
+    assert bg.main(["--repo", d2]) == 0
+    out2 = capsys.readouterr().out
+    assert out2.count("skipped (new cell") == 3
+
+
 def pytest_bench_gate_trace_stage_timings(tmp_path):
     bg = _bench_gate()
     t = Tracer(str(tmp_path), rank0=True)
