@@ -695,6 +695,12 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
 
         cge = training.get("compute_grad_energy", False)
         mp = training.get("mixed_precision", False)
+        # Telemetry.numerics changes the step program (in-graph probes ride
+        # the outputs — obs/numerics.py), so the mesh builders must get the
+        # same resolution the loop applies to its default builders
+        from .obs.telemetry import resolve_telemetry as _resolve_telemetry
+
+        numerics_on = bool(_resolve_telemetry(config)["numerics"])
         if branch_parallel:
             from .parallel.branch import (
                 make_branch_parallel_eval_step,
@@ -709,7 +715,9 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
 
             placement_fns.append(_place_branch)
             state = _place_branch(state)
-            _pstep = make_branch_parallel_train_step(model, tx, mesh, cge, mp)
+            _pstep = make_branch_parallel_train_step(
+                model, tx, mesh, cge, mp, numerics=numerics_on
+            )
             _peval = make_branch_parallel_eval_step(model, mesh, cge, mp)
         else:
             mesh = make_mesh()
@@ -739,6 +747,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             _pstep = make_parallel_train_step(
                 model, tx, mesh, cge, mp,
                 zero2=zero_stage >= 2, zero3=zero_stage >= 3,
+                numerics=numerics_on,
             )
             _peval = make_parallel_eval_step(model, mesh, cge, mp)
         # the wrappers hide the jit objects from the compile plane —
@@ -748,9 +757,17 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
 
         step_fn = attach_lower_fn(
             lambda s, b, r: _pstep(s, promote_batch(b, mesh), r),
-            _pstep,
+            # a numerics-enabled builder returns a wrapper carrying the
+            # true jit as _jitted (parallel/dp.py, parallel/branch.py)
+            getattr(_pstep, "_jitted", _pstep),
             lambda b: promote_batch(b, mesh),
         )
+        for _attr in ("_numerics_meta", "_nan_diagnose"):
+            # the numerics name tables + NaN drill-down travel with the
+            # step function the loop receives (train/loop.py reads them)
+            _val = getattr(_pstep, _attr, None)
+            if _val is not None:
+                setattr(step_fn, _attr, _val)
         # evaluate() expects (tot, tasks, aux) like make_eval_step
         eval_fn = attach_lower_fn(
             lambda s, b: _peval(s, promote_batch(b, mesh)) + (None,),

@@ -176,6 +176,7 @@ _HANDLED = {
     "Telemetry.trace_sample",
     "Telemetry.trace_interval_steps",
     "Telemetry.flight_recorder",
+    "Telemetry.numerics",
     "Mixture.temperature",
     "Mixture.weights",
     "Mixture.draws_per_epoch",

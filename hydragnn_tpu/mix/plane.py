@@ -603,6 +603,10 @@ class MixturePlane:
                             for k, c in cursors.items()},
             }
             graphs, sids, draw = self._fill_batch(epoch, draw, cursors, True)
+            # batch provenance for the guard/numerics planes: which sources
+            # this batch drew from, keyed by batch index — prefetch builds
+            # ahead of consumption, so "last batch" would lie (batch_sources)
+            self._journal[b]["sids"] = sorted(set(sids))
             # the position AFTER this batch too: a preemption cursor can
             # point one past the last batch built (lookahead == 0)
             self._journal[b + 1] = {
@@ -623,6 +627,17 @@ class MixturePlane:
                     flush=True,
                 )
             yield batch_graphs(graphs, spec, sort_edges=self.sort_edges)
+
+    def batch_sources(self, b) -> Optional[List[int]]:
+        """Source ids batch ``b`` of the CURRENT epoch drew from, or None
+        before the batch was built. The loop attaches this to guard-skip /
+        numerics-provenance events (train/loop.py) so a poisoned source is
+        identifiable from the event stream alone (ISSUE 12 satellite)."""
+        entry = self._journal.get(int(b))
+        if entry is None:
+            return None
+        sids = entry.get("sids")
+        return list(sids) if sids else None
 
     def spec_template_batches(self) -> List[Tuple[PadSpec, GraphBatch]]:
         """Warm-up templates over the ladder levels any mixture batch can

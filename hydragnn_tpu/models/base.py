@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..data.graph import GraphBatch
+from ..obs.numerics import probe
 from ..ops.segment import masked_global_mean_pool
 from .layers import MLP, MaskedBatchNorm, get_activation
 
@@ -377,12 +378,21 @@ class HydraModel(nn.Module):
         cfg = self.cfg
         act = get_activation(cfg.activation)
         inv, equiv, batch = self._embedding(batch)
+        # numerics taps (obs/numerics.py): named intermediates for the
+        # in-graph layer statistics + NaN provenance drill-down. Exact
+        # no-ops (absent from the jaxpr) unless a collection context is
+        # active at trace time — i.e. unless Telemetry.numerics is on.
+        # Masked: padding rows carry garbage by contract (see class doc).
+        probe("embedding", inv, batch.node_mask)
         # Activation rematerialization (the reference's per-conv torch
         # checkpoint, Base.py:459-465) is applied by the training step via
         # jax.checkpoint over the whole loss when cfg.conv_checkpointing.
-        for conv, feat_layer in zip(self.graph_convs, self.feature_layers):
+        for i, (conv, feat_layer) in enumerate(
+            zip(self.graph_convs, self.feature_layers)
+        ):
             inv, equiv = conv(inv, equiv, batch, train)
             inv = act(feat_layer(inv, batch.node_mask, train))
+            probe(f"conv{i}", inv, batch.node_mask)
         return inv, equiv, batch
 
     def __call__(self, batch: GraphBatch, train: bool = False):
@@ -391,6 +401,7 @@ class HydraModel(nn.Module):
         x_graph = masked_global_mean_pool(
             x, batch.node_graph, batch.num_graphs, batch.node_mask
         )
+        probe("pooled", x_graph, batch.graph_mask)
 
         outputs: Dict[str, jnp.ndarray] = {}
         for ihead, (name, t, d) in enumerate(
@@ -401,6 +412,11 @@ class HydraModel(nn.Module):
             else:
                 out = self._node_head(ihead, x, equiv, batch, train)
             outputs[name] = out[..., :d]
+            probe(
+                f"head:{name}",
+                outputs[name],
+                batch.graph_mask if t == "graph" else batch.node_mask,
+            )
             if cfg.var_output:
                 outputs[f"{name}__var"] = out[..., d:] ** 2
         return outputs

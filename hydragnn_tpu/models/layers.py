@@ -158,7 +158,21 @@ class MaskedBatchNorm(nn.Module):
             mean, var = ra_mean.value, ra_var.value
 
         y = (x - mean) / jnp.sqrt(var + self.epsilon)
-        return y * scale + bias
+        y = y * scale + bias
+        # numerics tap (obs/numerics.py): the pre-activation normalized
+        # output, named by module path — a no-op unless Telemetry.numerics
+        # armed a collection context at trace time. Batch norm is the first
+        # place a collapsing variance shows (1/sqrt(var) blowing up), one
+        # layer before the activation probe in models/base.py sees it.
+        from ..obs.numerics import collection_active, probe
+
+        if collection_active():
+            try:
+                pname = "/".join(str(p) for p in self.path)
+            except Exception:
+                pname = self.name or "batchnorm"
+            probe(f"bn:{pname}", y, mask)
+        return y
 
 
 def pair_message_factored(dim, inv, batch, name_recv, name_send, edge_terms=()):

@@ -8,6 +8,7 @@ plane — request/step spans (obs/trace.py), the structured event log
 from .events import EventLog, events
 from .events import emit as emit_event
 from .flightrec import FlightRecorder
+from .numerics import NanWatch, numerics_enabled, probe
 from .prometheus import TelemetryHTTPServer, render_text, start_endpoint
 from .registry import (
     Counter,
@@ -24,6 +25,7 @@ from .telemetry import (
     host_memory_bytes,
     mfu_estimate,
     peak_flops,
+    publish_build_info,
     resolve_telemetry,
 )
 from .trace import Span, Tracer
@@ -36,6 +38,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "MetricsStream",
+    "NanWatch",
     "ProfileTrigger",
     "SCHEMA_VERSION",
     "Span",
@@ -46,7 +49,10 @@ __all__ = [
     "events",
     "host_memory_bytes",
     "mfu_estimate",
+    "numerics_enabled",
     "peak_flops",
+    "probe",
+    "publish_build_info",
     "registry",
     "render_text",
     "resolve_telemetry",

@@ -48,6 +48,7 @@ EV_MIX_SOURCE_ADD = "mix_source_add"      # mixture source hot-added
 EV_MIX_SOURCE_REMOVE = "mix_source_remove"  # mixture source hot-removed
 EV_MIX_DEMOTE = "mix_demote"              # source quarantine-demoted (mix/)
 EV_MIX_DRIFT = "mix_drift"                # per-branch loss diverged past threshold
+EV_NUMERICS_PROVENANCE = "numerics_provenance"  # NaN drill-down located a tensor
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
@@ -55,6 +56,7 @@ EVENT_KINDS = (
     EV_SHED, EV_QUEUE_FULL, EV_DEADLINE, EV_WEDGE, EV_DRAIN,
     EV_RELOAD_SWAP, EV_RELOAD_REJECT, EV_FLIGHT_DUMP,
     EV_MIX_SOURCE_ADD, EV_MIX_SOURCE_REMOVE, EV_MIX_DEMOTE, EV_MIX_DRIFT,
+    EV_NUMERICS_PROVENANCE,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")

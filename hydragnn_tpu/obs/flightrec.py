@@ -118,6 +118,24 @@ class FlightRecorder:
                 json.dump(self._spans(), fh, indent=2)
             with open(os.path.join(tmp, "metrics.prom"), "w") as fh:
                 fh.write(render_text())
+            # OOM forensics: the per-spec HBM table (compile-plane
+            # memory_analysis harvest) + live per-device memory stats —
+            # best-effort, a backend without either leaves empty sections
+            try:
+                from . import memory as _memory
+
+                with open(os.path.join(tmp, "memory.json"), "w") as fh:
+                    json.dump(
+                        {
+                            "hbm_by_spec": _memory.snapshot(),
+                            "device_memory_peak_bytes":
+                                _memory.device_memory_stats(),
+                        },
+                        fh,
+                        indent=2,
+                    )
+            except Exception:
+                pass
             os.rename(tmp, final)
             # the dump is itself an incident record (visible to later dumps
             # and to anyone tailing the event log)
@@ -173,6 +191,14 @@ class FlightRecorder:
                 )
             except ValueError:
                 pass  # not the main thread: exception hooks only
+        # every snapshot self-describes: the build-info gauge rides the
+        # registry snapshot of every dump (and every Prometheus scrape)
+        try:
+            from .telemetry import publish_build_info
+
+            publish_build_info()
+        except Exception:
+            pass
         _set_active(self)
         return self
 

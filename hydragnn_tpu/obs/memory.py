@@ -1,0 +1,121 @@
+"""HBM accounting: per-specialization memory figures harvested from XLA's
+``memory_analysis()`` (docs/OBSERVABILITY.md "Memory").
+
+Nothing in this repo read ``compiled.memory_analysis()`` before r12, so HBM
+headroom on the production shape was guesswork until an OOM. The compile
+plane already holds a compiled executable per AOT-warmed ladder level (it
+harvests ``cost_analysis()`` FLOPs there — train/compile_plane.py); this
+module is the memory sibling: ``record(label, compiled)`` harvests
+argument / output / temp / alias bytes plus the derived peak estimate into
+one process-wide table, publishes ``hydragnn_hbm_*`` gauges per spec, and
+the flight recorder dumps the table (plus live per-device memory stats) as
+the OOM-forensics section of every black box (obs/flightrec.py).
+
+``memory_analysis()`` availability is backend-dependent — everything here
+is best-effort by contract: a backend without it leaves the table empty and
+never raises into the compile path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+_LOCK = threading.Lock()
+_TABLE: Dict[str, Dict[str, float]] = {}
+
+# (table key, CompiledMemoryStats attribute, required) — only the fields
+# the peak estimate needs are mandatory; a jaxlib whose stats object lacks
+# e.g. generated_code_size_in_bytes must not blank the whole table
+_FIELDS = (
+    ("argument_bytes", "argument_size_in_bytes", True),
+    ("output_bytes", "output_size_in_bytes", True),
+    ("temp_bytes", "temp_size_in_bytes", True),
+    ("alias_bytes", "alias_size_in_bytes", True),
+    ("generated_code_bytes", "generated_code_size_in_bytes", False),
+)
+
+
+def harvest(compiled) -> Optional[Dict[str, float]]:
+    """Memory figures of one compiled executable, or None when the backend
+    does not expose ``memory_analysis()``. ``peak_bytes`` is the standard
+    estimate ``arguments + outputs + temp − aliased`` (donated buffers are
+    the alias term, so a donated train step is not double-counted)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    if isinstance(ma, (list, tuple)):
+        if not ma:
+            return None
+        ma = ma[0]
+    out: Dict[str, float] = {}
+    for key, attr, required in _FIELDS:
+        v = getattr(ma, attr, None)
+        if v is None:
+            if required:
+                return None  # a partial PEAK estimate would lie
+            v = 0.0
+        out[key] = float(v)
+    out["peak_bytes"] = max(
+        out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+        - out["alias_bytes"],
+        0.0,
+    )
+    return out
+
+
+def record(label: str, compiled=None,
+           stats: Optional[Dict[str, float]] = None) -> Optional[Dict[str, float]]:
+    """Harvest (or accept pre-harvested) figures for one spec label, store
+    them in the process table, and publish the ``hydragnn_hbm_*`` gauges.
+    Returns the stats dict, or None when unavailable."""
+    if stats is None:
+        if compiled is None:
+            return None
+        stats = harvest(compiled)
+    if stats is None:
+        return None
+    with _LOCK:
+        _TABLE[label] = dict(stats)
+    try:
+        from .registry import registry
+
+        reg = registry()
+        for key in ("argument_bytes", "output_bytes", "temp_bytes",
+                    "peak_bytes"):
+            reg.gauge(
+                f"hydragnn_hbm_{key}",
+                f"XLA memory_analysis {key.replace('_', ' ')} per compiled "
+                "specialization",
+                labelnames=("spec",),
+            ).set(stats[key], spec=label)
+    except Exception:
+        pass  # the table is the source of truth; gauges are best-effort
+    return stats
+
+
+def snapshot() -> Dict[str, Dict[str, float]]:
+    """The per-spec table (what the flight recorder and the compile-plane
+    report render)."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _TABLE.items()}
+
+
+def reset() -> None:
+    """Drop the table (tests)."""
+    with _LOCK:
+        _TABLE.clear()
+
+
+def device_memory_stats() -> Dict[str, Any]:
+    """Live per-device peak-bytes-in-use, best-effort (the flight
+    recorder's 'what was actually resident at the moment of death')."""
+    try:
+        from ..utils.profile import peak_memory_stats
+
+        return {str(k): float(v) for k, v in peak_memory_stats().items()}
+    except Exception:
+        return {}

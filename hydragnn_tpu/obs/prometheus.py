@@ -182,6 +182,14 @@ def start_endpoint(
     specific interface) from the owning config section."""
     import warnings
 
+    # every scrape self-describes (jax/jaxlib/backend/devices/git): the
+    # build-info gauge is published the moment a scrape surface exists
+    try:
+        from .telemetry import publish_build_info
+
+        publish_build_info()
+    except Exception:
+        pass
     try:
         return TelemetryHTTPServer(
             reg=reg, host=host, port=int(port), ready_fn=ready_fn,

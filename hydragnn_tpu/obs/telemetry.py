@@ -77,8 +77,15 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
     "trace_interval_steps": 50,
     # crash flight recorder (obs/flightrec.py): events + spans + registry
     # snapshot dumped on unhandled exception / SIGUSR2 / fatal guard /
-    # serve wedge; armed whenever the plane is on (enabled or trace)
+    # serve wedge; armed whenever the plane is on (enabled, trace, or
+    # numerics)
     "flight_recorder": True,
+    # in-graph numerics probes + NaN provenance drill-down (obs/numerics.py;
+    # docs/OBSERVABILITY.md "Numerics"): per-layer activation and per-param-
+    # group gradient statistics ride the step outputs, and a guarded skip
+    # re-runs its held batch through a probe-instrumented diagnostic that
+    # names the first non-finite tensor. HYDRAGNN_NUMERICS=1/0 overrides.
+    "numerics": False,
 }
 
 # peak dense bf16 FLOP/s by TPU generation (public figures; bench.py
@@ -89,6 +96,17 @@ PEAK_FLOPS = {
     "v5": 197e12,  # v5e / "TPU v5 lite"
     "v4": 275e12,
 }
+
+
+def env_flag(name: str) -> Optional[bool]:
+    """Tri-state boolean env parse shared by every HYDRAGNN_* on/off
+    override (HYDRAGNN_TELEMETRY, HYDRAGNN_NUMERICS, ...): None when
+    unset, else False for the falsy tokens and True otherwise — ONE
+    spelling, so the overrides cannot drift between entry points."""
+    v = os.getenv(name)
+    if v is None:
+        return None
+    return v.strip().lower() not in ("0", "off", "false", "")
 
 
 def peak_flops(device_kind: str) -> float:
@@ -123,9 +141,16 @@ def resolve_telemetry(config: Dict[str, Any]) -> Dict[str, Any]:
             section.pop(k)
     out = dict(TELEMETRY_DEFAULTS)
     out.update(section)
-    env = os.getenv("HYDRAGNN_TELEMETRY")
+    env = env_flag("HYDRAGNN_TELEMETRY")
     if env is not None:
-        out["enabled"] = env.strip().lower() not in ("0", "off", "false", "")
+        out["enabled"] = env
+    env_num = env_flag("HYDRAGNN_NUMERICS")
+    if env_num is not None:
+        out["numerics"] = env_num
+    if not isinstance(out["numerics"], bool):
+        raise ValueError(
+            f"Telemetry.numerics must be true/false, got {out['numerics']!r}"
+        )
     if int(out["interval_steps"]) < 1:
         raise ValueError(
             f"Telemetry.interval_steps must be >= 1, got "
@@ -159,6 +184,93 @@ def resolve_telemetry(config: Dict[str, Any]) -> Dict[str, Any]:
             f"{out['trace_interval_steps']!r}"
         )
     return out
+
+
+_GIT_DESCRIBE: Optional[str] = None
+
+
+def _git_describe() -> str:
+    """``git describe --always --dirty`` of the repo this package runs
+    from, cached; "unknown" outside a checkout (wheels, containers)."""
+    global _GIT_DESCRIBE
+    if _GIT_DESCRIBE is not None:
+        return _GIT_DESCRIBE
+    try:
+        import subprocess
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        # only trust git if the discovered repo IS this package's root: an
+        # installed (non-checkout) copy nested under some other project's
+        # checkout would otherwise stamp build-info with that repo's
+        # describe — a confidently wrong process identity
+        top = subprocess.run(
+            ["git", "-C", root, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=5,
+        )
+        if top.returncode != 0 or os.path.realpath(
+            top.stdout.strip()
+        ) != os.path.realpath(root):
+            _GIT_DESCRIBE = "unknown"
+            return _GIT_DESCRIBE
+        out = subprocess.run(
+            ["git", "describe", "--always", "--dirty"],
+            cwd=root, capture_output=True, text=True, timeout=5,
+        )
+        _GIT_DESCRIBE = (
+            out.stdout.strip() if out.returncode == 0 and out.stdout.strip()
+            else "unknown"
+        )
+    except Exception:
+        _GIT_DESCRIBE = "unknown"
+    return _GIT_DESCRIBE
+
+
+def publish_build_info() -> None:
+    """Publish the ``hydragnn_build_info`` info-gauge (value 1; the facts
+    ride the labels, Prometheus *_info convention): jax/jaxlib versions,
+    backend, device count, git describe. Idempotent by REGISTRY state, not
+    a module flag — a ``registry().reset()`` (second in-process run, tests)
+    must not leave later scrapes/dumps permanently without the series (the
+    per-process-baseline lesson the PR 5 sentinel report recorded). Every
+    scrape and flight-recorder snapshot self-describes once any publisher
+    (StepTelemetry, the endpoint, the recorder) has run."""
+    have = registry().get("hydragnn_build_info")
+    if have is not None and have.samples():
+        return
+    jax_v = jaxlib_v = backend = "unknown"
+    devices = 0
+    try:
+        import jax
+
+        jax_v = jax.__version__
+        backend = jax.default_backend()
+        devices = jax.device_count()
+    except Exception:
+        pass
+    try:
+        import jaxlib
+
+        jaxlib_v = jaxlib.__version__
+    except Exception:
+        pass
+    try:
+        registry().gauge(
+            "hydragnn_build_info",
+            "Build/runtime identity of this process (value is always 1; "
+            "the facts are the labels)",
+            labelnames=("jax", "jaxlib", "backend", "devices", "git"),
+        ).set(
+            1.0,
+            jax=jax_v,
+            jaxlib=jaxlib_v,
+            backend=backend,
+            devices=str(devices),
+            git=_git_describe(),
+        )
+    except Exception:
+        pass
 
 
 def host_memory_bytes() -> float:
@@ -453,7 +565,10 @@ class StepTelemetry:
         self._flops_cache: Dict[Tuple[int, int], Optional[float]] = {}
         self._device_kind: Optional[str] = None
         self._mem_refreshed_at = 0.0
+        self._numerics_meta: Optional[Dict[str, Any]] = None
+        self._g_num: Dict[str, Any] = {}
         self._reset_window()
+        publish_build_info()
 
         # -- sinks / registry ------------------------------------------------
         self.stream = (
@@ -552,6 +667,11 @@ class StepTelemetry:
         self._w_real = {"graphs": 0, "nodes": 0, "edges": 0}
         self._w_padded = {"graphs": 0, "nodes": 0, "edges": 0}
         self._w_buckets: Dict[Tuple[int, int], Dict[str, float]] = {}
+        # device-resident numerics stacks ([P,5] act, [G,5] grad per step):
+        # held un-synced until flush — by then the producing steps have
+        # retired, so the readback copies ready buffers instead of stalling
+        # the async dispatch pipeline
+        self._w_numerics: List[Tuple[Any, Any]] = []
 
     # -- wiring --------------------------------------------------------------
 
@@ -563,6 +683,12 @@ class StepTelemetry:
         unknown (the compile plane fills its table as warm-up progresses)."""
         self._flops_for = flops_for
 
+    def attach_numerics(self, meta: Dict[str, Any]) -> None:
+        """Install the numerics name tables (the step builder's mutable
+        meta cell — act_names/grad_names are written at trace time, so they
+        are populated by the time the first window flushes)."""
+        self._numerics_meta = meta
+
     def _flops_of(self, key: Tuple[int, int]) -> Optional[float]:
         got = self._flops_cache.get(key)
         if got is None and self._flops_for is not None:
@@ -573,12 +699,19 @@ class StepTelemetry:
 
     # -- per-step path -------------------------------------------------------
 
-    def on_step(self, batch, dt: float, real_graphs: Optional[int] = None) -> None:
+    def on_step(self, batch, dt: float, real_graphs: Optional[int] = None,
+                numerics: Optional[Dict[str, Any]] = None) -> None:
         """Record one optimizer step: ``dt`` is the host wall time of the
         dispatch (see module docstring for why that converges to device
         step time), ``real_graphs`` the already-computed mask count the
-        loop has anyway."""
+        loop has anyway, ``numerics`` the step's in-graph stat bundle
+        (obs/numerics.py) when ``Telemetry.numerics`` is on — held as
+        device arrays until flush."""
         self.global_step += 1
+        if numerics is not None:
+            self._w_numerics.append(
+                (numerics.get("act"), numerics.get("grad"))
+            )
         self._h_step.observe(dt, phase="train")
         real, padded, key = _batch_census(batch, real_graphs)
         self._w_steps += 1
@@ -636,6 +769,17 @@ class StepTelemetry:
         if flops_known and flops > 0:
             mfu = mfu_estimate(flops, dt, self._device_kind_cached())
             self._g_mfu.set(mfu)
+        num_rec = None
+        if self._w_numerics and self._numerics_meta is not None:
+            try:  # observability never takes the owner down
+                num_rec = self._flush_numerics()
+            except Exception as e:
+                warnings.warn(
+                    f"numerics window flush failed ({type(e).__name__}: "
+                    f"{e}); this window's layer statistics are dropped",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         self._update_memory_gauges()
         if self.stream is not None:
             self.stream.write(
@@ -656,6 +800,10 @@ class StepTelemetry:
                     "buckets": buckets,
                 },
             )
+            if num_rec is not None:
+                self.stream.write(
+                    "numerics", {"step": self.global_step, **num_rec}
+                )
         if self.writer is not None:
             self.writer.add_scalars(
                 {
@@ -671,6 +819,98 @@ class StepTelemetry:
         if self.trigger is not None:
             self.trigger.poll(self.global_step)
         self._reset_window()
+
+    def _numerics_gauges(self):
+        if not self._g_num:
+            reg = registry()
+            self._g_num = {
+                "max_abs": reg.gauge(
+                    "hydragnn_numerics_max_abs",
+                    "Per-tensor max |x| over the last telemetry window "
+                    "(obs/numerics.py probes)",
+                    labelnames=("kind", "tensor"),
+                ),
+                "rms": reg.gauge(
+                    "hydragnn_numerics_rms",
+                    "Per-tensor rms over the last telemetry window",
+                    labelnames=("kind", "tensor"),
+                ),
+                "underflow": reg.gauge(
+                    "hydragnn_numerics_bf16_underflow_fraction",
+                    "Fraction of (real) elements below the smallest normal "
+                    "bf16 magnitude over the last window",
+                    labelnames=("kind", "tensor"),
+                ),
+                "nonfinite": reg.counter(
+                    "hydragnn_numerics_nonfinite_total",
+                    "Non-finite elements seen per tensor (windows "
+                    "accumulate)",
+                    labelnames=("kind", "tensor"),
+                ),
+            }
+        return self._g_num
+
+    @staticmethod
+    def _combine_numerics(stacks):
+        """Merge per-step [P,5] stacks over the window: max-abs by max,
+        the summed moments by sum. Returns a host [P,5] array or None."""
+        arrs = [np.asarray(s) for s in stacks if s is not None and s.size]
+        if not arrs:
+            return None
+        stacked = np.stack(arrs)  # [W, P, 5]
+        out = np.empty(stacked.shape[1:], np.float64)
+        out[:, 0] = stacked[:, :, 0].max(axis=0)
+        out[:, 1:] = stacked[:, :, 1:].sum(axis=0)
+        return out
+
+    @staticmethod
+    def _json_stat(v: float):
+        # metrics.jsonl stays strict-JSON parseable: non-finite stats are
+        # the SIGNAL here, so encode them as strings instead of bare NaN
+        return float(v) if np.isfinite(v) else str(v)
+
+    def _flush_numerics(self) -> Optional[Dict[str, Any]]:
+        """Aggregate the window's numerics stacks, publish the per-tensor
+        gauges, and return the metrics.jsonl ``numerics`` record body."""
+        from .numerics import finalize_stats
+
+        stacks, self._w_numerics = self._w_numerics, []
+        acts = self._combine_numerics([a for a, _ in stacks])
+        grads = self._combine_numerics([g for _, g in stacks])
+        meta = self._numerics_meta or {}
+        gauges = self._numerics_gauges()
+        record: Dict[str, Any] = {}
+        for kind, names, table in (
+            ("activation", meta.get("act_names"), acts),
+            ("gradient", meta.get("grad_names"), grads),
+        ):
+            if table is None:
+                continue
+            section: Dict[str, Any] = {}
+            for i in range(table.shape[0]):
+                name = (
+                    names[i] if names and i < len(names) else f"{kind}{i}"
+                )
+                st = finalize_stats(table[i])
+                gauges["max_abs"].set(st["max_abs"], kind=kind, tensor=name)
+                gauges["rms"].set(st["rms"], kind=kind, tensor=name)
+                gauges["underflow"].set(
+                    st["bf16_underflow"], kind=kind, tensor=name
+                )
+                if st["nonfinite"] > 0:
+                    gauges["nonfinite"].inc(
+                        st["nonfinite"], kind=kind, tensor=name
+                    )
+                section[name] = {
+                    "max_abs": self._json_stat(st["max_abs"]),
+                    "rms": self._json_stat(st["rms"]),
+                    "nonfinite": int(st["nonfinite"]),
+                    "bf16_underflow": round(st["bf16_underflow"], 6),
+                }
+            record["activations" if kind == "activation" else "gradients"] = (
+                section
+            )
+        return record or None
 
     def _device_kind_cached(self) -> str:
         if self._device_kind is None:

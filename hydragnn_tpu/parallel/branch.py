@@ -144,6 +144,7 @@ def make_branch_parallel_train_step(
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
     guard=None,
+    numerics=None,
 ):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks): DP over
     ``data`` x decoder-sharded ``branch``. The stacked batch must be
@@ -160,9 +161,15 @@ def make_branch_parallel_train_step(
     # resolve at BUILD time like the other step builders (dp.py, loop.py):
     # the env default must freeze when the step is constructed, not when it
     # first traces, and guard=True/False gives programmatic A/B control
+    from ..obs import numerics as obs_numerics
     from ..train.guard import guard_enabled
 
     use_guard = guard_enabled(guard)
+    # Telemetry.numerics (obs/numerics.py): probes tap the LOCAL branch
+    # slice's modules per device; activation stats merge across the mesh
+    # inside the shard_map, so one census covers every branch
+    use_numerics = obs_numerics.numerics_enabled(numerics)
+    meta = {"act_names": None, "grad_names": None}
 
     def per_device_loss(params, batch_stats, batch, rng):
         if mixed_precision:
@@ -170,12 +177,15 @@ def make_branch_parallel_train_step(
 
             params, batch = mp_cast(params, batch, compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
-        tot, tasks, mutated, _ = compute_loss(
-            local, variables, batch, lcfg, True, rng, compute_grad_energy
+        (tot, tasks, mutated, _), acts = obs_numerics.run_probed(
+            use_numerics, meta,
+            lambda: compute_loss(
+                local, variables, batch, lcfg, True, rng, compute_grad_energy
+            ),
         )
         if mixed_precision:
             mutated = mp_restore_stats(mutated)
-        return tot.astype(jnp.float32), (tasks, mutated)
+        return tot.astype(jnp.float32), (tasks, mutated, acts)
 
     if cfg.conv_checkpointing:
         from ..ops.remat import loss_remat
@@ -216,7 +226,7 @@ def make_branch_parallel_train_step(
             batch.dataset_id.astype(jnp.int32) - br * b_local, 0, b_local - 1
         )
         batch = batch.replace(dataset_id=local_ds)
-        (tot, (tasks, mutated)), grads = jax.value_and_grad(
+        (tot, (tasks, mutated, acts)), grads = jax.value_and_grad(
             per_device_loss, has_aux=True
         )(params, batch_stats, batch, rng)
         gm = batch.graph_mask.astype(jnp.float32)
@@ -253,6 +263,9 @@ def make_branch_parallel_train_step(
         )
         stats = mutated.get("batch_stats", batch_stats)
         new_stats = _mixed_pmean(stats, scale_enc, scale_dec_vec)
+        if use_numerics:
+            acts = obs_numerics.cross_device_reduce(acts, _BOTH)
+            return grads, tot, tasks, new_stats, acts
         return grads, tot, tasks, new_stats
 
     rep = P()
@@ -279,12 +292,18 @@ def make_branch_parallel_train_step(
                 rep,
                 rep,
                 _specs_like(state.batch_stats),
-            ),
+            ) + ((rep,) if use_numerics else ()),
             check_vma=False,
         )
-        grads, tot, tasks, new_stats = grad_map(
-            state.params, state.batch_stats, batch, rng
-        )
+        acts = None
+        if use_numerics:
+            grads, tot, tasks, new_stats, acts = grad_map(
+                state.params, state.batch_stats, batch, rng
+            )
+        else:
+            grads, tot, tasks, new_stats = grad_map(
+                state.params, state.batch_stats, batch, rng
+            )
         # chaos-test hook + non-finite step guard (train/guard.py): the
         # decision rides the reduced loss/grads, so every device agrees
         from ..train.guard import guarded_update, step_ok
@@ -293,6 +312,13 @@ def make_branch_parallel_train_step(
         grads = faultinject.poison_grads(
             grads, state.step, faultinject.lr_of(state.opt_state)
         )
+        numer = None
+        if use_numerics:
+            # branch-sharded decoder grad leaves reduce to replicated
+            # scalars under the outer jit (GSPMD inserts the collectives)
+            gnames, gstats = obs_numerics.grad_group_stats(grads)
+            meta["grad_names"] = gnames
+            numer = {"ok": step_ok(tot, grads), "act": acts, "grad": gstats}
 
         # optimizer update under the outer jit: decoder grads/moments stay
         # branch-sharded by propagation, encoder leaves replicated
@@ -303,24 +329,33 @@ def make_branch_parallel_train_step(
             return optax.apply_updates(state.params, updates), opt_state
 
         if use_guard:
-            return (
-                guarded_update(state, step_ok(tot, grads), do_update, new_stats),
-                tot,
-                tasks,
+            new_state = guarded_update(
+                state,
+                numer["ok"] if numer is not None else step_ok(tot, grads),
+                do_update,
+                new_stats,
             )
-        params, opt_state = do_update()
-        return (
-            state.replace(
+        else:
+            params, opt_state = do_update()
+            new_state = state.replace(
                 params=params,
                 opt_state=opt_state,
                 batch_stats=new_stats,
                 step=state.step + 1,
-            ),
-            tot,
-            tasks,
-        )
+            )
+        if use_numerics:
+            return new_state, tot, tasks, numer
+        return new_state, tot, tasks
 
-    return jax.jit(step, donate_argnums=0)
+    jitted = jax.jit(step, donate_argnums=0)
+    if not use_numerics:
+        return jitted
+    # numerics build: AOT-reachable jit + name tables + NaN drill-down;
+    # the diagnostic runs the GLOBAL (dense-decode) objective per shard
+    # row — branch ids stay global there, so no local remap is needed
+    return obs_numerics.numerics_step_wrapper(
+        jitted, meta, model, compute_grad_energy, mixed_precision
+    )
 
 
 def make_branch_parallel_eval_step(

@@ -54,6 +54,7 @@ def make_parallel_train_step(
     zero2_min_size: int = 1024,
     zero3: bool = False,
     guard=None,
+    numerics=None,
 ):
     """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh.
 
@@ -66,12 +67,20 @@ def make_parallel_train_step(
     UPDATED params sharded ``P(data)`` at step output — the FSDP profile:
     full params exist only transiently inside the step. ``guard`` (default
     on): non-finite step guard, computed on the pmean'd loss/gradients so
-    every device and host takes the same branch (train/guard.py)."""
+    every device and host takes the same branch (train/guard.py).
+    ``numerics`` (default off; ``Telemetry.numerics``): in-graph layer/
+    gradient statistics ride the step as a 4th output — activation stats
+    reduce across the mesh inside the shard_map (pmax/psum), gradient
+    stats are computed on the already-pmean'd grads under the outer jit
+    (obs/numerics.py; same contract as train/loop.make_train_step)."""
     cfg = model.cfg
+    from ..obs import numerics as obs_numerics
     from ..train.guard import guard_enabled, guarded_update, step_ok
     from ..utils import faultinject
 
     use_guard = guard_enabled(guard)
+    use_numerics = obs_numerics.numerics_enabled(numerics)
+    meta = {"act_names": None, "grad_names": None}
 
     def per_device_loss(params, batch_stats, batch, rng):
         if mixed_precision:
@@ -79,12 +88,15 @@ def make_parallel_train_step(
 
             params, batch = mp_cast(params, batch, compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
-        tot, tasks, mutated, _ = compute_loss(
-            model, variables, batch, cfg, True, rng, compute_grad_energy
+        (tot, tasks, mutated, _), acts = obs_numerics.run_probed(
+            use_numerics, meta,
+            lambda: compute_loss(
+                model, variables, batch, cfg, True, rng, compute_grad_energy
+            ),
         )
         if mixed_precision:
             mutated = mp_restore_stats(mutated)
-        return tot.astype(jnp.float32), (tasks, mutated)
+        return tot.astype(jnp.float32), (tasks, mutated, acts)
 
     if cfg.conv_checkpointing:
         from ..ops.remat import loss_remat
@@ -95,7 +107,7 @@ def make_parallel_train_step(
         # batch leaves arrive with leading axis [D_local=1, ...] inside the
         # shard; drop it to recover the per-device batch.
         batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        (tot, (tasks, mutated)), grads = jax.value_and_grad(
+        (tot, (tasks, mutated, acts)), grads = jax.value_and_grad(
             per_device_loss, has_aux=True
         )(params, batch_stats, batch, rng)
         # weight each shard by its real-graph count so empty/remainder shards
@@ -115,6 +127,11 @@ def make_parallel_train_step(
         new_stats = jax.lax.pmean(
             jax.tree_util.tree_map(lambda s: s * scale, stats), _BOTH
         )
+        if use_numerics:
+            # activation stats merge across the mesh with the same
+            # semantics the host uses across window steps: max / sums
+            acts = obs_numerics.cross_device_reduce(acts, _BOTH)
+            return grads, tot, tasks, new_stats, acts
         return grads, tot, tasks, new_stats
 
     rep = P()
@@ -122,7 +139,7 @@ def make_parallel_train_step(
         sharded_grads,
         mesh=mesh,
         in_specs=(rep, rep, P(_BOTH), rep),
-        out_specs=(rep, rep, rep, rep),
+        out_specs=(rep, rep, rep, rep) + ((rep,) if use_numerics else ()),
         check_vma=False,
     )
 
@@ -131,15 +148,28 @@ def make_parallel_train_step(
     def step(state: TrainState, batch, rng):
         # retrace sentinel: one execution per jit trace (compile_plane.py)
         note_trace("parallel_train_step", (state, batch, rng))
-        grads, tot, tasks, new_stats = grad_map(
-            state.params, state.batch_stats, batch, rng
-        )
+        acts = None
+        if use_numerics:
+            grads, tot, tasks, new_stats, acts = grad_map(
+                state.params, state.batch_stats, batch, rng
+            )
+        else:
+            grads, tot, tasks, new_stats = grad_map(
+                state.params, state.batch_stats, batch, rng
+            )
         # chaos-test hook: exact no-op unless a fault is armed (trace-time).
         # AFTER the pmean, so the poison (like the real failure it models)
         # is identical on every device and the guard decision agrees.
         grads = faultinject.poison_grads(
             grads, state.step, faultinject.lr_of(state.opt_state)
         )
+        numer = None
+        if use_numerics:
+            # gradient stats on the pmean'd (and possibly poisoned) grads:
+            # replicated values, so the census agrees across the mesh
+            gnames, gstats = obs_numerics.grad_group_stats(grads)
+            meta["grad_names"] = gnames
+            numer = {"ok": step_ok(tot, grads), "act": acts, "grad": gstats}
 
         # The optimizer update runs OUTSIDE the shard_map, under the outer
         # jit: with replicated optimizer state this is byte-identical to the
@@ -181,7 +211,10 @@ def make_parallel_train_step(
             # ok is computed from the pmean'd loss/grads — replicated
             # values, so the guard's select agrees across the whole mesh
             new_state = guarded_update(
-                state, step_ok(tot, grads), do_update, new_stats
+                state,
+                numer["ok"] if numer is not None else step_ok(tot, grads),
+                do_update,
+                new_stats,
             )
             # the guard's per-leaf select merges old and new params,
             # which does not preserve do_update's output constraint —
@@ -209,10 +242,20 @@ def make_parallel_train_step(
                 batch_stats=new_stats,
                 step=state.step + 1,
             )
+        if use_numerics:
+            return new_state, tot, tasks, numer
         return new_state, tot, tasks
 
     # donate the incoming state so params/opt-state update in place in HBM
-    return jax.jit(step, donate_argnums=0)
+    jitted = jax.jit(step, donate_argnums=0)
+    if not use_numerics:
+        return jitted
+    # numerics build: keep the jit AOT-reachable and carry the host-side
+    # name tables + NaN drill-down (the diagnostic runs the replicated
+    # single-device objective per shard row — obs/numerics.py)
+    return obs_numerics.numerics_step_wrapper(
+        jitted, meta, model, compute_grad_energy, mixed_precision
+    )
 
 
 def make_parallel_eval_step(

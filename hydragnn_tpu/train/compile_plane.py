@@ -502,6 +502,27 @@ def attach_lower_fn(fn, jitted, batch_transform: Optional[Callable] = None,
     return fn
 
 
+def _aval_like(x):
+    """Abstract stand-in for one (about-to-be-donated) argument leaf:
+    shape/dtype/weak_type via the aval, plus the committed sharding when
+    one exists — everything ``jit.lower`` specializes on, so a program
+    lowered from these is identical to the organic call's."""
+    import jax
+
+    aval = jax.core.get_aval(x)
+    sharding = getattr(x, "sharding", None)
+    # only MESH shardings are program-relevant; a plain array's implicit
+    # SingleDeviceSharding must stay implicit (an explicit one would mark
+    # the aval committed and lower a different — device-pinned — program
+    # than the organic call compiled)
+    if isinstance(sharding, jax.sharding.NamedSharding):
+        return jax.ShapeDtypeStruct(
+            aval.shape, aval.dtype, sharding=sharding,
+            weak_type=bool(getattr(aval, "weak_type", False)),
+        )
+    return aval
+
+
 def _lower_fn_of(fn) -> Optional[Callable]:
     lower = getattr(fn, "_compile_plane_lower", None)
     if lower is not None:
@@ -554,6 +575,18 @@ class CompilePlane:
         # (obs/telemetry.py attach_flops); dict writes are atomic under the
         # GIL, so the background worker publishes lock-free.
         self.flops_by_spec: Dict[str, float] = {}
+        # HBM accounting (obs/memory.py): memory_analysis() figures per
+        # warmed specialization, harvested beside the flops — argument /
+        # output / temp / peak bytes. Published as hydragnn_hbm_* gauges
+        # and rendered in report(); the flight recorder dumps the process
+        # table as its OOM-forensics section.
+        self.memory_by_spec: Dict[str, Dict[str, float]] = {}
+        # MFU-estimate fallback (obs/telemetry.py attach_flops consumer):
+        # with precompile off nothing fills flops_by_spec — when armed via
+        # enable_flops_fallback(), the first organic step's executable is
+        # lowered + compiled through the persistent cache and its
+        # cost/memory analysis harvested instead
+        self._organic_flops = False
         self.time_to_first_step: Optional[float] = None
         self._t0: Optional[float] = None
         self._m0: Dict[str, float] = {}
@@ -661,6 +694,21 @@ class CompilePlane:
                 return _fn(st, batch, step_rng)
             import jax
 
+            # organic-executable harvest (enable_flops_fallback): the
+            # donated STATE's buffers are dead after the step, so its
+            # avals (shape/dtype/weak_type + committed sharding — pure
+            # metadata, no trace, no copy) are captured here; the actual
+            # lower()+compile() happens AFTER the first step, off the
+            # time_to_first_step measurement (lowering is a full second
+            # Python trace — on the critical path it would inflate the
+            # first-step latency the bench gate bounds). batch/rng are
+            # not donated, so they lower live.
+            state_avals = None
+            if plane._organic_flops:
+                try:
+                    state_avals = jax.tree_util.tree_map(_aval_like, st)
+                except Exception:
+                    state_avals = None
             tr.start("first_step")
             out = _fn(st, batch, step_rng)
             jax.block_until_ready(out[1])
@@ -668,6 +716,25 @@ class CompilePlane:
             done["first"] = False
             plane.time_to_first_step = time.perf_counter() - plane._t0
             ttfs_timer.stop()
+            if state_avals is not None:
+                try:
+                    lower = _lower_fn_of(_fn)
+                    # compile() is a persistent-cache retrieval of the
+                    # entry the organic call just wrote (aval-faithful
+                    # lowering: weak types + shardings preserved, so the
+                    # program is byte-identical to the organic one)
+                    plane._harvest_analyses(
+                        plane._batch_label(batch),
+                        lower(state_avals, batch, step_rng).compile(),
+                    )
+                except Exception as e:
+                    warnings.warn(
+                        "organic FLOPs/HBM harvest failed "
+                        f"({type(e).__name__}: {e}); the MFU gauge stays "
+                        "unpublished for this run",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
             return out
 
         return instrumented
@@ -683,15 +750,29 @@ class CompilePlane:
                 self.errors.append((label, f"{type(e).__name__}: {e}"))
                 continue
             self.compiled.append((label, time.perf_counter() - t0))
-            try:
-                cost = compiled.cost_analysis()
-                if isinstance(cost, (list, tuple)):
-                    cost = cost[0]
-                flops = float(cost.get("flops", 0.0))
-                if flops > 0:
-                    self.flops_by_spec[label] = flops
-            except Exception:  # cost analysis is best-effort observability
-                pass
+            self._harvest_analyses(label, compiled)
+
+    def _harvest_analyses(self, label: str, compiled) -> None:
+        """Best-effort cost (FLOPs) + memory (HBM) harvest from one
+        compiled executable — the zero-extra-compile observability dividend
+        of holding the executable at all."""
+        try:
+            cost = compiled.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            flops = float(cost.get("flops", 0.0))
+            if flops > 0:
+                self.flops_by_spec[label] = flops
+        except Exception:  # cost analysis is best-effort observability
+            pass
+        try:
+            from ..obs import memory as obs_memory
+
+            stats = obs_memory.record(label, compiled)
+            if stats is not None:
+                self.memory_by_spec[label] = stats
+        except Exception:  # memory analysis availability is backend-bound
+            pass
 
     def _worker_main(self) -> None:
         from ..utils.timers import Timer
@@ -712,6 +793,43 @@ class CompilePlane:
         (per-shard nodes, edges), or None while warm-up has not compiled
         it (background mode fills the table as it goes)."""
         return self.flops_by_spec.get(f"train:{key[0]}n/{key[1]}e")
+
+    def enable_flops_fallback(self) -> None:
+        """Arm the organic cost/memory harvest for ``precompile: off``
+        runs (the loop calls this when telemetry wants an MFU estimate):
+        ``flops_by_spec`` is otherwise populated only by AOT warm-up, so
+        mode ``off`` silently zeroed the MFU gauge. With a persistent
+        cache active, the first organic step's program is lowered (one
+        extra Python trace) and ``compile()``d through the cache (a
+        retrieval, not a recompile — the organic call just wrote the
+        entry) purely to hold its analyses. Without a cache the fallback
+        would pay a FULL duplicate XLA compile, so it warns once naming
+        the cause instead."""
+        if self.mode != "off":
+            return  # warm-up fills the table; nothing to fall back from
+        if self.cache_dir is None:
+            warnings.warn(
+                "telemetry MFU estimate has no FLOPs source: "
+                "Training.precompile is 'off' (or degraded to off because "
+                "no persistent compilation cache is active) and no cache "
+                "directory is available to harvest the organic executable "
+                "through — hydragnn_mfu_estimate will not be published. "
+                "Enable Training.precompile or Training.compile_cache_dir.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return
+        self._organic_flops = True
+
+    @staticmethod
+    def _batch_label(batch, prefix: str = "train") -> str:
+        """Spec label of a (possibly device-stacked) batch from its mask
+        shapes — the same per-shard (nodes, edges) key the telemetry
+        layer's flops lookup uses (obs/telemetry.py _batch_census)."""
+        return (
+            f"{prefix}:{int(batch.node_mask.shape[-1])}n/"
+            f"{int(batch.edge_mask.shape[-1])}e"
+        )
 
     def finish(self, verbosity: int = 0) -> Dict[str, Any]:
         """End the run: stop/join the worker, disarm the sentinel, return
@@ -769,12 +887,27 @@ class CompilePlane:
             "traces": traces,
             "violations": len(_SENTINEL.violations()) - self._viol0,
             "warmup_errors": list(self.errors),
+            # per-spec HBM table (memory_analysis harvest, obs/memory.py):
+            # peak bytes per warmed specialization + the run's worst case —
+            # the headroom figure that used to be guesswork before an OOM
+            "hbm_by_spec": {
+                label: int(stats["peak_bytes"])
+                for label, stats in sorted(self.memory_by_spec.items())
+            },
+            "hbm_peak_bytes": (
+                max(
+                    int(s["peak_bytes"]) for s in self.memory_by_spec.values()
+                )
+                if self.memory_by_spec
+                else None
+            ),
         }
 
 
 def format_report(rep: Dict[str, Any]) -> str:
     """One grep-able line (the chaos/compile smokes parse these fields)."""
     ttfs = rep.get("time_to_first_step")
+    hbm = rep.get("hbm_peak_bytes")
     return (
         f"compile plane: mode={rep['mode']} "
         f"remat={rep.get('remat_policy', 'full')} "
@@ -783,7 +916,8 @@ def format_report(rep: Dict[str, Any]) -> str:
         f"cache_hits={rep['cache_hits']} cache_misses={rep['cache_misses']} "
         f"time_to_first_step={ttfs if ttfs is not None else 'n/a'}s "
         f"traces={sum(rep['traces'].values())} "
-        f"violations={rep['violations']}"
+        f"violations={rep['violations']} "
+        f"hbm_peak={hbm if hbm is not None else 'n/a'}"
         + (f" warmup_errors={len(rep['warmup_errors'])}"
            if rep["warmup_errors"] else "")
     )

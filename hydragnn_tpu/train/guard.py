@@ -151,8 +151,17 @@ class NonFinitePolicy:
         self._prev_skipped = 0
         self.rollbacks_done = 0
 
-    def after_epoch(self, state, epoch: int):
-        """Apply the policy; returns the (possibly restored) state."""
+    def after_epoch(self, state, epoch: int, provenance=None):
+        """Apply the policy; returns the (possibly restored) state.
+
+        ``provenance`` (optional) is the epoch's per-skip batch attribution
+        — a list of dicts with ``batch`` / ``level`` (spec-ladder pad
+        level) / ``sources`` (mixture draw ids) / ``layer`` (when the
+        numerics drill-down located the tensor) — attached to the
+        ``guard_skip`` event so a poisoned source or a recurring pad level
+        is identifiable from the event stream alone (train/loop.py fills it
+        from the NaN watch when ``Telemetry.numerics`` is on, else from the
+        epoch's non-finite loss census)."""
         skipped = int(jax.device_get(state.skipped_steps))
         consec = int(jax.device_get(state.consecutive_skips))
         new_skips = skipped - self._prev_skipped
@@ -170,6 +179,24 @@ class NonFinitePolicy:
         from ..obs.events import EV_GUARD_FATAL, EV_GUARD_SKIP
         from ..obs.events import emit as _emit_event
 
+        extra = {}
+        if provenance:
+            levels = sorted({str(p["level"]) for p in provenance
+                             if p.get("level")})
+            sources = sorted({int(s) for p in provenance
+                              for s in (p.get("sources") or [])})
+            batches = [int(p["batch"]) for p in provenance
+                       if p.get("batch") is not None]
+            layers = sorted({str(p["layer"]) for p in provenance
+                             if p.get("layer")})
+            if levels:
+                extra["levels"] = ",".join(levels)
+            if sources:
+                extra["sources"] = ",".join(str(s) for s in sources)
+            if batches:  # bounded: a diverged epoch skips every step
+                extra["batches"] = ",".join(str(b) for b in batches[:16])
+            if layers:
+                extra["layers"] = ",".join(layers[:8])
         _emit_event(
             EV_GUARD_SKIP,
             severity="warn",
@@ -178,6 +205,7 @@ class NonFinitePolicy:
             total=skipped,
             consecutive=consec,
             policy=self.policy,
+            **extra,
         )
         if self.policy == "error":
             err = RuntimeError(

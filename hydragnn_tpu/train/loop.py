@@ -97,6 +97,7 @@ def make_train_step(
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
     guard: Optional[bool] = None,
+    numerics: Optional[bool] = None,
 ):
     """Build the jitted SGD step: (state, batch, rng) -> (state, loss, tasks).
 
@@ -114,23 +115,38 @@ def make_train_step(
     non-finite step guard — loss/global-grad-norm finiteness is computed in
     the same program and a bad step's optimizer update is gated to identity
     (per-leaf select), advancing the state's skip counters (train/guard.py).
-    A good step commits the EXACT unguarded update values."""
+    A good step commits the EXACT unguarded update values.
+
+    ``numerics`` (default: off, env HYDRAGNN_NUMERICS=1 enables; wired from
+    ``Telemetry.numerics``): in-graph per-layer activation + per-param-group
+    gradient statistics (obs/numerics.py) ride the step as a FOURTH output
+    ``{"ok", "act", "grad"}`` — the step then returns a 4-tuple, and the
+    returned callable carries ``_numerics_meta`` (tensor name tables,
+    written at trace time) and ``_nan_diagnose`` (the provenance
+    drill-down) attributes. Off, the step and its outputs are byte-
+    identical to the historical 3-tuple."""
     cfg = model.cfg
+    from ..obs import numerics as obs_numerics
     from ..utils import faultinject
     from .guard import guard_enabled, guarded_update, step_ok
 
     use_guard = guard_enabled(guard)
+    use_numerics = obs_numerics.numerics_enabled(numerics)
+    meta = {"act_names": None, "grad_names": None}
 
     def loss_fn(params, batch_stats, batch, rng):
         if mixed_precision:
             params, batch = mp_cast(params, batch, compute_grad_energy)
         variables = {"params": params, "batch_stats": batch_stats}
-        tot, tasks, mutated, _ = compute_loss(
-            model, variables, batch, cfg, True, rng, compute_grad_energy
+        (tot, tasks, mutated, _), acts = obs_numerics.run_probed(
+            use_numerics, meta,
+            lambda: compute_loss(
+                model, variables, batch, cfg, True, rng, compute_grad_energy
+            ),
         )
         if mixed_precision:
             mutated = mp_restore_stats(mutated)
-        return tot.astype(jnp.float32), (tasks, mutated)
+        return tot.astype(jnp.float32), (tasks, mutated, acts)
 
     if cfg.conv_checkpointing:
         # rematerialize the forward during backward (reference: per-conv torch
@@ -148,14 +164,21 @@ def make_train_step(
         # retrace sentinel: the body runs once per jit trace, so this call
         # IS the trace census (train/compile_plane.py)
         note_trace("train_step", (state, batch, rng))
-        (tot, (tasks, mutated)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, state.batch_stats, batch, rng
-        )
+        (tot, (tasks, mutated, acts)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, state.batch_stats, batch, rng)
         # chaos-test hook: exact no-op unless a fault is armed (trace-time)
         grads = faultinject.poison_grads(
             grads, state.step, faultinject.lr_of(state.opt_state)
         )
         new_stats = mutated.get("batch_stats", state.batch_stats)
+        numer = None
+        if use_numerics:
+            # gradient stats AFTER the fault hook, so injected NaNs show up
+            # in the same census the provenance drill-down reads
+            gnames, gstats = obs_numerics.grad_group_stats(grads)
+            meta["grad_names"] = gnames
+            numer = {"ok": step_ok(tot, grads), "act": acts, "grad": gstats}
         if use_guard:
 
             def do_update():
@@ -165,7 +188,10 @@ def make_train_step(
                 return optax.apply_updates(state.params, updates), opt_state
 
             new_state = guarded_update(
-                state, step_ok(tot, grads), do_update, new_stats
+                state,
+                numer["ok"] if numer is not None else step_ok(tot, grads),
+                do_update,
+                new_stats,
             )
         else:
             updates, opt_state = tx.update(grads, state.opt_state, state.params)
@@ -176,9 +202,18 @@ def make_train_step(
                 batch_stats=new_stats,
                 step=state.step + 1,
             )
+        if use_numerics:
+            return new_state, tot, tasks, numer
         return new_state, tot, tasks
 
-    return train_step
+    if not use_numerics:
+        return train_step
+    # the numerics build returns a wrapper so the jit object stays AOT-
+    # reachable (compile plane) and the host-side name tables + NaN
+    # drill-down travel with the step function (obs/numerics.py)
+    return obs_numerics.numerics_step_wrapper(
+        train_step, meta, model, compute_grad_energy, mixed_precision
+    )
 
 
 def make_eval_step(
@@ -295,7 +330,8 @@ def _maybe_device_prefetch(iterator, depth: Optional[int] = None):
 
 
 def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
-                telemetry=None, tracer=None, prefetch_depth=None):
+                telemetry=None, tracer=None, prefetch_depth=None,
+                nan_watch=None, guard_log=None):
     """One training epoch. Returns ``(state, tot, tasks, rng, cursor)``:
     ``cursor`` is None when the epoch completed, or the next-batch offset
     (loader-absolute) when a SIGTERM arrived between steps — the mid-epoch
@@ -313,7 +349,15 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
     every-Nth sampled step: a ``train/step`` root with retroactive
     ``train/host_batch_build`` (host batching + validation + H2D staging,
     the ``dataload`` region) and ``train/device_dispatch`` children —
-    unsampled steps pay one ``is not None`` check."""
+    unsampled steps pay one ``is not None`` check.
+    ``nan_watch`` (obs/numerics.NanWatch, or None) receives every step's
+    ok flag + held batch for the deferred non-finite check and NaN
+    provenance drill-down (requires a numerics-enabled ``step_fn``).
+    ``guard_log`` (a dict, or None) is filled with this epoch's
+    ``nonfinite`` step census — batch index, spec-ladder level, and (when
+    the loader exposes ``batch_sources``) the mixture draw ids of every
+    step whose loss came back non-finite — the batch provenance the
+    epoch-boundary guard policy attaches to its ``guard_skip`` event."""
     from ..utils import preemption
     from ..utils import tracer as tr
 
@@ -330,6 +374,17 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
     check_preempt = jax.process_count() == 1
     cursor = None
     consumed = 0
+    # per-step provenance meta ((batch index, pad level, mixture sources)):
+    # two ints and a small tuple per step, recorded only when a consumer
+    # asked; MixturePlane exposes batch_sources, plain loaders don't
+    step_meta = [] if (guard_log is not None or nan_watch is not None) else None
+    src_fn = getattr(loader, "batch_sources", None)
+    # the watch needs the failing step's state.step value (the fault-
+    # injection hooks key on it); one host read of the incoming counter
+    # per epoch, then pure python increments
+    step0 = (
+        int(jax.device_get(state.step)) if nan_watch is not None else 0
+    )
     it = _maybe_device_prefetch(iter(loader), depth=prefetch_depth)
     for i in range(len(loader)):
         # dataload span covers host batching + H2D staging (the reference's
@@ -360,12 +415,29 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
         t_step = time.perf_counter()
-        state, tot, tasks = step_fn(state, batch, sub)
+        out = step_fn(state, batch, sub)
+        # a numerics-enabled step rides its stat bundle as a 4th output
+        # (obs/numerics.py); the historical 3-tuple is unchanged otherwise
+        state, tot, tasks = out[0], out[1], out[2]
+        numer = out[3] if len(out) > 3 else None
         # graph_mask is loader data (host numpy, or an already-transferred
         # leaf under device_prefetch) — reading it never waits on compute
         n = int(np.asarray(batch.graph_mask).sum())
         tr.stop("train_step")
         entries.append((tot, tasks, n))
+        if step_meta is not None:
+            idx = offset + consumed - 1
+            level = (
+                f"{int(batch.node_mask.shape[-1])}n/"
+                f"{int(batch.edge_mask.shape[-1])}e"
+            )
+            srcs = src_fn(idx) if src_fn is not None else None
+            step_meta.append((idx, level, srcs))
+            if nan_watch is not None:
+                nan_watch.on_step(
+                    state, batch, sub, step0 + len(entries) - 1, idx,
+                    numer, level=level, sources=srcs,
+                )
         if sp is not None:
             dispatch_dt = time.perf_counter() - t_step
             tracer.emit_completed(
@@ -379,7 +451,8 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
             tracer.finish(sp)
         if telemetry is not None:
             telemetry.on_step(
-                batch, time.perf_counter() - t_step, real_graphs=n
+                batch, time.perf_counter() - t_step, real_graphs=n,
+                numerics=numer,
             )
         if check_preempt and preemption.preempted():
             # SIGTERM between steps: stop HERE and let the loop checkpoint
@@ -390,12 +463,24 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
         max_batches = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
         if max_batches is not None and i + 1 >= int(max_batches):
             break
+    if nan_watch is not None:
+        # drain the watch ring at the boundary the loop syncs on anyway
+        nan_watch.end_epoch(state)
     # single host sync for the whole epoch
     entries = jax.device_get(entries)
     entries = [
         (float(t), {k: float(v) for k, v in d.items()}, n)
         for t, d, n in entries
     ]
+    if guard_log is not None and step_meta is not None:
+        # non-finite loss census -> batch provenance for the guard-skip
+        # event (grad-only NaNs keep a finite loss; the NaN watch covers
+        # those precisely when Telemetry.numerics is on)
+        guard_log["nonfinite"] = [
+            {"batch": m[0], "level": m[1], "sources": m[2]}
+            for e, m in zip(entries, step_meta)
+            if not np.isfinite(e[0])
+        ]
     # a guarded-and-skipped step reports its (non-finite) loss but applied
     # no update — excluding it keeps the epoch mean meaningful for the
     # plateau scheduler / early stopping. If EVERY step was non-finite
@@ -501,12 +586,23 @@ def train_validate_test(
     compute_grad_energy = training.get("compute_grad_energy", False)
     # bf16 compute against f32 master weights (MXU-native; make_train_step)
     mixed_precision = training.get("mixed_precision", False)
+    # resolved BEFORE the step builders: Telemetry.numerics changes the
+    # step program (in-graph probes ride the outputs — obs/numerics.py)
+    from ..obs.telemetry import StepTelemetry, resolve_telemetry
+
+    obs_settings = resolve_telemetry(config)
     if step_fn is None:
         step_fn = make_train_step(
-            model, tx, compute_grad_energy, mixed_precision
+            model, tx, compute_grad_energy, mixed_precision,
+            numerics=obs_settings["numerics"],
         )
     if eval_fn is None:
         eval_fn = make_eval_step(model, compute_grad_energy, mixed_precision)
+    # a numerics-enabled builder (here or api.py's mesh builders) carries
+    # its name tables + NaN drill-down as attributes; capture them before
+    # the compile plane wraps the callable below
+    numerics_meta = getattr(step_fn, "_numerics_meta", None)
+    nan_diagnose = getattr(step_fn, "_nan_diagnose", None)
     scheduler = ReduceLROnPlateau()
     stopper = (
         EarlyStopping(patience=training.get("patience", 10))
@@ -547,14 +643,39 @@ def train_validate_test(
     # versioned metrics.jsonl stream, an optional /metrics endpoint, and
     # the on-demand profiling trigger. None when disabled: the loop then
     # pays one `is not None` check per step and nothing else.
-    from ..obs.telemetry import StepTelemetry, resolve_telemetry
-
-    obs_settings = resolve_telemetry(config)
+    # (obs_settings was resolved above, before the step builders.)
     telemetry = (
         StepTelemetry(obs_settings, log_name, writer=writer)
         if obs_settings["enabled"]
         else None
     )
+    if telemetry is not None and numerics_meta is not None:
+        telemetry.attach_numerics(numerics_meta)
+    elif numerics_meta is not None:
+        import warnings as _warnings
+
+        # the probes are computed in-graph either way, but their window
+        # gauges/records ride the enabled sinks — say so instead of
+        # silently publishing nothing (the runbook's per-window history
+        # would be missing; provenance events + flight dumps still work)
+        _warnings.warn(
+            "Telemetry.numerics is on but Telemetry.enabled is off: the "
+            "hydragnn_numerics_* gauges and metrics.jsonl 'numerics' "
+            "records are published by the enabled per-step layer and will "
+            "not appear — NaN provenance events and flight-recorder dumps "
+            "still fire. Set Telemetry.enabled: true for the full "
+            "observatory.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    # NaN provenance watch (obs/numerics.py): deferred per-step ok checks +
+    # the drill-down on a guarded skip; exists exactly when the step rides
+    # a numerics bundle
+    nan_watch = None
+    if numerics_meta is not None:
+        from ..obs.numerics import NanWatch
+
+        nan_watch = NanWatch(diagnose=nan_diagnose, log_name=log_name)
     run_dir = os.path.join("./logs", log_name)
     # tracing plane (obs/trace.py; docs/OBSERVABILITY.md "Tracing"): spans
     # for every trace_interval_steps-th step to logs/<run>/trace.jsonl,
@@ -576,6 +697,7 @@ def train_validate_test(
     flight = None
     if obs_settings["flight_recorder"] and (
         obs_settings["enabled"] or obs_settings["trace"]
+        or obs_settings["numerics"]
     ):
         from ..obs.flightrec import FlightRecorder
 
@@ -609,6 +731,11 @@ def train_validate_test(
         # mode fills it while epoch 0 runs, so early windows may publish
         # no MFU and later ones do (the flush handles None)
         telemetry.attach_flops(plane.train_flops_for)
+        if telemetry.want_mfu:
+            # precompile: off never populates flops_by_spec — harvest the
+            # first organic executable instead (or warn once naming the
+            # cause) so the MFU gauge is not silently zeroed
+            plane.enable_flops_fallback()
 
     rng = jax.random.PRNGKey(seed)
     hist: Dict[str, List[float]] = {"train": [], "val": [], "test": [], "lr": []}
@@ -672,10 +799,12 @@ def train_validate_test(
                 )
             profiler.epoch_begin(epoch)
             train_loader.set_epoch(epoch)
+            guard_log: Dict[str, Any] = {}
             with tr.timer("train"):
                 state, tr_loss, tr_tasks, rng, cursor = train_epoch(
                     train_loader, step_fn, state, rng, telemetry=telemetry,
                     tracer=tracer, prefetch_depth=prefetch_depth,
+                    nan_watch=nan_watch, guard_log=guard_log,
                 )
             hist["train"].append(tr_loss)
             # mixture plane (mix/plane.py): per-source draw/skip tallies +
@@ -795,16 +924,27 @@ def train_validate_test(
                     )
                 break
             # non-finite-step policy: warn/raise/rollback BEFORE val/test so
-            # a rollback epoch evaluates the restored state, not a stale one
+            # a rollback epoch evaluates the restored state, not a stale one.
+            # Skip provenance for the guard_skip event: the NaN watch's
+            # located records when numerics is on (covers grad-only NaNs +
+            # layer attribution), else the epoch's non-finite loss census
+            provenance = (
+                nan_watch.take() if nan_watch is not None
+                else guard_log.get("nonfinite")
+            )
             rollbacks_before = nf_policy.rollbacks_done
             if tracer is not None:
                 # every epoch's guard verdict is traced (epochs are rare;
                 # the guard's skip/rollback/fatal events attach to this
                 # span's trace_id, so a rollback post-mortem has its anchor)
                 with tracer.span("train/guard_verdict", epoch=epoch):
-                    state = nf_policy.after_epoch(state, epoch)
+                    state = nf_policy.after_epoch(
+                        state, epoch, provenance=provenance
+                    )
             else:
-                state = nf_policy.after_epoch(state, epoch)
+                state = nf_policy.after_epoch(
+                    state, epoch, provenance=provenance
+                )
             if nf_policy.rollbacks_done > rollbacks_before:
                 # the warmup ramp below recomputes the LR from base_lr every
                 # warmup epoch — scale the base too, or the next ramp line

@@ -72,7 +72,9 @@ print("CLEAN_EXIT epochs=%d" % len(hist["train"]), flush=True)
 """
 
 _PLANE_RE = re.compile(
-    r"compile plane: mode=(\S+) precompiled=(\d+)/(\d+) "
+    # remat= (r11) and hbm_peak= (r12) are optional: the parsed fields keep
+    # their group numbers across report-line growth
+    r"compile plane: mode=(\S+) (?:remat=\S+ )?precompiled=(\d+)/(\d+) "
     r"compile_time_s=([0-9.]+) cache_hits=(\d+) cache_misses=(\d+) "
     r"time_to_first_step=([0-9.]+|n/a)s traces=(\d+) violations=(\d+)"
 )

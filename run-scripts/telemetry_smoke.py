@@ -25,6 +25,14 @@ end-to-end and asserts the acceptance contract of the r7 tentpole:
    depth in each leg (the knob reaches the staging path) and the
    double-buffered leg must stay within 1.5x of the inline one (the
    thread handoff is bounded; its H2D win is a hardware-round number).
+5. **numerics leg** (own single-device child): a training run with
+   ``Telemetry.numerics`` on and an injected gradient NaN
+   (``HYDRAGNN_FAULT_NAN_STEP``, utils/faultinject.py) must produce
+   typed ``numerics_provenance`` events naming the poisoned tensor, a
+   ``guard_skip`` event carrying batch provenance, a flight-recorder
+   dump with the OOM-forensics ``memory.json``, ``numerics`` records in
+   metrics.jsonl, and a populated HBM table — then a clean numerics-on
+   vs numerics-off A/B must hold the same <= 2% step-time budget.
 
 Exit 0 = telemetry plane healthy; nonzero with a diagnostic otherwise.
 """
@@ -352,6 +360,198 @@ print("LEG4_DOUBLE_BUFFER_OK", flush=True)
 """
 
 
+# ---- leg 5 child: numerics observatory + NaN provenance ---------------------
+# its OWN single-device subprocess: the injected-fault env must not leak
+# into legs 1-4, and the A/B wants the deterministic single-device loop
+_NUM_CHILD = """
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, {repo!r})
+import jax
+if not hasattr(jax.distributed, "is_initialized"):
+    jax.distributed.is_initialized = lambda: False
+import numpy as np
+
+# armed BEFORE the first step traces: poison_grads reads the env at trace
+# time; "3+" keeps the condition true at diagnosis time too
+os.environ["HYDRAGNN_FAULT_NAN_STEP"] = "3+"
+
+import hydragnn_tpu
+from hydragnn_tpu.config import get_log_name_config
+
+cfg = {{
+    "Verbosity": {{"level": 1}},
+    "Dataset": {{
+        "name": "numerics_smoke",
+        "format": "synthetic",
+        "synthetic": {{"number_configurations": 96}},
+        "node_features": {{"name": ["x", "x2", "x3"], "dim": [1, 1, 1]}},
+        "graph_features": {{"name": ["s"], "dim": [1]}},
+    }},
+    "NeuralNetwork": {{
+        "Architecture": {{
+            "mpnn_type": "GIN", "radius": 2.0, "max_neighbours": 100,
+            "hidden_dim": 8, "num_conv_layers": 2, "task_weights": [1.0],
+            "output_heads": {{"graph": {{"num_sharedlayers": 1,
+                                        "dim_sharedlayers": 8,
+                                        "num_headlayers": 2,
+                                        "dim_headlayers": [8, 8]}}}},
+        }},
+        "Variables_of_interest": {{
+            "input_node_features": [0],
+            "output_names": ["s"], "output_index": [0],
+            "type": ["graph"], "denormalize_output": False,
+        }},
+        "Training": {{
+            "num_epoch": 2, "batch_size": 8, "seed": 11,
+            "num_pad_buckets": 1,
+            "precompile": "blocking",
+            "Optimizer": {{"type": "AdamW", "learning_rate": 0.01}},
+        }},
+    }},
+    "Telemetry": {{"enabled": True, "interval_steps": 2, "numerics": True}},
+}}
+
+model, state, hist, cfg_out, loaders, mm = hydragnn_tpu.run_training(cfg)
+run_dir = os.path.join("logs", get_log_name_config(cfg_out))
+
+from hydragnn_tpu.obs.events import events
+
+evs = events().snapshot()
+prov = [e for e in evs if e["kind"] == "numerics_provenance"]
+assert prov, "no numerics_provenance event despite injected NaN"
+named = [e for e in prov if e.get("layer") and e["layer"] != "<unreproduced>"]
+assert named, f"provenance never named a tensor: {{prov[:3]}}"
+assert named[0].get("tensor_kind") == "gradient", named[0]
+assert named[0].get("level"), named[0]
+print("LEG5_PROVENANCE_OK layer=%s events=%d"
+      % (named[0]["layer"], len(prov)), flush=True)
+
+gs = [e for e in evs if e["kind"] == "guard_skip"]
+assert gs, "no guard_skip event despite injected NaN"
+assert any(e.get("layers") or e.get("batches") for e in gs), (
+    "guard_skip events carry no batch provenance: %r" % gs
+)
+
+fdir = os.path.join(run_dir, "flightrec")
+dumps = [d for d in os.listdir(fdir) if "numerics_provenance" in d]
+assert dumps, os.listdir(fdir)
+mem = json.load(open(os.path.join(fdir, dumps[0], "memory.json")))
+assert "hbm_by_spec" in mem, mem
+dump_evs = json.load(open(os.path.join(fdir, dumps[0], "events.json")))
+assert any(e["kind"] == "numerics_provenance" for e in dump_evs)
+
+recs = [json.loads(l) for l in open(os.path.join(run_dir, "metrics.jsonl"))]
+nrecs = [r for r in recs if r["kind"] == "numerics"]
+assert nrecs, "metrics.jsonl has no numerics records"
+assert any(
+    any(g["nonfinite"] > 0 for g in r["gradients"].values()) for r in nrecs
+), "no numerics record shows the injected non-finite gradients"
+
+# HBM table: blocking precompile harvested memory_analysis on this backend
+from hydragnn_tpu.obs import memory as obs_memory
+
+snap = obs_memory.snapshot()
+assert any(k.startswith("train:") for k in snap), snap
+assert all(v["peak_bytes"] > 0 for v in snap.values()), snap
+print("LEG5_FORENSICS_OK dumps=%d numerics_records=%d hbm_specs=%d"
+      % (len(dumps), len(nrecs), len(snap)), flush=True)
+
+# ---- numerics on/off overhead A/B ------------------------------------------
+# clean steps (fault disarmed; poison is read at trace time, so the fresh
+# builders below compile the identity). Production-representative shape:
+# ~60-node BCC cells, batch 32 (~2300 padded nodes / ~70k edges), hidden
+# 128 — the probes' single fused stat-reduce per tensor must disappear
+# into a real step's compute, not into a 1 ms dispatch-bound toy step
+del os.environ["HYDRAGNN_FAULT_NAN_STEP"]
+os.environ["HYDRAGNN_DEVICE_PREFETCH"] = "0"
+from hydragnn_tpu.data import (
+    GraphLoader, MinMax, VariablesOfInterest, deterministic_graph_dataset,
+    extract_variables,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.obs.numerics import NanWatch
+from hydragnn_tpu.train import TrainState, make_optimizer
+from hydragnn_tpu.train.loop import make_train_step, train_epoch
+from hydragnn_tpu.config import update_config
+
+graphs = MinMax.fit(g := deterministic_graph_dataset(
+    64, unit_cell_x_range=(3, 5), unit_cell_y_range=(3, 5),
+    unit_cell_z_range=(2, 4), seed=3)).apply(g)
+voi = VariablesOfInterest([0], ["s"], ["graph"], [0], [1, 1, 1], [1])
+graphs = [extract_variables(x, voi) for x in graphs]
+ab_cfg = {{
+    "Dataset": {{"node_features": {{"dim": [1, 1, 1]}},
+                 "graph_features": {{"dim": [1]}}}},
+    "NeuralNetwork": {{
+        "Architecture": {{"mpnn_type": "GIN", "hidden_dim": 128,
+                          "num_conv_layers": 3, "task_weights": [1.0],
+                          "output_heads": {{"graph": {{
+                              "num_sharedlayers": 1, "dim_sharedlayers": 128,
+                              "num_headlayers": 2,
+                              "dim_headlayers": [128, 128]}}}}}},
+        "Variables_of_interest": {{"input_node_features": [0],
+                                   "output_names": ["s"], "output_index": [0],
+                                   "type": ["graph"]}},
+        "Training": {{"batch_size": 32,
+                      "Optimizer": {{"type": "AdamW",
+                                     "learning_rate": 0.01}}}},
+    }},
+}}
+ab_cfg = update_config(ab_cfg, graphs, graphs[:4], graphs[:4])
+loader = GraphLoader(graphs, 32, seed=0, prefetch=0)
+ab_model = create_model(ab_cfg)
+variables = init_model(ab_model, next(iter(loader)), seed=0)
+tx = make_optimizer(ab_cfg["NeuralNetwork"]["Training"]["Optimizer"])
+step_off = make_train_step(ab_model, tx, numerics=False)
+step_on = make_train_step(ab_model, tx, numerics=True)
+rng = jax.random.PRNGKey(0)
+ab_state = TrainState.create(variables, tx)
+# warm BOTH programs before timing (they compile differently by design)
+ab_state, _, _, rng, _ = train_epoch(loader, step_off, ab_state, rng)
+ab_state, _, _, rng, _ = train_epoch(
+    loader, step_on, ab_state, rng,
+    nan_watch=NanWatch(diagnose=step_on._nan_diagnose),
+)
+n_batches = len(loader)
+# same gate design as leg 3: best-of-3 blocks of interleaved medians — a
+# real additive per-step cost inflates the on leg in EVERY block
+ratios = []
+for block in range(3):
+    times = {{"off": [], "on": []}}
+    for trial in range(8):
+        for leg in ("off", "on"):
+            watch = (
+                NanWatch(diagnose=step_on._nan_diagnose)
+                if leg == "on" else None
+            )
+            t0 = time.perf_counter()
+            ab_state, _, _, rng, _ = train_epoch(
+                loader, step_on if leg == "on" else step_off, ab_state,
+                rng, nan_watch=watch,
+            )
+            times[leg].append((time.perf_counter() - t0) / n_batches)
+    off_s = float(np.median(times["off"]))
+    on_s = float(np.median(times["on"]))
+    ratios.append(on_s / max(off_s, 1e-12))
+    print(f"LEG5_AB block {{block}}: off={{off_s*1e3:.3f}}ms "
+          f"on={{on_s*1e3:.3f}}ms delta={{(on_s/off_s-1)*100:+.2f}}%",
+          flush=True)
+best = min(ratios)
+print(f"LEG5_AB overhead={{(best-1)*100:.2f}}% (best of {{len(ratios)}}; "
+      f"all: {{[round((r-1)*100, 2) for r in ratios]}})", flush=True)
+assert best <= 1.02, (
+    f"numerics overhead {{(best-1)*100:.2f}}% exceeds the 2% budget in "
+    f"EVERY block ({{[round((r-1)*100, 2) for r in ratios]}}%) — the "
+    "in-graph probes are costing more than fused reductions should"
+)
+print("LEG5_NUMERICS_OK", flush=True)
+"""
+
+
 def _env(workdir, single_device=False):
     env = {
         k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
@@ -406,8 +606,23 @@ def main() -> int:
             f"telemetry_smoke FAIL leg4 (rc={db.returncode}):\n{db_out[-3000:]}"
         )
         return 1
-    for line in (out + db_out).splitlines():
-        if line.startswith(("LEG1_", "LEG2_", "LEG3_", "LEG4_", "TELEMETRY_")):
+    num_script = os.path.join(workdir, "num_child.py")
+    with open(num_script, "w") as f:
+        f.write(_NUM_CHILD.format(repo=_REPO))
+    num = subprocess.run(
+        [sys.executable, num_script], cwd=workdir,
+        env=_env(workdir, single_device=True),
+        capture_output=True, text=True, timeout=900,
+    )
+    num_out = num.stdout + num.stderr
+    if num.returncode != 0 or "LEG5_NUMERICS_OK" not in num_out:
+        print(
+            f"telemetry_smoke FAIL leg5 (rc={num.returncode}):\n{num_out[-4000:]}"
+        )
+        return 1
+    for line in (out + db_out + num_out).splitlines():
+        if line.startswith(("LEG1_", "LEG2_", "LEG3_", "LEG4_", "LEG5_",
+                            "TELEMETRY_")):
             print(line)
     return 0
 

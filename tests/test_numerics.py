@@ -1,0 +1,614 @@
+"""Numerics & memory observatory (ISSUE 12; docs/OBSERVABILITY.md
+"Numerics"/"Memory"): in-graph layer statistics riding the step outputs,
+the NaN provenance drill-down, HBM accounting from ``memory_analysis()``,
+the MFU-estimate fallback, the build-info gauge, guard-skip batch
+provenance, mixture draw-id attribution, and flight-recorder concurrency.
+"""
+
+import dataclasses
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.obs import flightrec as obs_flightrec
+from hydragnn_tpu.obs import memory as obs_memory
+from hydragnn_tpu.obs import numerics as obs_numerics
+from hydragnn_tpu.obs.events import events
+from hydragnn_tpu.obs.registry import registry
+from hydragnn_tpu.train import TrainState, make_optimizer
+from hydragnn_tpu.train.loop import make_train_step, train_epoch
+from hydragnn_tpu.utils import faultinject
+
+
+def _setup(hidden=8, batch_size=8, n=32):
+    graphs = MinMax.fit(deterministic_graph_dataset(n, seed=3)).apply(
+        deterministic_graph_dataset(n, seed=3)
+    )
+    voi = VariablesOfInterest([0], ["s"], ["graph"], [0], [1, 1, 1], [1])
+    graphs = [extract_variables(g, voi) for g in graphs]
+    cfg = {
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": hidden,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": hidden,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [hidden, hidden],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["s"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+    cfg = update_config(cfg, graphs, graphs[:4], graphs[:4])
+    loader = GraphLoader(graphs, batch_size, seed=0, prefetch=0)
+    model = create_model(cfg)
+    variables = init_model(model, next(iter(loader)), seed=0)
+    tx = make_optimizer(cfg["NeuralNetwork"]["Training"]["Optimizer"])
+    return cfg, loader, model, variables, tx
+
+
+# ---------------------------------------------------------------------------
+# stat math
+# ---------------------------------------------------------------------------
+
+
+def pytest_stat_components_masked_math():
+    """The raw moment vector over a masked tensor: padding rows (garbage
+    by contract) excluded from every statistic; NaN/inf counted; nonzero
+    sub-bf16-normal magnitudes counted as underflow."""
+    x = np.zeros((4, 2), np.float32)
+    x[0] = [3.0, -4.0]
+    x[1] = [np.nan, np.inf]
+    x[2] = [1e-39, 0.0]  # subnormal in bf16, plus a true zero
+    x[3] = [1e6, 1e6]  # padding row: must not be seen
+    mask = np.array([True, True, True, False])
+    comps = jax.jit(lambda a, m: obs_numerics._stat_components(a, m))(x, mask)
+    maxabs, sumsq, cnt, nonfin, under = [float(v) for v in comps]
+    assert cnt == 6.0  # 3 real rows x 2 channels
+    assert nonfin == 2.0 and under == 1.0
+    assert not np.isfinite(maxabs)  # NaN/inf present -> magnitude poisoned
+    st = obs_numerics.finalize_stats(np.asarray(comps))
+    assert st["nonfinite"] == 2.0
+    assert st["bf16_underflow"] == pytest.approx(1.0 / 6.0)
+
+    # clean masked tensor: exact rms / max-abs
+    y = np.array([[1.0, -2.0], [3.0, 4.0], [9.0, 9.0]], np.float32)
+    m2 = np.array([True, True, False])
+    st2 = obs_numerics.finalize_stats(
+        np.asarray(obs_numerics._stat_components(y, m2))
+    )
+    assert st2["max_abs"] == 4.0
+    assert st2["rms"] == pytest.approx(np.sqrt((1 + 4 + 9 + 16) / 4.0))
+    assert st2["nonfinite"] == 0.0 and st2["bf16_underflow"] == 0.0
+
+
+def pytest_grad_group_stats_groups_by_module():
+    grads = {
+        "conv_a": {"kernel": np.ones((2, 3), np.float32) * 2.0},
+        "head_b": {"kernel": np.full((4,), np.nan, np.float32),
+                   "bias": np.zeros((2,), np.float32)},
+    }
+    # names are trace-time strings (the builders stash them on a meta
+    # cell); only the stat table is a jit-returnable array
+    table = jax.jit(lambda g: obs_numerics.grad_group_stats(g)[1])(grads)
+    names, _ = obs_numerics.grad_group_stats(grads)
+    table = np.asarray(table)
+    assert names == ("conv_a", "head_b")
+    assert table.shape == (2, obs_numerics.STAT_WIDTH)
+    assert float(table[0][0]) == 2.0 and float(table[0][3]) == 0.0
+    assert float(table[1][3]) == 4.0  # the NaN'd kernel, bias clean
+
+
+# ---------------------------------------------------------------------------
+# step ride-along
+# ---------------------------------------------------------------------------
+
+
+def pytest_numerics_step_rides_bundle_loss_identical():
+    """numerics=True returns a 4-tuple whose loss is BIT-identical to the
+    historical 3-tuple step; the bundle carries forward-ordered activation
+    probes and sorted gradient groups with populated name tables."""
+    cfg, loader, model, variables, tx = _setup()
+    rng = jax.random.PRNGKey(0)
+    b = next(iter(loader))
+    off = make_train_step(model, tx)
+    on = make_train_step(model, tx, numerics=True)
+    out_off = off(TrainState.create(init_model(model, b, seed=0), tx), b, rng)
+    out_on = on(TrainState.create(init_model(model, b, seed=0), tx), b, rng)
+    assert len(out_off) == 3 and len(out_on) == 4
+    assert float(out_off[1]) == float(out_on[1])
+    numer = out_on[3]
+    assert bool(np.asarray(numer["ok"]))
+    meta = on._numerics_meta
+    acts = np.asarray(numer["act"])
+    assert acts.shape == (len(meta["act_names"]), obs_numerics.STAT_WIDTH)
+    # forward order: embedding first, head last; layers.py bn taps between
+    assert meta["act_names"][0] == "embedding"
+    assert meta["act_names"][-1].startswith("head:")
+    assert any(n.startswith("bn:") for n in meta["act_names"])
+    gnames = meta["grad_names"]
+    assert tuple(gnames) == tuple(sorted(gnames)) and len(gnames) > 1
+    assert np.asarray(numer["grad"]).shape == (
+        len(gnames), obs_numerics.STAT_WIDTH,
+    )
+    assert np.all(np.asarray(numer["act"])[:, 3] == 0)  # clean forward
+    assert callable(on._nan_diagnose)
+
+
+def pytest_nan_watch_gradient_provenance_and_flight_dump(tmp_path):
+    """Injected gradient NaN (faultinject) -> the watch's deferred check
+    catches the guarded skips, the drill-down names the first non-finite
+    gradient group, a typed numerics_provenance event is emitted, and
+    exactly ONE flight-recorder dump (with the OOM-forensics memory.json)
+    is produced per run."""
+    cfg, loader, model, variables, tx = _setup()
+    faultinject.configure(nan_step="2+")
+    try:
+        step = make_train_step(model, tx, numerics=True)
+        st = TrainState.create(variables, tx)
+        rng = jax.random.PRNGKey(0)
+        rec = obs_flightrec.FlightRecorder(str(tmp_path)).install(
+            signal_hook=False
+        )
+        try:
+            watch = obs_numerics.NanWatch(
+                diagnose=step._nan_diagnose, lag=2
+            )
+            before = len(
+                [e for e in events().snapshot()
+                 if e["kind"] == "numerics_provenance"]
+            )
+            st, tot, tasks, rng, cursor = train_epoch(
+                loader, step, st, rng, nan_watch=watch
+            )
+            skips = watch.take()
+            assert watch.located >= 2 and len(skips) >= 2
+            first = skips[0]
+            assert first["kind"] == "gradient" and first["layer"]
+            assert first["level"].endswith("e") and "n/" in first["level"]
+            assert first["stat_nonfinite"] > 0
+            evs = [e for e in events().snapshot()
+                   if e["kind"] == "numerics_provenance"]
+            assert len(evs) - before >= 2
+            assert evs[-1]["tensor_kind"] == "gradient"
+            dumps = os.listdir(tmp_path / "flightrec")
+            dumps = [d for d in dumps if "numerics_provenance" in d]
+            assert len(dumps) == 1  # one dump per run, not per skip
+            mem = json.load(
+                open(tmp_path / "flightrec" / dumps[0] / "memory.json")
+            )
+            assert "hbm_by_spec" in mem and "device_memory_peak_bytes" in mem
+        finally:
+            rec.uninstall()
+    finally:
+        faultinject.reset()
+
+
+def pytest_nan_watch_diagnostic_budget_bounds_sustained_divergence():
+    """A run that fails every step must not re-run the (forward+backward)
+    diagnostic forever: past max_diagnoses the cheap skip tally continues,
+    drill-downs and per-skip events stop, one budget event announces it."""
+    calls = {"n": 0}
+
+    def counting_diagnose(state, batch, rng, step):
+        calls["n"] += 1
+        return {"layer": "conv0", "kind": "gradient",
+                "stats": {"max_abs": 1.0, "rms": 1.0, "nonfinite": 1.0,
+                          "bf16_underflow": 0.0}}
+
+    watch = obs_numerics.NanWatch(
+        diagnose=counting_diagnose, lag=1, max_diagnoses=3
+    )
+    bad = np.zeros((), bool)  # every step's ok flag is False
+    before = len(
+        [e for e in events().snapshot()
+         if e["kind"] == "numerics_provenance"]
+    )
+    for i in range(10):
+        watch.on_step(None, None, None, i, i, {"ok": bad})
+    watch.end_epoch(None)
+    assert calls["n"] == 3  # the budget, not one per failed step
+    assert watch.suppressed == 7
+    skips = watch.take()
+    assert len(skips) == 10  # the guard tally still sees every skip
+    assert skips[-1]["layer"] == "<diagnostic_budget_spent>"
+    after = [e for e in events().snapshot()
+             if e["kind"] == "numerics_provenance"][before:]
+    # 3 drill-down events + ONE budget announcement, not 10
+    assert len(after) == 4
+    assert after[-1]["layer"] == "<diagnostic_budget_spent>"
+
+
+def pytest_nan_diagnose_first_activation_in_forward_order():
+    """A NaN planted in the INPUT features must be attributed to the first
+    probe that sees it (embedding), not to a downstream layer or to the
+    gradients."""
+    cfg, loader, model, variables, tx = _setup()
+    step = make_train_step(model, tx, numerics=True)
+    st = TrainState.create(variables, tx)
+    b = next(iter(loader))
+    x = np.array(np.asarray(b.x), copy=True)
+    x[0, 0] = np.nan
+    bad = dataclasses.replace(b, x=x)
+    finding = step._nan_diagnose(st, bad, jax.random.PRNGKey(0), 0)
+    assert finding is not None
+    assert finding["kind"] == "activation"
+    assert finding["layer"] == "embedding"
+    assert finding["stats"]["nonfinite"] >= 1
+
+
+def pytest_guard_log_census_and_guard_skip_event_provenance():
+    """Without numerics, the epoch's non-finite LOSS census still attaches
+    batch provenance (pad level + batch index) to the guard_skip event via
+    NonFinitePolicy.after_epoch(provenance=...)."""
+    from hydragnn_tpu.train.guard import NonFinitePolicy
+
+    cfg, loader, model, variables, tx = _setup()
+    # poison one batch's features so the LOSS itself goes non-finite (the
+    # grad-only fault path is covered by the watch test above)
+    poisoned = []
+    for i, g in enumerate(loader.graphs):
+        if i == 0:
+            x = np.array(np.asarray(g.x), copy=True)
+            x[0, 0] = np.nan
+            g = dataclasses.replace(g, x=x)
+        poisoned.append(g)
+    bad_loader = GraphLoader(poisoned, 8, seed=0, shuffle=False, prefetch=0)
+    step = make_train_step(model, tx)  # numerics OFF: census fallback
+    st = TrainState.create(variables, tx)
+    guard_log = {}
+    st, tot, tasks, rng, cursor = train_epoch(
+        bad_loader, step, st, jax.random.PRNGKey(0), guard_log=guard_log
+    )
+    nonfinite = guard_log.get("nonfinite")
+    assert nonfinite and nonfinite[0]["batch"] == 0
+    assert "n/" in nonfinite[0]["level"]
+    policy = NonFinitePolicy(policy="warn_skip")
+    policy.after_epoch(st, 0, provenance=nonfinite)
+    ev = [e for e in events().snapshot() if e["kind"] == "guard_skip"][-1]
+    assert ev["new_skips"] >= 1
+    assert ev.get("batches") == "0"
+    assert "n/" in ev.get("levels", "")
+
+
+# ---------------------------------------------------------------------------
+# HBM accounting + MFU fallback
+# ---------------------------------------------------------------------------
+
+
+def pytest_memory_record_snapshot_and_gauges():
+    obs_memory.reset()
+    compiled = jax.jit(lambda x: (x * 2.0).sum()).lower(
+        np.ones((64, 64), np.float32)
+    ).compile()
+    stats = obs_memory.record("train:64n/64e", compiled)
+    assert stats is not None and stats["peak_bytes"] > 0
+    assert stats["argument_bytes"] >= 64 * 64 * 4
+    snap = obs_memory.snapshot()
+    assert snap["train:64n/64e"]["peak_bytes"] == stats["peak_bytes"]
+    g = registry().get("hydragnn_hbm_peak_bytes")
+    assert g is not None
+    assert g.value(spec="train:64n/64e") == stats["peak_bytes"]
+
+
+def pytest_compile_plane_reports_hbm_table(tmp_path):
+    """Blocking AOT warm-up harvests memory_analysis beside the flops: the
+    report carries the per-spec peak table and the grep-able line its
+    hbm_peak= token."""
+    from hydragnn_tpu.train.compile_plane import (
+        CompilePlane,
+        format_report,
+        sentinel,
+        set_cache_dir,
+    )
+    from hydragnn_tpu.train.loop import make_eval_step
+
+    cfg, loader, model, variables, tx = _setup()
+    step = make_train_step(model, tx)
+    evalf = make_eval_step(model)
+    st = TrainState.create(variables, tx)
+    obs_memory.reset()
+    old = os.environ.get("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
+    os.environ["HYDRAGNN_COMPILE_CACHE_MIN_SECS"] = "0"
+    try:
+        set_cache_dir(str(tmp_path / "cache"), min_compile_secs=0)
+        plane = CompilePlane(mode="blocking", log_name="hbmtest")
+        plane.launch(step, evalf, st, loader, loader, loader,
+                     rng=jax.random.PRNGKey(0))
+        rep = plane.report()
+        assert rep["hbm_by_spec"] and rep["hbm_peak_bytes"] > 0
+        assert any(k.startswith("train:") for k in rep["hbm_by_spec"])
+        assert f"hbm_peak={rep['hbm_peak_bytes']}" in format_report(rep)
+        plane.finish()
+    finally:
+        set_cache_dir(None)
+        sentinel().reset()
+        if old is None:
+            os.environ.pop("HYDRAGNN_COMPILE_CACHE_MIN_SECS", None)
+        else:
+            os.environ["HYDRAGNN_COMPILE_CACHE_MIN_SECS"] = old
+
+
+def pytest_mfu_fallback_harvests_first_organic_executable(tmp_path):
+    """Training.precompile: off zeroes flops_by_spec (only warm-up filled
+    it) — with a cache active, enable_flops_fallback harvests the first
+    organic step's executable so the MFU gauge has a source."""
+    from hydragnn_tpu.train.compile_plane import (
+        CompilePlane,
+        sentinel,
+        set_cache_dir,
+    )
+    from hydragnn_tpu.train.loop import make_eval_step
+
+    cfg, loader, model, variables, tx = _setup()
+    step = make_train_step(model, tx)
+    evalf = make_eval_step(model)
+    st = TrainState.create(variables, tx)
+    rng = jax.random.PRNGKey(0)
+    old = os.environ.get("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
+    os.environ["HYDRAGNN_COMPILE_CACHE_MIN_SECS"] = "0"
+    try:
+        set_cache_dir(str(tmp_path / "cache"), min_compile_secs=0)
+        plane = CompilePlane(mode="off", log_name="fbtest")
+        inst = plane.launch(step, evalf, st, loader, loader, loader, rng=rng)
+        plane.enable_flops_fallback()
+        assert plane._organic_flops
+        assert not plane.flops_by_spec  # nothing until the organic step
+        b = next(iter(loader))
+        inst(st, b, rng)
+        key = (int(b.node_mask.shape[-1]), int(b.edge_mask.shape[-1]))
+        assert plane.train_flops_for(key) and plane.train_flops_for(key) > 0
+        assert plane.memory_by_spec  # HBM rides the same harvest
+        plane.finish()
+    finally:
+        set_cache_dir(None)
+        sentinel().reset()
+        if old is None:
+            os.environ.pop("HYDRAGNN_COMPILE_CACHE_MIN_SECS", None)
+        else:
+            os.environ["HYDRAGNN_COMPILE_CACHE_MIN_SECS"] = old
+
+
+def pytest_mfu_fallback_warns_without_cache():
+    """Without a persistent cache the fallback would pay a full duplicate
+    XLA compile — it must warn once naming the cause instead of arming."""
+    import warnings
+
+    from hydragnn_tpu.train.compile_plane import (
+        CompilePlane,
+        sentinel,
+        set_cache_dir,
+    )
+    from hydragnn_tpu.train.loop import make_eval_step
+
+    cfg, loader, model, variables, tx = _setup()
+    step = make_train_step(model, tx)
+    st = TrainState.create(variables, tx)
+    set_cache_dir(None)
+    try:
+        plane = CompilePlane(mode="off", log_name="warntest")
+        plane.launch(step, make_eval_step(model), st, loader, loader,
+                     loader, rng=jax.random.PRNGKey(0))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            plane.enable_flops_fallback()
+        assert not plane._organic_flops
+        assert any("MFU" in str(x.message) and "precompile" in str(x.message)
+                   for x in w)
+    finally:
+        sentinel().reset()
+
+
+# ---------------------------------------------------------------------------
+# build info / flight recorder / config surface
+# ---------------------------------------------------------------------------
+
+
+def pytest_build_info_gauge_self_describes():
+    from hydragnn_tpu.obs.telemetry import publish_build_info
+
+    publish_build_info()
+    g = registry().get("hydragnn_build_info")
+    assert g is not None
+    samples = g.samples()
+    assert samples and samples[0][2] == 1.0
+    labels = dict(samples[0][1])
+    assert labels["jax"] == jax.__version__
+    assert labels["backend"] == jax.default_backend()
+    assert int(labels["devices"]) == jax.device_count()
+    assert labels["git"]  # describe string or "unknown", never empty
+    # idempotence is keyed on REGISTRY state: dropping the series (the
+    # registry-reset scenario, done surgically here so the process-global
+    # event counter other tests bind to stays attached) must let a later
+    # publisher re-materialize it instead of permanently no-opping
+    registry()._metrics.pop("hydragnn_build_info")
+    publish_build_info()
+    g2 = registry().get("hydragnn_build_info")
+    assert g2 is not None and g2.samples()
+
+
+def pytest_flight_recorder_concurrent_triggers(tmp_path):
+    """Two threads hitting the dump path simultaneously must produce two
+    well-formed bounded dumps (distinct directories, complete file sets,
+    no torn .tmp leftovers), and the dump budget still binds."""
+    rec = obs_flightrec.FlightRecorder(str(tmp_path), max_dumps=2)
+    results = []
+    barrier = threading.Barrier(2)
+
+    def fire(reason):
+        barrier.wait()
+        results.append(rec.dump(reason))
+
+    threads = [
+        threading.Thread(target=fire, args=(f"concurrent_{i}",))
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dirs = [r for r in results if r]
+    assert len(dirs) == 2 and len(set(dirs)) == 2
+    for d in dirs:
+        names = set(os.listdir(d))
+        assert {"meta.json", "events.json", "spans.json",
+                "metrics.prom", "memory.json"} <= names
+        json.load(open(os.path.join(d, "meta.json")))
+        json.load(open(os.path.join(d, "events.json")))
+        json.load(open(os.path.join(d, "memory.json")))
+    leftovers = [
+        d for d in os.listdir(tmp_path / "flightrec")
+        if d.startswith(".tmp")
+    ]
+    assert not leftovers
+    assert rec.dump("over_budget") is None  # budget spent by the pair
+
+
+def pytest_resolve_telemetry_numerics_key(monkeypatch):
+    from hydragnn_tpu.config.lint import lint_config
+    from hydragnn_tpu.obs.telemetry import resolve_telemetry
+
+    assert resolve_telemetry({})["numerics"] is False
+    out = resolve_telemetry({"Telemetry": {"enabled": True,
+                                           "numerics": True}})
+    assert out["numerics"] is True
+    with pytest.raises(ValueError, match="numerics"):
+        resolve_telemetry({"Telemetry": {"numerics": "yes"}})
+    monkeypatch.setenv("HYDRAGNN_NUMERICS", "1")
+    assert resolve_telemetry({})["numerics"] is True
+    monkeypatch.setenv("HYDRAGNN_NUMERICS", "0")
+    assert resolve_telemetry(
+        {"Telemetry": {"numerics": True}}
+    )["numerics"] is False
+    monkeypatch.delenv("HYDRAGNN_NUMERICS")
+    # builder-side resolution is explicit-only: the env must NOT flip a
+    # direct builder's return arity out from under 3-tuple callers
+    # (bench.py, examples) — it flows through resolve_telemetry into the
+    # loop/api's explicit numerics= argument instead
+    assert obs_numerics.numerics_enabled(True) is True
+    assert obs_numerics.numerics_enabled(None) is False
+    monkeypatch.setenv("HYDRAGNN_NUMERICS", "1")
+    assert obs_numerics.numerics_enabled(None) is False
+    # every truthy env token resolves identically through the one shared
+    # env_flag parse
+    monkeypatch.setenv("HYDRAGNN_NUMERICS", "true")
+    assert resolve_telemetry({})["numerics"] is True
+    findings = lint_config({"Telemetry": {"numerics": True}})
+    assert all(f.status == "handled" for f in findings), findings
+
+
+def pytest_telemetry_numerics_window_flush(tmp_path):
+    """StepTelemetry aggregates the per-step stacks over the window (max /
+    sums), publishes the hydragnn_numerics_* series, and emits a strict-
+    JSON `numerics` record."""
+    from hydragnn_tpu.obs.telemetry import StepTelemetry, resolve_telemetry
+
+    cfg, loader, model, variables, tx = _setup()
+    faultinject.configure(nan_step="1+")
+    try:
+        step = make_train_step(model, tx, numerics=True)
+        telem = StepTelemetry(
+            resolve_telemetry(
+                {"Telemetry": {"enabled": True, "interval_steps": 2,
+                               "numerics": True}}
+            ),
+            "numflush",
+            log_path=str(tmp_path),
+        )
+        telem.attach_numerics(step._numerics_meta)
+        st = TrainState.create(variables, tx)
+        st, *_ = train_epoch(
+            loader, step, st, jax.random.PRNGKey(0), telemetry=telem
+        )
+        telem.close()
+        recs = [
+            json.loads(l)
+            for l in open(tmp_path / "numflush" / "metrics.jsonl")
+        ]
+        nrecs = [r for r in recs if r["kind"] == "numerics"]
+        assert nrecs
+        grads = nrecs[-1]["gradients"]
+        assert any(v["nonfinite"] > 0 for v in grads.values())
+        # non-finite magnitudes are stringified, lines stay strict JSON
+        assert any(
+            isinstance(v["max_abs"], str) for v in grads.values()
+        )
+        acts = nrecs[-1]["activations"]
+        assert "embedding" in acts and acts["embedding"]["nonfinite"] == 0
+        g = registry().get("hydragnn_numerics_rms")
+        assert g is not None
+        assert np.isfinite(g.value(kind="activation", tensor="embedding"))
+        c = registry().get("hydragnn_numerics_nonfinite_total")
+        assert c is not None and any(s[2] > 0 for s in c.samples())
+    finally:
+        faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# mixture draw-id provenance
+# ---------------------------------------------------------------------------
+
+
+def pytest_mixture_batch_sources_journal():
+    from hydragnn_tpu.mix import (
+        MixturePlane,
+        resolve_mixture,
+        sources_from_graphs,
+    )
+
+    raw = MinMax.fit(deterministic_graph_dataset(48, seed=11)).apply(
+        deterministic_graph_dataset(48, seed=11)
+    )
+    voi = VariablesOfInterest([0], ["s"], ["graph"], [0], [1, 1, 1], [1])
+    graphs = [
+        dataclasses.replace(extract_variables(g, voi), dataset_id=i % 2)
+        for i, g in enumerate(raw)
+    ]
+    plane = MixturePlane(
+        sources_from_graphs(graphs), 8,
+        settings=resolve_mixture({"Mixture": {}}), seed=7,
+    )
+    assert plane.batch_sources(0) is None  # nothing built yet
+    plane.set_epoch(0)
+    batches = list(plane)
+    assert batches
+    for b in range(len(batches)):
+        srcs = plane.batch_sources(b)
+        assert srcs, f"batch {b} has no journaled sources"
+        assert all(isinstance(s, int) for s in srcs)
+        assert set(srcs) <= set(plane.sources)
+    # the union over the epoch covers every active source (two ~equal ones)
+    union = {s for b in range(len(batches)) for s in plane.batch_sources(b)}
+    assert union == set(plane.sources)
