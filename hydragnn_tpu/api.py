@@ -775,6 +775,47 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             lambda b: promote_batch(b, mesh),
         )
 
+    # sharding-layout inspector (obs/sharding.py): whenever a placement
+    # was applied (zero1/2/3, mesh DP, branch decoders), tabulate the
+    # placed state's param/optimizer leaf shardings, run the replicated-
+    # above-threshold audit, publish the hydragnn_sharding_* gauges, and
+    # record the report so every flight dump carries sharding.json — the
+    # before/after oracle for the planned rule-table sharding refactor
+    if placement_fns:
+        from .obs import sharding as obs_sharding
+        from .obs.telemetry import resolve_telemetry as _rt
+
+        try:
+            import sys as _sys
+
+            _shard_report = obs_sharding.inspect_state(
+                state,
+                threshold_bytes=int(
+                    _rt(config)["fleet_sharding_audit_bytes"]
+                ),
+                label=log_name,
+                mesh=mesh,
+            )
+            obs_sharding.record(_shard_report)
+            if verbosity > 0:
+                # summary + audit at verbosity 1 (one grep-able line per
+                # run), the full per-leaf table at 2+
+                print(
+                    obs_sharding.format_report(
+                        _shard_report, leaves=verbosity > 1
+                    ),
+                    file=_sys.stderr,
+                )
+        except Exception as _e:  # the inspector must never block training
+            import warnings as _warnings
+
+            _warnings.warn(
+                f"sharding inspector failed ({type(_e).__name__}: {_e}); "
+                "the placement report is unavailable for this run",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+
     writer = MetricsWriter(log_name)
 
     def log_fn(epoch, scalars):

@@ -177,6 +177,15 @@ _HANDLED = {
     "Telemetry.trace_interval_steps",
     "Telemetry.flight_recorder",
     "Telemetry.numerics",
+    "Telemetry.fleet",
+    "Telemetry.fleet_collector",
+    "Telemetry.fleet_collector_port",
+    "Telemetry.fleet_collector_host",
+    "Telemetry.fleet_straggler_factor",
+    "Telemetry.fleet_max_step_lag",
+    "Telemetry.fleet_stale_after_s",
+    "Telemetry.fleet_collective_budget",
+    "Telemetry.fleet_sharding_audit_bytes",
     "Mixture.temperature",
     "Mixture.weights",
     "Mixture.draws_per_epoch",

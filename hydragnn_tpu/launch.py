@@ -6,7 +6,12 @@ The C++ binary (native/launcher.cpp) is the torchrun/setup_ddp analog
 (world_size, rank, coordinator) from scheduler envs or fans out ``--nprocs``
 local ranks, exports the ``HYDRAGNN_COORDINATOR``/``WORLD_SIZE``/``RANK``
 contract that ``hydragnn_tpu.parallel.setup_distributed`` consumes, and
-execs the training command::
+execs the training command. The same exported envs give each rank its
+fleet identity (``obs/fleet.py host_identity`` falls back to them before
+the JAX runtime is up), so every launched process self-identifies in the
+fleet observability plane from its very first record; set
+``HYDRAGNN_FLEET_COLLECTOR=host:port`` alongside to point every rank's
+telemetry push at rank 0 (docs/OBSERVABILITY.md "Fleet")::
 
     python -m hydragnn_tpu.launch --nprocs 2 -- python train.py config.json
     srun python -m hydragnn_tpu.launch -- python train.py config.json

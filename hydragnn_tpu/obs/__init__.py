@@ -1,12 +1,22 @@
 """Unified telemetry plane (docs/OBSERVABILITY.md): the process-wide
 metrics registry every subsystem publishes into, the Prometheus scrape +
 health endpoint, the per-step train instrumentation with its versioned
-``metrics.jsonl`` stream, the on-demand profiling trigger, and the tracing
+``metrics.jsonl`` stream, the on-demand profiling trigger, the tracing
 plane — request/step spans (obs/trace.py), the structured event log
-(obs/events.py), and the crash flight recorder (obs/flightrec.py)."""
+(obs/events.py), the crash flight recorder (obs/flightrec.py) — and the
+fleet layer: cross-host aggregation + straggler/desync watchdog
+(obs/fleet.py) and the sharding-layout inspector (obs/sharding.py)."""
 
 from .events import EventLog, events
 from .events import emit as emit_event
+from .fleet import (
+    FleetCollector,
+    FleetPlane,
+    FleetPusher,
+    host_identity,
+    merge_traces,
+    registry_snapshot,
+)
 from .flightrec import FlightRecorder
 from .numerics import NanWatch, numerics_enabled, probe
 from .prometheus import TelemetryHTTPServer, render_text, start_endpoint
@@ -33,6 +43,9 @@ from .trace import Span, Tracer
 __all__ = [
     "Counter",
     "EventLog",
+    "FleetCollector",
+    "FleetPlane",
+    "FleetPusher",
     "FlightRecorder",
     "Gauge",
     "Histogram",
@@ -47,13 +60,16 @@ __all__ = [
     "Tracer",
     "emit_event",
     "events",
+    "host_identity",
     "host_memory_bytes",
+    "merge_traces",
     "mfu_estimate",
     "numerics_enabled",
     "peak_flops",
     "probe",
     "publish_build_info",
     "registry",
+    "registry_snapshot",
     "render_text",
     "resolve_telemetry",
     "start_endpoint",

@@ -49,6 +49,10 @@ EV_MIX_SOURCE_REMOVE = "mix_source_remove"  # mixture source hot-removed
 EV_MIX_DEMOTE = "mix_demote"              # source quarantine-demoted (mix/)
 EV_MIX_DRIFT = "mix_drift"                # per-branch loss diverged past threshold
 EV_NUMERICS_PROVENANCE = "numerics_provenance"  # NaN drill-down located a tensor
+EV_FLEET_STRAGGLER = "fleet_straggler"    # fleet watchdog flagged a slow host
+EV_FLEET_DESYNC = "fleet_desync"          # step progress skewed past the bound
+EV_FLEET_HOST_STALE = "fleet_host_stale"  # host heartbeat missing past timeout
+EV_SHARDING_AUDIT = "sharding_audit"      # inspector flagged an over-replicated leaf
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
@@ -57,6 +61,8 @@ EVENT_KINDS = (
     EV_RELOAD_SWAP, EV_RELOAD_REJECT, EV_FLIGHT_DUMP,
     EV_MIX_SOURCE_ADD, EV_MIX_SOURCE_REMOVE, EV_MIX_DEMOTE, EV_MIX_DRIFT,
     EV_NUMERICS_PROVENANCE,
+    EV_FLEET_STRAGGLER, EV_FLEET_DESYNC, EV_FLEET_HOST_STALE,
+    EV_SHARDING_AUDIT,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")

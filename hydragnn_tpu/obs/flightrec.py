@@ -87,17 +87,29 @@ class FlightRecorder:
                 c if c.isalnum() or c in "-_" else "_" for c in str(reason)
             )[:64] or "dump"
             stamp = time.strftime("%Y%m%d-%H%M%S")
+            # host-disambiguated directory: a coordinated fleet dump lands
+            # every host's black box onto the SAME shared filesystem at the
+            # same second — the process index keeps them side by side
+            # instead of colliding (obs/fleet.py host_identity)
+            try:
+                from .fleet import host_identity
+
+                host_i, _ = host_identity()
+            except Exception:
+                host_i = 0
             final = os.path.join(
-                self.out_root, f"{stamp}-{idx:02d}-{safe_reason}"
+                self.out_root, f"{stamp}-{idx:02d}-{safe_reason}-h{host_i}"
             )
             tmp = os.path.join(
-                self.out_root, f".tmp-{idx:02d}-{safe_reason}-{os.getpid()}"
+                self.out_root,
+                f".tmp-{idx:02d}-{safe_reason}-h{host_i}-{os.getpid()}",
             )
             os.makedirs(tmp, exist_ok=True)
             meta: Dict[str, Any] = {
                 "reason": str(reason),
                 "ts": round(time.time(), 6),
                 "pid": os.getpid(),
+                "host": host_i,
                 "dump_index": idx,
             }
             if exc is not None:
@@ -134,6 +146,20 @@ class FlightRecorder:
                         fh,
                         indent=2,
                     )
+            except Exception:
+                pass
+            # sharding-layout table (obs/sharding.py): the placement
+            # oracle rides every black box so a fleet post-mortem can diff
+            # each host's actual leaf placements — best-effort like memory
+            try:
+                from . import sharding as _sharding
+
+                table = _sharding.snapshot()
+                if table:
+                    with open(
+                        os.path.join(tmp, "sharding.json"), "w"
+                    ) as fh:
+                        json.dump(table, fh, indent=2)
             except Exception:
                 pass
             os.rename(tmp, final)

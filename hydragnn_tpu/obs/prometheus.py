@@ -26,7 +26,7 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 from .registry import MetricsRegistry, registry
 
@@ -79,6 +79,13 @@ class TelemetryHTTPServer:
     drives ``/healthz``. Both are called per request on the handler thread,
     so they must be cheap and lock-free (the call sites pass Event checks).
     ``port=0`` binds an ephemeral port — read it back from ``.port``.
+
+    ``post_routes`` maps a path to ``body_bytes -> (status, json_dict)`` —
+    the fleet collector mounts its push sink here (obs/fleet.py), so the
+    cross-host push rides the same HTTP substrate the scrape endpoint
+    already owns instead of a second server stack. A handler exception
+    returns 500 with the error named; there is no handler = 404, matching
+    the GET side.
     """
 
     def __init__(
@@ -88,10 +95,14 @@ class TelemetryHTTPServer:
         port: int = 0,
         ready_fn: Optional[Callable[[], bool]] = None,
         health_fn: Optional[Callable[[], Tuple[bool, str]]] = None,
+        post_routes: Optional[
+            Dict[str, Callable[[bytes], Tuple[int, dict]]]
+        ] = None,
     ):
         self._registry = reg if reg is not None else registry()
         self._ready_fn = ready_fn or (lambda: True)
         self._health_fn = health_fn or (lambda: (True, "ok"))
+        self._post_routes = dict(post_routes or {})
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -136,6 +147,29 @@ class TelemetryHTTPServer:
                     else:
                         self._send(404, b"not found\n", "text/plain")
                 except BrokenPipeError:  # client went away mid-scrape
+                    pass
+
+            def do_POST(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    handler = outer._post_routes.get(path)
+                    if handler is None:
+                        self._send(404, b"not found\n", "text/plain")
+                        return
+                    length = int(self.headers.get("Content-Length", 0) or 0)
+                    body = self.rfile.read(length) if length else b""
+                    try:
+                        status, payload = handler(body)
+                    except Exception as e:  # handler bug != dead endpoint
+                        status, payload = 500, {
+                            "error": f"{type(e).__name__}: {e}"
+                        }
+                    self._send(
+                        status,
+                        json.dumps(payload).encode("utf-8"),
+                        "application/json",
+                    )
+                except BrokenPipeError:  # client went away mid-reply
                     pass
 
         self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)

@@ -139,6 +139,15 @@ class Gauge(_Metric):
         with self._lock:
             return self._values.get(key, math.nan)
 
+    def remove(self, **labels) -> None:
+        """Drop one label-set's series entirely (the fleet collector
+        retires aggregates whose only contributors went stale — a frozen
+        last value scraping forever is indistinguishable from a live
+        reading). No-op when the series never existed."""
+        key = _label_key(self.labelnames, labels)
+        with self._lock:
+            self._values.pop(key, None)
+
     def samples(self):
         with self._lock:
             return [("", k, v) for k, v in self._values.items()]

@@ -1,0 +1,270 @@
+"""Sharding-layout inspector: the param tree -> placement oracle
+(docs/OBSERVABILITY.md "Fleet" / sharding audit).
+
+The dp/zero1-3/branch builders each hand-place state (parallel/mesh.py
+``shard_optimizer_state``/``shard_params_zero3``/``place_branch_state``)
+and nothing ever rendered the RESULT: whether a given leaf actually ended
+up sharded, over which axis, and how many bytes of it every device holds.
+That blind spot is exactly what makes the planned rule-table sharding
+refactor (ROADMAP item 1) risky — there is no before/after oracle to diff.
+This module is that oracle:
+
+- ``inspect_state`` walks a (placed) TrainState and tabulates every
+  params / opt_state leaf: tree path, PartitionSpec, replicated-vs-
+  sharded, total and per-device bytes (parallel/mesh.py
+  ``leaf_sharding_info`` reads the committed shardings).
+- ``format_report`` renders the table as grep-able ``sharding[...]``
+  lines; ``record`` stores it in a process table the flight recorder
+  dumps verbatim (``sharding.json``) and publishes the
+  ``hydragnn_sharding_*`` gauges.
+- the **audit** flags every leaf left fully replicated above a size
+  threshold (``Telemetry.fleet_sharding_audit_bytes``) — the lint that
+  catches "this 80 MB moment bank silently fell off the ZeRO path"
+  before the HBM bill does. Findings are emitted as typed
+  ``sharding_audit`` events (bounded), so they ride flight dumps too.
+
+Everything is host-side metadata walking — no device transfers, no
+compute — and best-effort by the plane's contract: a leaf the helper
+cannot describe is skipped, never raised on.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+# bounded event emission per audit: a badly-placed model can have hundreds
+# of offending leaves; the report carries them all, the event log does not
+_MAX_AUDIT_EVENTS = 8
+
+_LOCK = threading.Lock()
+_REPORTS: Dict[str, Dict[str, Any]] = {}
+# which step builder produced the live placement (parallel/dp.py,
+# parallel/branch.py, train/loop.py note at build time) — report provenance
+_BUILDER: Optional[Dict[str, Any]] = None
+
+
+def note_builder(
+    name: str, mesh_shape: Optional[Dict[str, int]] = None, **flags: Any
+) -> None:
+    """Record which step builder (and mesh / ZeRO flags) owns the live
+    placement — called by the builders themselves so the inspector report
+    names its provenance instead of guessing from leaf shapes."""
+    global _BUILDER
+    with _LOCK:
+        _BUILDER = {
+            "name": str(name),
+            "mesh": dict(mesh_shape) if mesh_shape else None,
+            **{k: v for k, v in flags.items()},
+        }
+
+
+def builder_info() -> Optional[Dict[str, Any]]:
+    with _LOCK:
+        return dict(_BUILDER) if _BUILDER is not None else None
+
+
+def sharding_table(tree, section: str = "") -> List[Dict[str, Any]]:
+    """Per-leaf placement entries of one pytree: ``{path, spec, sharded,
+    total_bytes, per_device_bytes, devices, dtype, shape}``. Leaves the
+    mesh helper cannot describe (non-arrays) are skipped."""
+    import jax
+
+    from ..parallel.mesh import leaf_sharding_info
+
+    out: List[Dict[str, Any]] = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        try:
+            info = leaf_sharding_info(leaf)
+        except Exception:
+            info = None
+        if info is None:
+            continue
+        key = jax.tree_util.keystr(path)
+        out.append({"path": f"{section}{key}", **info})
+    return out
+
+
+def audit_table(
+    table: List[Dict[str, Any]], threshold_bytes: int
+) -> List[Dict[str, Any]]:
+    """Lint pass: every fully replicated leaf at/above the threshold is a
+    finding — on a ZeRO/branch placement it means the leaf fell off the
+    sharding path (or the rule table regressed)."""
+    findings = []
+    for e in table:
+        if e["replicated"] and e["total_bytes"] >= int(threshold_bytes):
+            findings.append(
+                {
+                    "path": e["path"],
+                    "bytes": e["total_bytes"],
+                    "spec": e["spec"],
+                    "message": (
+                        f"leaf {e['path']} is fully replicated at "
+                        f"{e['total_bytes']} bytes (>= audit threshold "
+                        f"{int(threshold_bytes)}) — every device holds a "
+                        "full copy"
+                    ),
+                }
+            )
+    return findings
+
+
+def _summary(table: List[Dict[str, Any]]) -> Dict[str, Any]:
+    sharded = [e for e in table if not e["replicated"]]
+    return {
+        "leaves": len(table),
+        "sharded_leaves": len(sharded),
+        "total_bytes": int(sum(e["total_bytes"] for e in table)),
+        "sharded_bytes": int(sum(e["total_bytes"] for e in sharded)),
+        "replicated_bytes": int(
+            sum(e["total_bytes"] for e in table if e["replicated"])
+        ),
+        "per_device_bytes": int(
+            sum(e["per_device_bytes"] for e in table)
+        ),
+    }
+
+
+def inspect_state(
+    state,
+    threshold_bytes: int = 1 << 20,
+    label: str = "train_state",
+    mesh=None,
+) -> Dict[str, Any]:
+    """Tabulate a (placed) TrainState's params + optimizer leaves and run
+    the replication audit. ``mesh`` (a ``jax.sharding.Mesh``) adds the
+    axis sizes to the report header; builder provenance comes from
+    ``note_builder``."""
+    sections: Dict[str, List[Dict[str, Any]]] = {}
+    for name in ("params", "opt_state", "batch_stats"):
+        sub = getattr(state, name, None)
+        if sub is None:
+            continue
+        table = sharding_table(sub, section=name)
+        if table:
+            sections[name] = table
+    flat = [e for table in sections.values() for e in table]
+    report: Dict[str, Any] = {
+        "label": str(label),
+        "mesh": (
+            {str(k): int(v) for k, v in dict(mesh.shape).items()}
+            if mesh is not None
+            else None
+        ),
+        "builder": builder_info(),
+        "threshold_bytes": int(threshold_bytes),
+        "sections": sections,
+        "summary": _summary(flat),
+        "audit": audit_table(flat, threshold_bytes),
+    }
+    return report
+
+
+def format_report(report: Dict[str, Any], leaves: bool = True) -> str:
+    """Grep-able text rendering: one ``sharding[label] ...`` summary line,
+    one line per leaf (``leaves=False`` keeps just summary + audit)."""
+    label = report["label"]
+    s = report["summary"]
+    mesh = report.get("mesh")
+    mesh_s = (
+        ",".join(f"{k}:{v}" for k, v in mesh.items()) if mesh else "none"
+    )
+    builder = report.get("builder") or {}
+    lines = [
+        f"sharding[{label}] builder={builder.get('name', 'unknown')} "
+        f"mesh={mesh_s} leaves={s['leaves']} "
+        f"sharded={s['sharded_leaves']} "
+        f"total_bytes={s['total_bytes']} "
+        f"replicated_bytes={s['replicated_bytes']} "
+        f"per_device_bytes={s['per_device_bytes']} "
+        f"audit_warnings={len(report['audit'])}"
+    ]
+    if leaves:
+        for table in report["sections"].values():
+            for e in table:
+                lines.append(
+                    f"sharding[{label}] leaf={e['path']} "
+                    f"spec={e['spec']} "
+                    f"{'SHARDED' if not e['replicated'] else 'REPLICATED'} "
+                    f"bytes={e['total_bytes']} "
+                    f"per_device={e['per_device_bytes']} "
+                    f"dtype={e['dtype']} shape={list(e['shape'])}"
+                )
+    for f in report["audit"]:
+        lines.append(f"sharding[{label}] AUDIT {f['message']}")
+    return "\n".join(lines)
+
+
+def record(report: Dict[str, Any], emit_events: bool = True) -> Dict[str, Any]:
+    """Store the report in the process table (the flight recorder dumps it
+    as ``sharding.json``), publish the ``hydragnn_sharding_*`` gauges, and
+    emit (bounded) ``sharding_audit`` events for the findings."""
+    label = report["label"]
+    with _LOCK:
+        _REPORTS[label] = report
+    try:
+        from .registry import registry
+
+        reg = registry()
+        s = report["summary"]
+        g_bytes = reg.gauge(
+            "hydragnn_sharding_bytes",
+            "State bytes by placement (sharding inspector, obs/sharding.py)",
+            labelnames=("label", "placement"),
+        )
+        g_bytes.set(s["sharded_bytes"], label=label, placement="sharded")
+        g_bytes.set(
+            s["replicated_bytes"], label=label, placement="replicated"
+        )
+        g_leaves = reg.gauge(
+            "hydragnn_sharding_leaves",
+            "State leaves by placement",
+            labelnames=("label", "placement"),
+        )
+        g_leaves.set(
+            s["sharded_leaves"], label=label, placement="sharded"
+        )
+        g_leaves.set(
+            s["leaves"] - s["sharded_leaves"],
+            label=label,
+            placement="replicated",
+        )
+        reg.gauge(
+            "hydragnn_sharding_audit_warnings",
+            "Replicated-above-threshold leaves the sharding audit flagged",
+            labelnames=("label",),
+        ).set(float(len(report["audit"])), label=label)
+    except Exception:
+        pass  # the table is the source of truth; gauges are best-effort
+    if emit_events and report["audit"]:
+        try:
+            from .events import EV_SHARDING_AUDIT
+            from .events import emit as emit_event
+
+            for f in report["audit"][:_MAX_AUDIT_EVENTS]:
+                emit_event(
+                    EV_SHARDING_AUDIT,
+                    severity="warn",
+                    label=label,
+                    leaf=f["path"],
+                    bytes=f["bytes"],
+                    spec=f["spec"],
+                )
+        except Exception:
+            pass
+    return report
+
+
+def snapshot() -> Dict[str, Dict[str, Any]]:
+    """The per-label report table (what the flight recorder dumps)."""
+    with _LOCK:
+        return {k: dict(v) for k, v in _REPORTS.items()}
+
+
+def reset() -> None:
+    """Drop reports + builder note (tests)."""
+    global _BUILDER
+    with _LOCK:
+        _REPORTS.clear()
+        _BUILDER = None

@@ -86,6 +86,20 @@ TELEMETRY_DEFAULTS: Dict[str, Any] = {
     # re-runs its held batch through a probe-instrumented diagnostic that
     # names the first non-finite tensor. HYDRAGNN_NUMERICS=1/0 overrides.
     "numerics": False,
+    # fleet plane (obs/fleet.py; docs/OBSERVABILITY.md "Fleet"): per-host
+    # registry snapshots push to a rank-0 collector each flush window,
+    # which publishes across-host hydragnn_fleet_* aggregates and runs the
+    # straggler/desync watchdog. HYDRAGNN_FLEET=1/0 overrides "fleet";
+    # HYDRAGNN_FLEET_COLLECTOR overrides the collector address.
+    "fleet": False,
+    "fleet_collector": None,        # "host:port" push target / rank-0 bind port
+    "fleet_collector_port": 0,      # rank-0 bind port when no address is given
+    "fleet_collector_host": "127.0.0.1",  # rank-0 bind interface
+    "fleet_straggler_factor": 2.0,  # step time vs fleet median before flagging
+    "fleet_max_step_lag": 200,      # steps of progress skew before fleet_desync
+    "fleet_stale_after_s": 30.0,    # heartbeat silence before a host goes stale
+    "fleet_collective_budget": None,  # est. collective fraction bound (None=off)
+    "fleet_sharding_audit_bytes": 1 << 20,  # replicated-leaf audit threshold
 }
 
 # peak dense bf16 FLOP/s by TPU generation (public figures; bench.py
@@ -183,6 +197,51 @@ def resolve_telemetry(config: Dict[str, Any]) -> Dict[str, Any]:
             "Telemetry.trace_interval_steps must be >= 1, got "
             f"{out['trace_interval_steps']!r}"
         )
+    env_fleet = env_flag("HYDRAGNN_FLEET")
+    if env_fleet is not None:
+        out["fleet"] = env_fleet
+    if not isinstance(out["fleet"], bool):
+        raise ValueError(
+            f"Telemetry.fleet must be true/false, got {out['fleet']!r}"
+        )
+    if float(out["fleet_straggler_factor"]) <= 1.0:
+        raise ValueError(
+            "Telemetry.fleet_straggler_factor must be > 1 (it multiplies "
+            f"the fleet median step time), got "
+            f"{out['fleet_straggler_factor']!r}"
+        )
+    if int(out["fleet_max_step_lag"]) < 1:
+        raise ValueError(
+            "Telemetry.fleet_max_step_lag must be >= 1, got "
+            f"{out['fleet_max_step_lag']!r}"
+        )
+    if float(out["fleet_stale_after_s"]) <= 0:
+        raise ValueError(
+            "Telemetry.fleet_stale_after_s must be > 0, got "
+            f"{out['fleet_stale_after_s']!r}"
+        )
+    if out["fleet_collective_budget"] is not None and not (
+        0.0 < float(out["fleet_collective_budget"]) <= 1.0
+    ):
+        raise ValueError(
+            "Telemetry.fleet_collective_budget must be null (off) or a "
+            f"fraction in (0, 1], got {out['fleet_collective_budget']!r}"
+        )
+    if int(out["fleet_sharding_audit_bytes"]) < 0:
+        raise ValueError(
+            "Telemetry.fleet_sharding_audit_bytes must be >= 0, got "
+            f"{out['fleet_sharding_audit_bytes']!r}"
+        )
+    if out["fleet_collector"] is not None:
+        from .fleet import _valid_collector_addr
+
+        # ONE grammar with the HYDRAGNN_FLEET_COLLECTOR env path
+        # (obs/fleet.py applies the same helper, warn-and-degrade there)
+        if not _valid_collector_addr(str(out["fleet_collector"])):
+            raise ValueError(
+                "Telemetry.fleet_collector must be a 'host:port' address, "
+                f"got {out['fleet_collector']!r}"
+            )
     return out
 
 
@@ -256,11 +315,17 @@ def publish_build_info() -> None:
     except Exception:
         pass
     try:
+        from .fleet import host_identity
+
+        host_i, host_n = host_identity()
         registry().gauge(
             "hydragnn_build_info",
             "Build/runtime identity of this process (value is always 1; "
             "the facts are the labels)",
-            labelnames=("jax", "jaxlib", "backend", "devices", "git"),
+            labelnames=(
+                "jax", "jaxlib", "backend", "devices", "git",
+                "process_index", "process_count",
+            ),
         ).set(
             1.0,
             jax=jax_v,
@@ -268,6 +333,10 @@ def publish_build_info() -> None:
             backend=backend,
             devices=str(devices),
             git=_git_describe(),
+            # fleet identity: every scrape self-identifies which host of
+            # how many produced it (obs/fleet.py host_identity)
+            process_index=str(host_i),
+            process_count=str(host_n),
         )
     except Exception:
         pass
@@ -295,7 +364,8 @@ class MetricsStream:
     record stamped with the schema version and a wall-clock timestamp.
     Rank-0-gated like ``MetricsWriter`` — exactly one stream per run."""
 
-    def __init__(self, run_dir: str, rank0: Optional[bool] = None):
+    def __init__(self, run_dir: str, rank0: Optional[bool] = None,
+                 fleet: bool = False):
         if rank0 is None:
             try:
                 import jax
@@ -303,7 +373,24 @@ class MetricsStream:
                 rank0 = jax.process_index() == 0
             except Exception:
                 rank0 = True
-        self.path = os.path.join(run_dir, "metrics.jsonl")
+        # fleet identity: every record self-identifies its host, and a
+        # non-zero host writing onto a shared filesystem gets its own
+        # stream file (two processes appending one JSONL interleave
+        # mid-line) — obs/fleet.py host_identity. With the fleet plane ON
+        # the per-host stream overrides the historical rank-0 gate: the
+        # whole point of the plane is per-host records, and the suffixed
+        # filename makes the multi-writer case safe (the Tracer gets the
+        # same override in train/loop.py)
+        from .fleet import host_identity
+
+        self._host, _ = host_identity()
+        fname = (
+            "metrics.jsonl" if self._host == 0
+            else f"metrics-h{self._host}.jsonl"
+        )
+        if fleet and self._host > 0:
+            rank0 = True
+        self.path = os.path.join(run_dir, fname)
         self._fh = None
         self._flushed_at = 0.0
         # HPO trial labeling (hpo.py run_hpo exports HYDRAGNN_TRIAL_ID per
@@ -338,7 +425,7 @@ class MetricsStream:
         if self._fh is None:
             return
         line = {"v": SCHEMA_VERSION, "ts": round(time.time(), 3),
-                "kind": kind, **record}
+                "kind": kind, "host": self._host, **record}
         if self._trial is not None:
             line["trial"] = self._trial
         try:
@@ -563,6 +650,11 @@ class StepTelemetry:
         self.global_step = 0
         self._flops_for: Optional[Callable[[Tuple[int, int]], Optional[float]]] = None
         self._flops_cache: Dict[Tuple[int, int], Optional[float]] = {}
+        # comm accounting source (train/compile_plane.py comm_by_spec):
+        # (per-shard padded nodes, edges) -> per-spec collective table
+        self._comm_for: Optional[
+            Callable[[Tuple[int, int]], Optional[Dict[str, Any]]]
+        ] = None
         self._device_kind: Optional[str] = None
         self._mem_refreshed_at = 0.0
         self._numerics_meta: Optional[Dict[str, Any]] = None
@@ -572,7 +664,9 @@ class StepTelemetry:
 
         # -- sinks / registry ------------------------------------------------
         self.stream = (
-            MetricsStream(self.run_dir) if settings["jsonl"] else None
+            MetricsStream(self.run_dir, fleet=bool(settings.get("fleet")))
+            if settings["jsonl"]
+            else None
         )
         self.trigger = (
             ProfileTrigger(self.run_dir, steps=int(settings["profile_steps"]))
@@ -590,6 +684,14 @@ class StepTelemetry:
                 label=f"telemetry[{log_name}]",
                 host=str(settings["http_host"]),
             )
+        # fleet plane (obs/fleet.py): rank-0 collector + per-host pusher;
+        # None when Telemetry.fleet is off — every call site then pays one
+        # `is not None` check, nothing else
+        self.fleet = None
+        if settings.get("fleet"):
+            from .fleet import FleetPlane
+
+            self.fleet = FleetPlane.from_settings(settings, self.run_dir)
         reg = registry()
         self._h_step = reg.histogram(
             "hydragnn_step_time_seconds",
@@ -689,6 +791,17 @@ class StepTelemetry:
         are populated by the time the first window flushes)."""
         self._numerics_meta = meta
 
+    def attach_comm(
+        self,
+        comm_for: Callable[[Tuple[int, int]], Optional[Dict[str, Any]]],
+    ) -> None:
+        """Install the comm-accounting source: (per-shard padded nodes,
+        edges) -> that train-step specialization's collective table
+        (train/compile_plane.py ``train_comm_for``), or None while warm-up
+        has not walked its HLO yet. The flush windows then carry the
+        per-step collective bytes + compute-vs-comm decomposition."""
+        self._comm_for = comm_for
+
     def _flops_of(self, key: Tuple[int, int]) -> Optional[float]:
         got = self._flops_cache.get(key)
         if got is None and self._flops_for is not None:
@@ -769,6 +882,36 @@ class StepTelemetry:
         if flops_known and flops > 0:
             mfu = mfu_estimate(flops, dt, self._device_kind_cached())
             self._g_mfu.set(mfu)
+        # comm accounting (compile-plane HLO walk): window-weighted
+        # collective bytes per step + the compute-vs-comm decomposition —
+        # None until every visited spec's table is harvested
+        comm_bytes = comm_frac = None
+        if self._comm_for is not None:
+            total_bytes = 0.0
+            frac_weighted = 0.0
+            steps_seen = 0
+            known = frac_known = True
+            for key, b in self._w_buckets.items():
+                c = self._comm_for(key)
+                if c is None:
+                    known = False
+                    break
+                total_bytes += float(c.get("bytes_total", 0.0)) * b["steps"]
+                frac = c.get("comm_fraction_est")
+                if frac is None:
+                    # a spec whose FLOPs never harvested has bytes but no
+                    # decomposition — publishing a fraction diluted by
+                    # zeros would underestimate (and could mask a
+                    # fleet_collective_budget breach), so the whole
+                    # window's fraction stays unknown instead
+                    frac_known = False
+                else:
+                    frac_weighted += float(frac) * b["steps"]
+                steps_seen += b["steps"]
+            if known and steps_seen:
+                comm_bytes = total_bytes / steps_seen
+                if frac_known:
+                    comm_frac = frac_weighted / steps_seen
         num_rec = None
         if self._w_numerics and self._numerics_meta is not None:
             try:  # observability never takes the owner down
@@ -797,6 +940,17 @@ class StepTelemetry:
                     # 9 decimals: a CPU-backend MFU is ~1e-7 against the
                     # TPU peak table and must not round to a dead 0.0
                     "mfu_est": round(mfu, 9) if mfu is not None else None,
+                    # per-device collective bytes each step moves + the
+                    # estimated fraction of step time inside collectives
+                    # (compile-plane comm accounting; None until harvested)
+                    "comm_bytes_per_step": (
+                        round(comm_bytes, 1) if comm_bytes is not None
+                        else None
+                    ),
+                    "comm_fraction_est": (
+                        round(comm_frac, 6) if comm_frac is not None
+                        else None
+                    ),
                     "buckets": buckets,
                 },
             )
@@ -818,6 +972,15 @@ class StepTelemetry:
             )
         if self.trigger is not None:
             self.trigger.poll(self.global_step)
+        if self.fleet is not None:
+            # the flush IS the heartbeat: registry snapshot + step index +
+            # window step time (+ collective fraction) push to the rank-0
+            # collector on the fleet plane's background thread
+            self.fleet.on_window(
+                self.global_step,
+                step_time_s=dt / max(self._w_steps, 1),
+                comm_fraction_est=comm_frac,
+            )
         self._reset_window()
 
     def _numerics_gauges(self):
@@ -996,6 +1159,11 @@ class StepTelemetry:
 
     def close(self) -> None:
         self.flush()
+        if self.fleet is not None:
+            # final synchronous push: the collector sees this host's
+            # terminal step, and this host applies any last broadcast
+            self.fleet.close(final_step=self.global_step)
+            self.fleet = None
         if self.trigger is not None:
             self.trigger.close()
         if self.http is not None:

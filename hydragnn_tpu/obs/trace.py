@@ -186,12 +186,20 @@ class Tracer:
         ring: int = 512,
         jsonl: bool = True,
         rank0: Optional[bool] = None,
+        filename: str = "trace.jsonl",
     ):
         self.sample = float(sample)
         self.every_n_steps = int(every_n_steps)
         self.run_dir = run_dir
+        # fleet identity: every span record self-identifies its host so
+        # per-host streams stitch into one run-level view (obs/fleet.py
+        # merge_traces); ``filename`` lets a non-zero fleet host write its
+        # own host-suffixed stream on a shared filesystem (train/loop.py)
+        from .fleet import host_identity
+
+        self.host, _ = host_identity()
         self.path = (
-            os.path.join(run_dir, "trace.jsonl")
+            os.path.join(run_dir, filename)
             if run_dir and jsonl
             else None
         )
@@ -355,6 +363,7 @@ class Tracer:
 
     def _emit(self, span: Span) -> None:
         rec = span.to_record()
+        rec["host"] = self.host
         with self._lock:
             self._ring.append(rec)
             self.emitted += 1

@@ -162,8 +162,16 @@ def make_branch_parallel_train_step(
     # the env default must freeze when the step is constructed, not when it
     # first traces, and guard=True/False gives programmatic A/B control
     from ..obs import numerics as obs_numerics
+    from ..obs import sharding as obs_sharding
     from ..train.guard import guard_enabled
 
+    # sharding-inspector provenance (obs/sharding.py): the branch builder's
+    # decoder banks are the one placement the replication audit must NOT
+    # flag as accidental — the report names the owner
+    obs_sharding.note_builder(
+        "branch_parallel_train_step", dict(mesh.shape),
+        branches=int(cfg.num_branches),
+    )
     use_guard = guard_enabled(guard)
     # Telemetry.numerics (obs/numerics.py): probes tap the LOCAL branch
     # slice's modules per device; activation stats merge across the mesh

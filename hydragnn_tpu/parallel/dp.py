@@ -75,9 +75,15 @@ def make_parallel_train_step(
     (obs/numerics.py; same contract as train/loop.make_train_step)."""
     cfg = model.cfg
     from ..obs import numerics as obs_numerics
+    from ..obs import sharding as obs_sharding
     from ..train.guard import guard_enabled, guarded_update, step_ok
     from ..utils import faultinject
 
+    # sharding-inspector provenance: the report names the builder + mesh
+    # that own the live placement (obs/sharding.py)
+    obs_sharding.note_builder(
+        "parallel_train_step", dict(mesh.shape), zero2=zero2, zero3=zero3,
+    )
     use_guard = guard_enabled(guard)
     use_numerics = obs_numerics.numerics_enabled(numerics)
     meta = {"act_names": None, "grad_names": None}

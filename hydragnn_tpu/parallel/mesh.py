@@ -227,6 +227,55 @@ def zero2_grad_constraint(grads, mesh: Mesh, min_size: int = 1024):
     return jax.tree_util.tree_map(place, grads)
 
 
+def leaf_sharding_info(x) -> Optional[dict]:
+    """Placement facts of one state leaf for the sharding inspector
+    (obs/sharding.py): PartitionSpec string, replicated-vs-sharded, total
+    and per-device bytes. Pure metadata — no transfers, no compute. None
+    for non-array leaves; host numpy arrays report as replicated with a
+    ``host`` spec (every process holds the full copy, which is what the
+    audit cares about)."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is None or dtype is None:
+        return None
+    try:
+        itemsize = np.dtype(dtype).itemsize
+    except TypeError:
+        return None
+    total = int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+    sharding = getattr(x, "sharding", None)
+    if sharding is None:
+        return {
+            "spec": "host", "replicated": True, "total_bytes": total,
+            "per_device_bytes": total, "devices": 1,
+            "dtype": str(np.dtype(dtype)), "shape": tuple(shape),
+        }
+    if isinstance(sharding, NamedSharding):
+        spec = str(sharding.spec)
+    else:
+        spec = type(sharding).__name__
+    replicated = bool(getattr(sharding, "is_fully_replicated", True))
+    per_device = total
+    try:
+        shard_shape = sharding.shard_shape(tuple(shape))
+        per_device = (
+            int(np.prod(shard_shape, dtype=np.int64)) * itemsize
+            if shard_shape
+            else itemsize
+        )
+    except Exception:
+        pass
+    try:
+        devices = len(sharding.device_set)
+    except Exception:
+        devices = 1
+    return {
+        "spec": spec, "replicated": replicated, "total_bytes": total,
+        "per_device_bytes": per_device, "devices": devices,
+        "dtype": str(np.dtype(dtype)), "shape": tuple(shape),
+    }
+
+
 def materialize_replicated(tree):
     """Host-local numpy copy of a (possibly sharded) global-state pytree.
 

@@ -48,6 +48,7 @@ Observability: per-specialization compile seconds, cache hit/miss counts
 from __future__ import annotations
 
 import os
+import re
 import sys
 import threading
 import time
@@ -56,7 +57,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-PRECOMPILE_MODES = ("off", "blocking", "background")
+PRECOMPILE_MODES = ("off", "blocking", "background", "analysis")
 RETRACE_POLICIES = ("warn", "error")
 
 # how long finish() waits for a still-running warm-up worker before leaking
@@ -140,6 +141,113 @@ def compile_metrics() -> Dict[str, float]:
 def _metrics_delta(before: Dict[str, float]) -> Dict[str, float]:
     now = compile_metrics()
     return {k: now[k] - before.get(k, 0) for k in now}
+
+
+# ---------------------------------------------------------------------------
+# communication accounting: collective ops + bytes from the compiled HLO
+# ---------------------------------------------------------------------------
+
+# per-chip ICI bandwidth by TPU generation, bytes/second (public figures,
+# same table discipline as PEAK_FLOPS in obs/telemetry.py) — the divisor of
+# the collective-time estimate. CPU/unknown gets a deliberately modest
+# figure so the estimate stays an ESTIMATE, never a claim.
+ICI_BYTES_PER_S = {
+    "v6": 400e9,
+    "v5p": 600e9,
+    "v5": 200e9,  # v5e / "TPU v5 lite"
+    "v4": 300e9,
+}
+
+
+def ici_bytes_per_s(device_kind: str) -> float:
+    kind = str(device_kind).lower()
+    for key, val in ICI_BYTES_PER_S.items():
+        if key in kind:
+            return val
+    return 50e9
+
+
+# result-shape + op-name of one collective instruction in optimized HLO
+# text. Async pairs count once: the `-start` op is matched, the matching
+# `-done` never is (after the base op name only `-start(` or `(` match).
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s+(?P<shape>\([^)]*\)|[a-zA-Z0-9]+\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"(?P<op>all-reduce|all-gather|reduce-scatter|all-to-all|"
+    r"collective-permute|collective-broadcast)(?P<start>-start)?\("
+)
+_HLO_SHAPE_TOKEN_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_HLO_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "s32": 4, "u32": 4, "s16": 2, "u16": 2,
+    "s8": 1, "u8": 1, "s4": 1, "u4": 1, "pred": 1, "c64": 8, "c128": 16,
+}
+
+
+def _shape_bytes(shape: str, largest_only: bool = False) -> int:
+    """Bytes of one HLO result shape (scalar, array, or tuple).
+    ``largest_only`` keeps just the biggest tuple component — the async
+    ``-start`` forms return ``(operand, destination, ...)`` tuples whose
+    operand entries alias buffers already counted, so summing them would
+    roughly double the sync form's figure."""
+    sizes = []
+    for dtype, dims in _HLO_SHAPE_TOKEN_RE.findall(shape):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        sizes.append(n * _HLO_DTYPE_BYTES.get(dtype, 4))
+    if not sizes:
+        return 0
+    return max(sizes) if largest_only else sum(sizes)
+
+
+def collective_census(hlo_text: str) -> Dict[str, Dict[str, float]]:
+    """Count collective instructions and their per-device result bytes in
+    an optimized HLO module: ``{op: {"count": n, "bytes": b}}``. The text
+    is the PER-DEVICE SPMD program, so bytes are what each device's
+    collective touches per step — the figure the ICI/DCN estimate divides.
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        op = m.group("op")
+        entry = out.setdefault(op, {"count": 0, "bytes": 0})
+        entry["count"] += 1
+        entry["bytes"] += _shape_bytes(
+            m.group("shape"), largest_only=m.group("start") is not None
+        )
+    return out
+
+
+def summarize_comm(
+    census: Dict[str, Dict[str, float]],
+    flops: Optional[float],
+    device_kind: str,
+) -> Dict[str, Any]:
+    """One spec's collective table + the compute-vs-comm step-time
+    decomposition: ``comm_time_est_s`` = bytes / per-chip ICI bandwidth,
+    ``compute_time_est_s`` = XLA-counted FLOPs / chip peak,
+    ``comm_fraction_est`` their ratio — the direct instrument for the MFU
+    hunt (a spec whose fraction dominates is bandwidth-bound, and no
+    kernel fusion will move it)."""
+    from ..obs.telemetry import peak_flops
+
+    bytes_total = float(sum(e["bytes"] for e in census.values()))
+    ops_total = int(sum(e["count"] for e in census.values()))
+    comm_t = bytes_total / ici_bytes_per_s(device_kind)
+    compute_t = (
+        float(flops) / peak_flops(device_kind) if flops else None
+    )
+    fraction = None
+    if compute_t is not None and (comm_t + compute_t) > 0:
+        fraction = comm_t / (comm_t + compute_t)
+    return {
+        "collectives": {k: dict(v) for k, v in sorted(census.items())},
+        "ops_total": ops_total,
+        "bytes_total": int(bytes_total),
+        "comm_time_est_s": comm_t,
+        "compute_time_est_s": compute_t,
+        "comm_fraction_est": fraction,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -581,6 +689,13 @@ class CompilePlane:
         # and rendered in report(); the flight recorder dumps the process
         # table as its OOM-forensics section.
         self.memory_by_spec: Dict[str, Dict[str, float]] = {}
+        # communication accounting (collective_census): per warmed
+        # specialization, the collective ops + per-device bytes walked out
+        # of the compiled HLO and the compute-vs-comm decomposition —
+        # published as hydragnn_comm_* gauges, rendered in report(), and
+        # read per window by the telemetry layer (attach_comm). Dict
+        # writes are atomic under the GIL like flops_by_spec.
+        self.comm_by_spec: Dict[str, Dict[str, Any]] = {}
         # MFU-estimate fallback (obs/telemetry.py attach_flops consumer):
         # with precompile off nothing fills flops_by_spec — when armed via
         # enable_flops_fallback(), the first organic step's executable is
@@ -636,9 +751,16 @@ class CompilePlane:
                val_loader=None, test_loader=None, rng=None, skip_eval=False):
         """Start the plane for one run. Returns ``step_fn`` instrumented
         with a first-step timer; warm-up runs per ``self.mode``. Without an
-        active persistent cache directory the mode degrades to ``off``: the
-        call path could never reuse the AOT executables, so warm-up would
-        burn a core for nothing."""
+        active persistent cache directory ``blocking``/``background``
+        degrade to ``off``: the call path could never reuse the AOT
+        executables, so warm-up would burn a core for nothing. Mode
+        ``analysis`` is the explicit exception — it runs the (blocking)
+        warm-up regardless, accepting that without a cache the
+        executables are unreachable, because the harvests are the point:
+        the FLOPs/HBM/collective tables and the MFU gauge on environments
+        where a persistent cache cannot run (shared-FS quota, or a jaxlib
+        whose cache-key serializer is broken — run-scripts/fleet_smoke.py
+        runs under exactly that)."""
         from ..utils import tracer as tr
         from ..utils.timers import Timer
 
@@ -655,7 +777,7 @@ class CompilePlane:
         # this plane's report never attributes an earlier run's retraces
         # to itself (in-process HPO trials, repeated run_training)
         self._viol0 = len(_SENTINEL.violations())
-        if self.mode != "off" and self.cache_dir is None:
+        if self.mode in ("blocking", "background") and self.cache_dir is None:
             self.mode = "off"
         if self.mode != "off":
             import jax
@@ -666,7 +788,7 @@ class CompilePlane:
                 step_fn, None if skip_eval else eval_fn, state,
                 train_loader, val_loader, test_loader, rng,
             )
-            if self.mode == "blocking":
+            if self.mode in ("blocking", "analysis"):
                 with Timer("compile_plane_warmup"):
                     self._run_jobs()
                 self._maybe_arm()
@@ -773,6 +895,60 @@ class CompilePlane:
                 self.memory_by_spec[label] = stats
         except Exception:  # memory analysis availability is backend-bound
             pass
+        # collective census: walk the compiled per-device HLO for
+        # collective ops + bytes. Multi-device programs only — a
+        # single-device executable has no collectives, and its (possibly
+        # tens of MB) HLO text is not worth materializing to prove it.
+        try:
+            import jax
+
+            if jax.device_count() > 1:
+                census = collective_census(compiled.as_text())
+                summary = summarize_comm(
+                    census,
+                    self.flops_by_spec.get(label),
+                    jax.devices()[0].device_kind,
+                )
+                self.comm_by_spec[label] = summary
+                self._publish_comm(label, summary)
+        except Exception:  # the census is best-effort observability
+            pass
+
+    @staticmethod
+    def _publish_comm(label: str, summary: Dict[str, Any]) -> None:
+        """hydragnn_comm_* gauges for one spec (best-effort)."""
+        try:
+            from ..obs.registry import registry
+
+            reg = registry()
+            g_ops = reg.gauge(
+                "hydragnn_comm_collectives",
+                "Collective instructions per compiled specialization "
+                "(HLO census, train/compile_plane.py)",
+                labelnames=("spec", "collective"),
+            )
+            g_bytes = reg.gauge(
+                "hydragnn_comm_bytes",
+                "Per-device bytes each collective touches per step",
+                labelnames=("spec", "collective"),
+            )
+            for op, entry in summary["collectives"].items():
+                g_ops.set(entry["count"], spec=label, collective=op)
+                g_bytes.set(entry["bytes"], spec=label, collective=op)
+            reg.gauge(
+                "hydragnn_comm_bytes_total",
+                "Per-device collective bytes per step, all collectives",
+                labelnames=("spec",),
+            ).set(summary["bytes_total"], spec=label)
+            if summary["comm_fraction_est"] is not None:
+                reg.gauge(
+                    "hydragnn_comm_fraction_est",
+                    "Estimated fraction of step time inside collectives "
+                    "(bytes/ICI-bandwidth vs FLOPs/peak)",
+                    labelnames=("spec",),
+                ).set(summary["comm_fraction_est"], spec=label)
+        except Exception:
+            pass
 
     def _worker_main(self) -> None:
         from ..utils.timers import Timer
@@ -793,6 +969,12 @@ class CompilePlane:
         (per-shard nodes, edges), or None while warm-up has not compiled
         it (background mode fills the table as it goes)."""
         return self.flops_by_spec.get(f"train:{key[0]}n/{key[1]}e")
+
+    def train_comm_for(self, key: Tuple[int, int]) -> Optional[Dict[str, Any]]:
+        """Collective table of the train-step specialization padded to
+        ``key`` (obs/telemetry.py ``attach_comm`` consumer), or None while
+        its HLO has not been walked."""
+        return self.comm_by_spec.get(f"train:{key[0]}n/{key[1]}e")
 
     def enable_flops_fallback(self) -> None:
         """Arm the organic cost/memory harvest for ``precompile: off``
@@ -901,6 +1083,28 @@ class CompilePlane:
                 if self.memory_by_spec
                 else None
             ),
+            # per-spec collective table (HLO census): bytes + op count +
+            # the compute-vs-comm decomposition — ROADMAP item 4's direct
+            # instrument (a comm-bound spec shows up HERE, not in a guess)
+            "comm_by_spec": {
+                label: {
+                    "bytes_total": int(c["bytes_total"]),
+                    "ops_total": int(c["ops_total"]),
+                    "comm_fraction_est": (
+                        round(c["comm_fraction_est"], 6)
+                        if c["comm_fraction_est"] is not None
+                        else None
+                    ),
+                }
+                for label, c in sorted(self.comm_by_spec.items())
+            },
+            "comm_bytes_peak": (
+                max(
+                    int(c["bytes_total"]) for c in self.comm_by_spec.values()
+                )
+                if self.comm_by_spec
+                else None
+            ),
         }
 
 
@@ -908,6 +1112,13 @@ def format_report(rep: Dict[str, Any]) -> str:
     """One grep-able line (the chaos/compile smokes parse these fields)."""
     ttfs = rep.get("time_to_first_step")
     hbm = rep.get("hbm_peak_bytes")
+    comm = rep.get("comm_bytes_peak")
+    comm_specs = rep.get("comm_by_spec") or {}
+    fracs = [
+        c["comm_fraction_est"]
+        for c in comm_specs.values()
+        if c.get("comm_fraction_est") is not None
+    ]
     return (
         f"compile plane: mode={rep['mode']} "
         f"remat={rep.get('remat_policy', 'full')} "
@@ -917,7 +1128,9 @@ def format_report(rep: Dict[str, Any]) -> str:
         f"time_to_first_step={ttfs if ttfs is not None else 'n/a'}s "
         f"traces={sum(rep['traces'].values())} "
         f"violations={rep['violations']} "
-        f"hbm_peak={hbm if hbm is not None else 'n/a'}"
+        f"hbm_peak={hbm if hbm is not None else 'n/a'} "
+        f"comm_bytes_peak={comm if comm is not None else 'n/a'} "
+        f"comm_frac_est={round(max(fracs), 4) if fracs else 'n/a'}"
         + (f" warmup_errors={len(rep['warmup_errors'])}"
            if rep["warmup_errors"] else "")
     )

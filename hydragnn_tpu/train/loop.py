@@ -358,7 +358,7 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
     the loader exposes ``batch_sources``) the mixture draw ids of every
     step whose loss came back non-finite — the batch provenance the
     epoch-boundary guard policy attaches to its ``guard_skip`` event."""
-    from ..utils import preemption
+    from ..utils import faultinject, preemption
     from ..utils import tracer as tr
 
     # Device-side loss bookkeeping: the per-step (loss, tasks) scalars stay
@@ -415,6 +415,14 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
         rng, sub = jax.random.split(rng)
         tr.start("train_step")
         t_step = time.perf_counter()
+        # fleet chaos hook: host-side sleep when HYDRAGNN_FAULT_STRAGGLE
+        # is armed — the slow-host model the fleet watchdog must flag
+        # (utils/faultinject.py; exact no-op unarmed, one dict lookup).
+        # INSIDE the measured interval: the injected slowness must land
+        # in the step time the telemetry window pushes as the fleet
+        # heartbeat, or the drill would not model what the watchdog
+        # measures
+        faultinject.maybe_straggle(i)
         out = step_fn(state, batch, sub)
         # a numerics-enabled step rides its stat bundle as a 4th output
         # (obs/numerics.py); the historical 3-tuple is unchanged otherwise
@@ -685,10 +693,24 @@ def train_validate_test(
     if obs_settings["trace"]:
         from ..obs import trace as obs_trace
 
+        # fleet mode: every host writes its own span stream (host 0 keeps
+        # the plain trace.jsonl name) — two processes appending one JSONL
+        # on a shared filesystem interleave mid-line; obs/fleet.py
+        # merge_traces stitches the streams into the run-level view
+        trace_kw = {}
+        if obs_settings.get("fleet"):
+            from ..obs.fleet import host_identity
+
+            host_i, _ = host_identity()
+            if host_i > 0:
+                trace_kw = {
+                    "filename": f"trace-h{host_i}.jsonl", "rank0": True,
+                }
         tracer = obs_trace.Tracer(
             run_dir,
             sample=float(obs_settings["trace_sample"]),
             every_n_steps=int(obs_settings["trace_interval_steps"]),
+            **trace_kw,
         )
         obs_trace.install(tracer)
     # crash flight recorder (obs/flightrec.py): armed whenever the plane is
@@ -731,6 +753,10 @@ def train_validate_test(
         # mode fills it while epoch 0 runs, so early windows may publish
         # no MFU and later ones do (the flush handles None)
         telemetry.attach_flops(plane.train_flops_for)
+        # comm-accounting source (same fill discipline): per-spec
+        # collective bytes + the compute-vs-comm decomposition ride the
+        # step_window records and the fleet heartbeat
+        telemetry.attach_comm(plane.train_comm_for)
         if telemetry.want_mfu:
             # precompile: off never populates flops_by_spec — harvest the
             # first organic executable instead (or warn once naming the

@@ -63,6 +63,16 @@ Serve-plane points (docs/SERVING.md "Failure model"):
   the slow-client model (admission must not be wedged by one caller; other
   threads keep being served).
 
+Fleet-plane points (docs/OBSERVABILITY.md "Fleet"):
+
+- ``HYDRAGNN_FAULT_STRAGGLE`` (``"k:secs"``, ``"k+:secs"``, or bare
+  ``"k"``/``"k+"`` with a 0.05s default): ``maybe_straggle`` sleeps on the
+  HOST side before dispatching the listed training-step indices (``"k+"``
+  arms every step >= k) — the slow-host model the fleet watchdog
+  (obs/fleet.py) must flag as a typed ``fleet_straggler`` event with a
+  coordinated flight dump, exercised by ``run-scripts/fleet_smoke.py``
+  with the env set on exactly one simulated host.
+
 ``flip_bit`` is the host-side corruption tool for the torn/rotted-checkpoint
 tests: flip one bit of a saved file and assert restore falls back to the
 previous verified epoch (the serve chaos smoke also uses it to corrupt a
@@ -101,6 +111,7 @@ def configure(**kwargs: Optional[str]) -> None:
         "serve_req_nan": "HYDRAGNN_FAULT_SERVE_REQ_NAN",
         "serve_wedge": "HYDRAGNN_FAULT_SERVE_WEDGE",
         "serve_slow_client": "HYDRAGNN_FAULT_SERVE_SLOW_CLIENT",
+        "straggle": "HYDRAGNN_FAULT_STRAGGLE",
     }
     for k, v in kwargs.items():
         if k not in keymap:
@@ -206,6 +217,23 @@ def _index_set(spec: Optional[str]) -> set:
     return {int(k) for k in spec.split(",") if k.strip()}
 
 
+def _index_armed(spec: str, index: int) -> bool:
+    """Whether ``index`` matches an index spec: comma-separated values
+    (``"3"``/``"3,7"``, the _index_set grammar) plus the open-range form
+    ``"k+"`` (every index >= k) — ONE grammar for every indexed
+    HYDRAGNN_FAULT_* point."""
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.endswith("+"):
+            if index >= int(part[:-1]):
+                return True
+        elif index == int(part):
+            return True
+    return False
+
+
 def poison_samples(graphs):
     """Dataset-ingest corruption: return ``graphs`` with the first feature of
     every armed index (HYDRAGNN_FAULT_SAMPLE_NAN, ``"3,7"``) replaced by NaN.
@@ -300,7 +328,7 @@ def _indexed_sleep(spec: Optional[str], index: int, default_secs: float) -> None
     if spec is None:
         return
     k, _, secs = spec.partition(":")
-    if index in _index_set(k):
+    if _index_armed(k, index):
         import time
 
         time.sleep(float(secs) if secs else default_secs)
@@ -320,6 +348,15 @@ def maybe_slow_client(request_index: int) -> None:
     1s) — the slow-client model: one dawdling caller must only delay
     itself, never the serve loop or other submitters."""
     _indexed_sleep(_get("HYDRAGNN_FAULT_SERVE_SLOW_CLIENT"), request_index, 1.0)
+
+
+def maybe_straggle(step_index: int) -> None:
+    """Host-side per-step sleep when armed (HYDRAGNN_FAULT_STRAGGLE =
+    ``"k:secs"`` for exactly step k, ``"k+:secs"`` for every step >= k,
+    comma lists like the sibling points; seconds default 0.05) — the
+    slow-host model of a fleet straggler. Called from the epoch loop
+    before each step dispatch; an unarmed call is one dict lookup."""
+    _indexed_sleep(_get("HYDRAGNN_FAULT_STRAGGLE"), step_index, 0.05)
 
 
 def flip_bit(path: str, byte_offset: Optional[int] = None, bit: int = 0) -> int:

@@ -248,8 +248,12 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
 
 def trace_stage_stats(trace_path: str) -> Dict[str, Dict[str, float]]:
     """Per-span-name duration stats from a trace.jsonl: p50/p99 in
-    milliseconds plus the sample count."""
+    milliseconds plus the sample count. The reserved ``_meta`` key carries
+    the trace's topology (distinct span ``host`` identities,
+    obs/fleet.py) so the gate only ever compares percentiles measured on
+    the same host count."""
     durations: Dict[str, List[float]] = {}
+    hosts: set = set()
     with open(trace_path) as fh:
         for line in fh:
             line = line.strip()
@@ -259,6 +263,8 @@ def trace_stage_stats(trace_path: str) -> Dict[str, Dict[str, float]]:
                 rec = json.loads(line)
             except json.JSONDecodeError:
                 continue
+            if "host" in rec:
+                hosts.add(rec["host"])
             try:
                 dur_ms = (
                     int(rec["endTimeUnixNano"]) - int(rec["startTimeUnixNano"])
@@ -274,6 +280,7 @@ def trace_stage_stats(trace_path: str) -> Dict[str, Dict[str, float]]:
             "p99_ms": round(_percentile(vals, 0.99), 4),
             "count": len(vals),
         }
+    out["_meta"] = {"host_count": max(len(hosts), 1)}
     return out
 
 
@@ -284,6 +291,24 @@ def gate_trace(
 ) -> Tuple[List[str], List[str]]:
     failures: List[str] = []
     report: List[str] = []
+    # topology guard: per-stage percentiles only compare within the same
+    # host count — a round run on a different process count shifts every
+    # stage's latency profile (per-host batch shares, collective hops), so
+    # comparing across topologies gates apples against oranges. An old
+    # baseline without _meta predates host identities: host_count 1.
+    stats = dict(stats)
+    baseline = dict(baseline)
+    meta_s = stats.pop("_meta", None) or {"host_count": 1}
+    meta_b = baseline.pop("_meta", None) or {"host_count": 1}
+    if int(meta_s.get("host_count", 1)) != int(meta_b.get("host_count", 1)):
+        report.append(
+            "bench_gate[trace]: topology changed (host_count "
+            f"{meta_s.get('host_count', 1)} vs baseline "
+            f"{meta_b.get('host_count', 1)}) — stage percentiles are not "
+            "comparable across process counts; trace gate skipped "
+            "(re-baseline with --write-trace-baseline on the new topology)"
+        )
+        return failures, report
     for name in sorted(set(stats) & set(baseline)):
         for q in ("p50_ms", "p99_ms"):
             have = float(stats[name][q])

@@ -61,6 +61,9 @@ python run-scripts/telemetry_smoke.py
 echo "== tracing smoke (span parentage train+serve, queue-wait latency contract, flight-recorder dump on injected wedge, <=2% tracing overhead A/B, bench-gate self-check) =="
 python run-scripts/trace_smoke.py
 
+echo "== fleet smoke (2-process simulated fleet: aggregated hydragnn_fleet_* gauges, injected straggler -> typed events + coordinated host-disambiguated dumps on both hosts, stitched trace, per-spec comm table, zero3 sharding inspector, fleet on/off byte-identical + <=2% A/B) =="
+python run-scripts/fleet_smoke.py
+
 echo "== BENCH_MIX cells (mixture stream + balanced-train goodput, per-source graphs/sec, loss drift) =="
 BENCH_MIX=1 BENCH_MIX_EPOCHS=2 BENCH_MIX_CONFIGS=120 python bench.py
 

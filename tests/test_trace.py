@@ -244,9 +244,13 @@ def pytest_flight_recorder_dump_contents(tmp_path):
         err = RuntimeError("boom")
         out = rec.dump("unit_reason", exc=err)
         assert out is not None and os.path.isdir(out)
-        assert os.path.basename(out).endswith("unit_reason")
+        # host-disambiguated directory name: <stamp>-<idx>-<reason>-h<rank>
+        # so coordinated multi-host dumps onto a shared filesystem cannot
+        # collide (obs/fleet.py host_identity; single-process rank is 0)
+        assert os.path.basename(out).endswith("unit_reason-h0")
         meta = json.load(open(os.path.join(out, "meta.json")))
         assert meta["reason"] == "unit_reason"
+        assert meta["host"] == 0
         assert meta["exception"]["type"] == "RuntimeError"
         evs = json.load(open(os.path.join(out, "events.json")))
         assert any(
@@ -446,14 +450,25 @@ def pytest_bench_gate_trace_stage_timings(tmp_path):
     # against its own baseline: pass
     assert bg.main(["--repo", d, "--trace", trace,
                     "--trace-baseline", base]) == 0
+    # the stats carry the trace's topology for the host-count guard
+    assert stats["_meta"]["host_count"] == 1
     # against a 10x-tighter baseline: fail
     shrunk = {
-        k: {**v, "p50_ms": v["p50_ms"] / 10, "p99_ms": v["p99_ms"] / 10}
+        k: (
+            v if k == "_meta"
+            else {**v, "p50_ms": v["p50_ms"] / 10, "p99_ms": v["p99_ms"] / 10}
+        )
         for k, v in json.load(open(base)).items()
     }
     json.dump(shrunk, open(base, "w"))
     assert bg.main(["--repo", d, "--trace", trace,
                     "--trace-baseline", base]) == 1
+    # topology guard: the SAME too-tight baseline stamped with a different
+    # host count must SKIP (with the explicit note) instead of failing —
+    # percentiles from different process counts are not comparable
+    json.dump({**shrunk, "_meta": {"host_count": 2}}, open(base, "w"))
+    assert bg.main(["--repo", d, "--trace", trace,
+                    "--trace-baseline", base]) == 0
 
 
 # ---------------------------------------------------------------------------
