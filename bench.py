@@ -535,9 +535,9 @@ def _bench_production(mixed_precision=None, sorted_aggregation=None,
     # logs/bench_profile (drives the MFU work — find the top non-matmul op)
     if profile:
         os.makedirs("logs/bench_profile", exist_ok=True)
-        # perfetto trace alongside the xplane pb: parseable with stdlib
-        # (run-scripts/analyze_trace.py summarizes top device ops + the
-        # matmul vs non-matmul split for the MFU push)
+        # perfetto trace alongside the xplane pb — loadable in Perfetto
+        # UI for the device-op rollup; stage-level decomposition comes
+        # from `python -m hydragnn_tpu.obs.doctor trace` over trace.jsonl
         with jax.profiler.trace(
             "logs/bench_profile", create_perfetto_trace=True
         ):

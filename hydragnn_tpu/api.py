@@ -1145,6 +1145,14 @@ def _(config: dict, datasets=None, install_sigterm: bool = False):
         from .obs.flightrec import FlightRecorder
 
         flight = FlightRecorder(run_dir, tracer=tracer).install()
+    if obs_settings["trace"] or obs_settings["enabled"]:
+        # persistent incident stream (obs/events.py): shed/queue-full/
+        # wedge/reload events land in logs/<run>/events.jsonl so the run
+        # doctor (obs/doctor.py) can diagnose a serving deployment
+        # post-hoc; last attach wins, matching the tracer install contract
+        from .obs.events import attach_stream as _attach_events
+
+        _attach_events(run_dir)
     server = GraphServer(
         model,
         state,

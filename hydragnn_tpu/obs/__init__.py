@@ -7,7 +7,14 @@ plane — request/step spans (obs/trace.py), the structured event log
 fleet layer: cross-host aggregation + straggler/desync watchdog
 (obs/fleet.py) and the sharding-layout inspector (obs/sharding.py)."""
 
-from .events import EventLog, events
+from .events import (
+    DEFAULT_SEVERITY,
+    EventLog,
+    attach_stream,
+    detach_stream,
+    events,
+    severity_rank,
+)
 from .events import emit as emit_event
 from .fleet import (
     FleetCollector,
@@ -38,10 +45,16 @@ from .telemetry import (
     publish_build_info,
     resolve_telemetry,
 )
+from .schema import (
+    validate_event_record,
+    validate_metrics_record,
+    validate_span_record,
+)
 from .trace import Span, Tracer
 
 __all__ = [
     "Counter",
+    "DEFAULT_SEVERITY",
     "EventLog",
     "FleetCollector",
     "FleetPlane",
@@ -58,8 +71,14 @@ __all__ = [
     "StepTelemetry",
     "TelemetryHTTPServer",
     "Tracer",
+    "attach_stream",
+    "detach_stream",
     "emit_event",
     "events",
+    "severity_rank",
+    "validate_event_record",
+    "validate_metrics_record",
+    "validate_span_record",
     "host_identity",
     "host_memory_bytes",
     "merge_traces",

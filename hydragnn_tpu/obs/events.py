@@ -20,8 +20,11 @@ become an incident *source*.
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
+import warnings
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -67,6 +70,49 @@ EVENT_KINDS = (
 
 SEVERITIES = ("info", "warn", "error", "fatal")
 
+# per-kind default severities: emitters that do not rank their own
+# incident inherit the kind's rank here, so consumers (the run doctor's
+# rules, the flight recorder's incident census) can order incidents by
+# severity instead of re-deriving rank from kind-name heuristics. An
+# emitter passing an explicit severity still wins (a retrace violation
+# under policy=error emits "error", not the table's "warn").
+DEFAULT_SEVERITY: Dict[str, str] = {
+    EV_GUARD_SKIP: "warn",
+    EV_GUARD_ROLLBACK: "error",
+    EV_GUARD_FATAL: "fatal",
+    EV_DATA_SKIP: "warn",
+    EV_RETRACE_VIOLATION: "warn",
+    EV_CACHE_MISS: "info",
+    EV_LOADER_STALL: "error",
+    EV_CKPT_WRITE: "info",
+    EV_SHED: "warn",
+    EV_QUEUE_FULL: "warn",
+    EV_DEADLINE: "warn",
+    EV_WEDGE: "error",
+    EV_DRAIN: "info",
+    EV_RELOAD_SWAP: "info",
+    EV_RELOAD_REJECT: "warn",
+    EV_FLIGHT_DUMP: "info",
+    EV_MIX_SOURCE_ADD: "info",
+    EV_MIX_SOURCE_REMOVE: "info",
+    EV_MIX_DEMOTE: "warn",
+    EV_MIX_DRIFT: "warn",
+    EV_NUMERICS_PROVENANCE: "warn",
+    EV_FLEET_STRAGGLER: "warn",
+    EV_FLEET_DESYNC: "error",
+    EV_FLEET_HOST_STALE: "warn",
+    EV_SHARDING_AUDIT: "warn",
+}
+
+
+def severity_rank(severity: str) -> int:
+    """Numeric rank of a severity (info=0 .. fatal=3; unknown ranks as
+    info) — the shared ordering for doctor rules and dump censuses."""
+    try:
+        return SEVERITIES.index(severity)
+    except ValueError:
+        return 0
+
 # default ring capacity: deep enough that a post-mortem sees the whole
 # incident cascade (a wedge under load sheds dozens of requests), small
 # enough that the resident cost is a few hundred dicts
@@ -91,6 +137,12 @@ class EventLog:
         self._lock = threading.RLock()
         self._ring: "deque[Dict[str, Any]]" = deque(maxlen=max(int(capacity), 1))
         self.emitted = 0
+        # persistent JSONL sink (events.jsonl; attach_stream): the on-disk
+        # analog of the ring so a *completed* run's incidents are readable
+        # post-hoc (the run doctor's primary event source) instead of only
+        # surviving inside flight dumps
+        self._sink_fh = None
+        self._sink_path: Optional[str] = None
         self._counter = registry().counter(
             "hydragnn_events_total",
             "Structured incident events emitted, by kind "
@@ -101,17 +153,21 @@ class EventLog:
     def emit(
         self,
         kind: str,
-        severity: str = "info",
+        severity: Optional[str] = None,
         trace_id: Optional[str] = None,
         **attrs: Any,
     ) -> Dict[str, Any]:
-        """Record one incident. ``trace_id`` defaults to the active
-        tracer's current span context, so incidents inside a sampled
-        request/step carry their causal anchor for free."""
+        """Record one incident. ``severity=None`` (the default) resolves
+        through the per-kind ``DEFAULT_SEVERITY`` table so every record is
+        ranked even when the emitter did not rank it; ``trace_id``
+        defaults to the active tracer's current span context, so incidents
+        inside a sampled request/step carry their causal anchor for free."""
         if trace_id is None:
             from . import trace as _trace
 
             trace_id = _trace.current_trace_id()
+        if severity is None:
+            severity = DEFAULT_SEVERITY.get(str(kind), "info")
         rec: Dict[str, Any] = {
             "ts": round(time.time(), 6),
             "kind": str(kind),
@@ -124,11 +180,69 @@ class EventLog:
         with self._lock:
             self._ring.append(rec)
             self.emitted += 1
+            if self._sink_fh is not None:
+                try:
+                    # flushed per record: events are rare incidents (the
+                    # hot paths emit none), and a crash must not truncate
+                    # the very record that explains it
+                    self._sink_fh.write(json.dumps(rec) + "\n")
+                    self._sink_fh.flush()
+                except (OSError, ValueError) as e:
+                    self._sink_fh = None
+                    warnings.warn(
+                        f"events.jsonl stream failed ({e}); incident "
+                        "records are ring-buffered only from here on",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
         try:
             self._counter.inc(kind=rec["kind"])
         except Exception:
             pass  # an invalid label value must not fail the reporter
         return rec
+
+    # -- persistent sink -----------------------------------------------------
+
+    def attach_jsonl(self, path: str) -> Optional[str]:
+        """Append-mode JSONL sink for every subsequent emit (last attach
+        wins — one live run per process, matching the tracer's install
+        contract). Returns the path, or None when it could not open (the
+        ring keeps working either way)."""
+        with self._lock:
+            if self._sink_fh is not None:
+                try:
+                    self._sink_fh.close()
+                except OSError:
+                    pass
+                self._sink_fh = None
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                self._sink_fh = open(path, "a")
+                self._sink_path = path
+            except OSError as e:
+                self._sink_path = None
+                warnings.warn(
+                    f"events.jsonl sink could not open ({e}); incidents "
+                    "stay ring-buffered only",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return None
+        return path
+
+    def detach_jsonl(self) -> None:
+        with self._lock:
+            if self._sink_fh is not None:
+                try:
+                    self._sink_fh.close()
+                except OSError:
+                    pass
+            self._sink_fh = None
+            self._sink_path = None
+
+    @property
+    def sink_path(self) -> Optional[str]:
+        return self._sink_path
 
     def snapshot(self) -> List[Dict[str, Any]]:
         """The last N events, oldest first (what the flight recorder dumps)."""
@@ -149,8 +263,30 @@ def events() -> EventLog:
     return _EVENTS
 
 
-def emit(kind: str, severity: str = "info",
+def emit(kind: str, severity: Optional[str] = None,
          trace_id: Optional[str] = None, **attrs: Any) -> Dict[str, Any]:
     """Module-level shorthand for ``events().emit(...)`` — the one-line
-    call subsystems use at their incident sites."""
+    call subsystems use at their incident sites. ``severity=None``
+    inherits the kind's ``DEFAULT_SEVERITY`` rank."""
     return _EVENTS.emit(kind, severity=severity, trace_id=trace_id, **attrs)
+
+
+def attach_stream(run_dir: str) -> Optional[str]:
+    """Arm the persistent ``events.jsonl`` sink for ``run_dir`` (host-
+    suffixed on non-zero fleet hosts, like ``metrics.jsonl`` — two
+    processes appending one JSONL on a shared filesystem interleave
+    mid-line). train/loop.py and api.run_server call this when the
+    observability plane is on; the run doctor reads it back."""
+    try:
+        from .fleet import host_identity
+
+        host_i, _ = host_identity()
+    except Exception:
+        host_i = 0
+    fname = "events.jsonl" if host_i == 0 else f"events-h{host_i}.jsonl"
+    return _EVENTS.attach_jsonl(os.path.join(run_dir, fname))
+
+
+def detach_stream() -> None:
+    """Close the persistent sink (run teardown)."""
+    _EVENTS.detach_jsonl()

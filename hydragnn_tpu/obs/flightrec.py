@@ -122,10 +122,25 @@ class FlightRecorder:
                         )
                     ),
                 }
+            # incident census by severity (the per-kind ranks of
+            # obs/events.py DEFAULT_SEVERITY): a post-mortem — and the run
+            # doctor's dump ingestion — ranks the window without kind-name
+            # heuristics, and the worst rank is grep-able from meta alone
+            event_window = _event_log().snapshot()
+            census: Dict[str, int] = {}
+            for ev in event_window:
+                sev = str(ev.get("severity", "info"))
+                census[sev] = census.get(sev, 0) + 1
+            meta["events_by_severity"] = census
+            from .events import SEVERITIES as _SEVS
+
+            meta["worst_severity"] = next(
+                (s for s in reversed(_SEVS) if census.get(s)), "info"
+            )
             with open(os.path.join(tmp, "meta.json"), "w") as fh:
                 json.dump(meta, fh, indent=2)
             with open(os.path.join(tmp, "events.json"), "w") as fh:
-                json.dump(_event_log().snapshot(), fh, indent=2)
+                json.dump(event_window, fh, indent=2)
             with open(os.path.join(tmp, "spans.json"), "w") as fh:
                 json.dump(self._spans(), fh, indent=2)
             with open(os.path.join(tmp, "metrics.prom"), "w") as fh:
@@ -142,6 +157,11 @@ class FlightRecorder:
                             "hbm_by_spec": _memory.snapshot(),
                             "device_memory_peak_bytes":
                                 _memory.device_memory_stats(),
+                            # capacity denominator: lets the doctor's
+                            # HBM-pressure rule reach its verdict from
+                            # the dump alone (the OOM-forensics case)
+                            "device_bytes_limit":
+                                _memory.device_bytes_limit(),
                         },
                         fh,
                         indent=2,

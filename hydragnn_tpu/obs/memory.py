@@ -119,3 +119,20 @@ def device_memory_stats() -> Dict[str, Any]:
         return {str(k): float(v) for k, v in peak_memory_stats().items()}
     except Exception:
         return {}
+
+
+def device_bytes_limit() -> Optional[float]:
+    """Per-device memory capacity in bytes, best-effort (``bytes_limit``
+    of the first local device's memory_stats; None where the backend
+    exposes none — the CPU case). The denominator the run doctor's
+    HBM-pressure rule divides peak bytes by; rides the compile-plane
+    report AND every flight dump's memory.json so the crash-forensics
+    path can reach the same verdict."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        limit = (stats or {}).get("bytes_limit")
+        return float(limit) if limit else None
+    except Exception:
+        return None

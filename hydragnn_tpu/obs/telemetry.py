@@ -52,7 +52,9 @@ import numpy as np
 
 from .registry import registry
 
-SCHEMA_VERSION = 1
+# record shapes + version live in obs/schema.py (one source of truth the
+# producers stamp and the consumers — doctor, smokes — validate against)
+from .schema import METRICS_SCHEMA_VERSION as SCHEMA_VERSION
 
 # memory gauges are the one flush component with a real price (device
 # memory_stats + /proc reads, ~300us) — refresh at most this often rather
@@ -1152,6 +1154,32 @@ class StepTelemetry:
     def run_record(self, info: Dict[str, Any]) -> None:
         if self.stream is not None:
             self.stream.write("run", dict(info))
+
+    def compile_record(self, rep: Dict[str, Any]) -> None:
+        """Persist the compile plane's end-of-run report as a
+        ``compile_report`` record (obs/schema.py) — the run doctor's
+        source for HBM/comm/cache/retrace verdicts; until r14 this
+        report was a stderr line only."""
+        if self.stream is None:
+            return
+        body = {
+            "mode": str(rep.get("mode", "off")),
+            "precompiled": int(rep.get("precompiled") or 0),
+            "specializations": int(rep.get("specializations") or 0),
+            "cache_hits": int(rep.get("cache_hits") or 0),
+            "cache_misses": int(rep.get("cache_misses") or 0),
+            "violations": int(rep.get("violations") or 0),
+            "time_to_first_step": rep.get("time_to_first_step"),
+            "hbm_by_spec": dict(rep.get("hbm_by_spec") or {}),
+            "hbm_peak_bytes": rep.get("hbm_peak_bytes"),
+            "comm_by_spec": dict(rep.get("comm_by_spec") or {}),
+            "comm_bytes_peak": rep.get("comm_bytes_peak"),
+            "device_bytes_limit": rep.get("device_bytes_limit"),
+            # JSON-safe extras (warmup errors may be exception objects)
+            "warmup_errors": [str(e) for e in rep.get("warmup_errors") or []],
+            "remat_policy": rep.get("remat_policy"),
+        }
+        self.stream.write("compile_report", body)
 
     @property
     def endpoint_port(self) -> Optional[int]:

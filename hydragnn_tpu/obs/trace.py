@@ -49,8 +49,9 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from .registry import registry
 
-# span-record schema version (the trace.jsonl analog of metrics.jsonl "v")
-TRACE_SCHEMA_VERSION = 1
+# span-record schema version (the trace.jsonl analog of metrics.jsonl "v";
+# the shape itself is pinned in obs/schema.py SPAN_FIELDS)
+from .schema import TRACE_SCHEMA_VERSION
 
 # OTLP status codes (proto enum values)
 STATUS_UNSET = 0

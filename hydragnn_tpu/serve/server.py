@@ -83,9 +83,12 @@ _TICK_S = 0.02
 _JOIN_TIMEOUT_S = 5.0
 
 
-def _emit_serve_event(kind, severity: str = "warn", trace_id=None, **attrs):
+def _emit_serve_event(kind, severity=None, trace_id=None, **attrs):
     """Typed incident record (obs/events.py), exception-proof: an event
-    emission must never fail the request path it describes."""
+    emission must never fail the request path it describes. ``severity``
+    defaults through the per-kind DEFAULT_SEVERITY table (shed/queue-full
+    rank warn, wedge error, drain info) so doctor rules and the flight
+    recorder's census rank serve incidents without kind-name heuristics."""
     try:
         _emit_event(kind, severity=severity, trace_id=trace_id, **attrs)
     except Exception:

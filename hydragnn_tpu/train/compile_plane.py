@@ -1105,7 +1105,23 @@ class CompilePlane:
                 if self.comm_by_spec
                 else None
             ),
+            # accelerator memory capacity (None on backends that expose
+            # no memory_stats, e.g. CPU): the denominator the run
+            # doctor's HBM-pressure rule divides hbm_peak_bytes by
+            "device_bytes_limit": device_bytes_limit(),
         }
+
+
+def device_bytes_limit() -> Optional[float]:
+    """Per-device memory capacity (obs/memory.py owns the helper — it
+    also rides every flight dump's memory.json); kept as a best-effort
+    delegate so the report never fails on an obs import problem."""
+    try:
+        from ..obs.memory import device_bytes_limit as _limit
+
+        return _limit()
+    except Exception:
+        return None
 
 
 def format_report(rep: Dict[str, Any]) -> str:

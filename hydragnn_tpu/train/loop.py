@@ -724,6 +724,21 @@ def train_validate_test(
         from ..obs.flightrec import FlightRecorder
 
         flight = FlightRecorder(run_dir, tracer=tracer).install()
+    # persistent incident stream (obs/events.py): whenever the plane is
+    # on, every typed event also lands in logs/<run>/events.jsonl so a
+    # COMPLETED run's incidents are readable post-hoc — the run doctor's
+    # (obs/doctor.py) primary event source; the in-memory ring alone only
+    # survives inside flight dumps
+    events_armed = False
+    if (
+        obs_settings["enabled"] or obs_settings["trace"]
+        or obs_settings["numerics"]
+    ):
+        # submodule import: the package __init__ re-exports the events()
+        # accessor under the submodule's name (the flightrec.py lesson)
+        from ..obs.events import attach_stream as _attach_events
+
+        events_armed = _attach_events(run_dir) is not None
 
     # compile plane (train/compile_plane.py): AOT warm-up of every
     # (train, eval) x pad-bucket specialization against the persistent
@@ -1086,6 +1101,12 @@ def train_validate_test(
                     retrace_violations=rep["violations"],
                     compile_metrics=compile_metrics(),
                 )
+                # verdict hook (obs/doctor.py): the FULL compile-plane
+                # report — HBM/comm tables, cache tallies, retrace
+                # violations, device capacity — lands in metrics.jsonl as
+                # a typed compile_report record, so the doctor's rules
+                # read it instead of scraping the stderr line
+                telemetry.compile_record(rep)
                 telemetry.run_record(
                     {
                         "log_name": log_name,
@@ -1136,6 +1157,52 @@ def train_validate_test(
                 tracer.close()
             except Exception:  # noqa: BLE001 — same contract
                 pass
+        if events_armed:
+            from ..obs.events import detach_stream as _detach_events
+
+            try:
+                _detach_events()
+            except Exception:  # noqa: BLE001 — same contract
+                pass
+        # run-verdict hook: HYDRAGNN_DOCTOR=1 runs the diagnosis engine
+        # over the run dir the moment the streams are closed, writing
+        # logs/<run>/doctor.json and one grep-able verdict line — the
+        # post-run analog of `python -m hydragnn_tpu.obs.doctor <run>`
+        from ..obs.telemetry import env_flag as _env_flag
+
+        if _env_flag("HYDRAGNN_DOCTOR"):
+            try:
+                import json as _json
+
+                from ..obs import doctor as _doctor
+
+                streams = _doctor.RunStreams.from_run_dir(run_dir)
+                findings, d_report = _doctor.diagnose(streams)
+                with open(os.path.join(run_dir, "doctor.json"), "w") as fh:
+                    _json.dump(
+                        {
+                            "v": _doctor.DOCTOR_SCHEMA_VERSION,
+                            "mode": "diagnose",
+                            "target": run_dir,
+                            "findings": [f.to_dict() for f in findings],
+                            "report": d_report,
+                        },
+                        fh, indent=2, default=str,
+                    )
+                print(
+                    f"[{log_name}] run doctor: {len(findings)} finding(s)"
+                    + (
+                        ": " + ",".join(f.kind for f in findings)
+                        if findings else ""
+                    ),
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 — diagnosis must never
+                print(                # take the diagnosed run down
+                    f"[{log_name}] run doctor failed: "
+                    f"{type(e).__name__}: {e}",
+                    file=sys.stderr,
+                )
     if best_state is not None:
         state = best_state
     return state, hist

@@ -1,118 +1,36 @@
 #!/usr/bin/env python
-"""Summarize a jax.profiler perfetto trace: top device ops + MXU share.
+"""DEPRECATED shim — use ``python -m hydragnn_tpu.obs.doctor`` instead.
 
-Usage: python run-scripts/analyze_trace.py [trace_dir_or_file]
+This script predates the PR 8 tracing plane: it parsed raw
+``jax.profiler`` perfetto dumps. The span-decomposition report now lives
+in the run doctor (per-stage count/p50/p99/total over ``trace.jsonl``):
 
-Default search root is logs/bench_profile (written by BENCH_PROFILE=1 —
-bench.py captures 8 steady-state steps with create_perfetto_trace=True).
-Prints the top ops by device self-time, the matmul vs non-matmul split,
-and per-category totals — the working input for the MFU push (VERDICT r2
-next-steps #3: "attack the top non-matmul cost with a profile in hand").
+    python -m hydragnn_tpu.obs.doctor trace logs/<run>/trace.jsonl
+    python -m hydragnn_tpu.obs.doctor <run_dir>      # full diagnosis
 
-Pure stdlib: the perfetto JSON is a Chrome trace — complete events
-("ph":"X") with microsecond durations on named tracks; device tracks are
-the process/thread names containing "TPU"/"device" (field layout per the
-Chrome Trace Event format).
+For raw device-op rollups of a perfetto capture, load the trace in
+Perfetto UI (ui.perfetto.dev) — the xprof capture directories written by
+``BENCH_PROFILE=1`` / the on-demand trigger open there directly.
 """
 
-import gzip
-import json
 import os
-import re
 import sys
-from collections import defaultdict
-
-
-def find_trace(root: str) -> str:
-    if os.path.isfile(root):
-        return root
-    hits = []
-    for dirpath, _, files in os.walk(root):
-        for f in files:
-            if f.endswith((".perfetto-trace", "perfetto_trace.json.gz",
-                           ".trace.json.gz")):
-                hits.append(os.path.join(dirpath, f))
-    if not hits:
-        raise SystemExit(f"no perfetto/chrome trace under {root!r} — run "
-                         "BENCH_PROFILE=1 python bench.py first")
-    return max(hits, key=os.path.getmtime)
-
-
-def load_events(path: str):
-    opener = gzip.open if path.endswith(".gz") else open
-    with opener(path, "rt") as f:
-        data = json.load(f)
-    return data["traceEvents"] if isinstance(data, dict) else data
-
-
-# op-name buckets for the category rollup
-_CATEGORIES = (
-    ("matmul", re.compile(r"dot|conv|matmul|gemm", re.I)),
-    ("fusion", re.compile(r"^(loop_)?fusion", re.I)),
-    ("scatter/segment", re.compile(r"scatter|segment", re.I)),
-    ("gather", re.compile(r"gather|dynamic-slice", re.I)),
-    ("pallas", re.compile(r"pallas|custom-call", re.I)),
-    ("copy/transpose", re.compile(r"copy|transpose|bitcast|reshape", re.I)),
-    ("allreduce/collective", re.compile(r"all-reduce|all-gather|collective|"
-                                        r"reduce-scatter|permute", re.I)),
-    ("infeed/outfeed", re.compile(r"infeed|outfeed|transfer", re.I)),
-)
-
-
-def categorize(name: str) -> str:
-    for cat, pat in _CATEGORIES:
-        if pat.search(name):
-            return cat
-    return "other"
-
-
-def main():
-    root = sys.argv[1] if len(sys.argv) > 1 else "logs/bench_profile"
-    path = find_trace(root)
-    events = load_events(path)
-
-    # map (pid, tid) -> track name; device tracks mention TPU / device / XLA
-    names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") in ("process_name",
-                                                    "thread_name"):
-            key = (e.get("pid"), e.get("tid"), e["name"])
-            names[key] = e.get("args", {}).get("name", "")
-    def track(pid, tid):
-        proc = names.get((pid, 0, "process_name")) or names.get(
-            (pid, None, "process_name"), "")
-        thr = names.get((pid, tid, "thread_name"), "")
-        return f"{proc}/{thr}"
-
-    device_pat = re.compile(r"tpu|device|/device|xla", re.I)
-    per_op = defaultdict(float)
-    total = 0.0
-    for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        if not device_pat.search(track(e.get("pid"), e.get("tid"))):
-            continue
-        per_op[e["name"]] += float(e["dur"])
-        total += float(e["dur"])
-    if not per_op:
-        raise SystemExit(f"no device complete-events found in {path!r}")
-
-    per_cat = defaultdict(float)
-    for name, dur in per_op.items():
-        per_cat[categorize(name)] += dur
-
-    print(f"trace: {path}")
-    print(f"total device op time: {total/1e3:.2f} ms\n")
-    print("category rollup:")
-    for cat, dur in sorted(per_cat.items(), key=lambda kv: -kv[1]):
-        print(f"  {cat:<22} {dur/1e3:10.2f} ms  {100*dur/total:5.1f}%")
-    mxu = per_cat.get("matmul", 0.0)
-    print(f"\nMXU (matmul-like) share: {100*mxu/total:.1f}% — everything "
-          "else is the optimization surface\n")
-    print("top 20 ops by device self-time:")
-    for name, dur in sorted(per_op.items(), key=lambda kv: -kv[1])[:20]:
-        print(f"  {100*dur/total:5.1f}%  {dur/1e3:9.2f} ms  {name[:90]}")
-
 
 if __name__ == "__main__":
-    main()
+    # run-scripts/ is sys.path[0] when invoked directly; the package
+    # lives one level up
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    print(
+        "analyze_trace.py is deprecated: use "
+        "`python -m hydragnn_tpu.obs.doctor trace <trace.jsonl>` for the "
+        "span-decomposition report, or `python -m hydragnn_tpu.obs.doctor "
+        "<run_dir>` for the full diagnosis (docs/OBSERVABILITY.md "
+        "'Run doctor').",
+        file=sys.stderr,
+    )
+    if len(sys.argv) > 1 and sys.argv[1].endswith(".jsonl"):
+        # forward the one still-meaningful invocation shape
+        from hydragnn_tpu.obs.doctor import main
+
+        raise SystemExit(main(["trace", sys.argv[1]]))
+    raise SystemExit(2)

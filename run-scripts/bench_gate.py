@@ -37,6 +37,13 @@ Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 (fewer than two valid rounds / empty cell intersection), so a wiring bug
 cannot masquerade as a pass.
 
+Beside the exit code the gate writes a machine-readable
+``gate_verdict.json`` (``--verdict-out``, default ``logs/`` under
+``--repo``): per-cell pass/fail/skip with value, baseline, baseline round
+and relative delta — the record the run doctor's ``diff`` mode
+(``python -m hydragnn_tpu.obs.doctor diff``) ingests and cross-checks,
+and the promotion-gate primitive serving/HPO orchestration consumes.
+
 Wired into ``run-scripts/ci.sh`` against the committed rounds; exercised
 (pass AND synthetic-degradation fail) by ``run-scripts/trace_smoke.py``
 and ``tests/test_trace.py``.
@@ -52,6 +59,14 @@ import os
 import re
 import sys
 from typing import Any, Dict, List, Optional, Tuple
+
+# the shared trace-consumer helpers (obs/schema.py is the one source of
+# truth: the doctor's span decomposition and this gate's stage stats must
+# compute the same duration and the same percentile); run-scripts/ is
+# sys.path[0] when invoked directly, the package lives one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from hydragnn_tpu.obs.schema import percentile as _percentile  # noqa: E402
+from hydragnn_tpu.obs.schema import span_duration_ms  # noqa: E402
 
 _ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
 
@@ -107,9 +122,11 @@ def cells_of(parsed: Dict[str, Any]) -> Dict[str, float]:
 def gate_bench(
     rounds: List[Tuple[int, str, Dict[str, Any]]],
     threshold: float,
+    verdict: Optional[List[Dict[str, Any]]] = None,
 ) -> Tuple[List[str], List[str]]:
     """(failures, report lines). The newest round's cells vs the most
-    recent prior occurrence of each cell."""
+    recent prior occurrence of each cell. ``verdict`` (when given)
+    collects one machine-readable entry per cell for gate_verdict.json."""
     report: List[str] = []
     if len(rounds) < 2:
         report.append(
@@ -136,6 +153,13 @@ def gate_bench(
                 "prior-round counterpart — skipped (new cell, gated from "
                 "the next round)"
             )
+            if verdict is not None:
+                verdict.append({
+                    "section": "bench", "cell": key, "status": "skip",
+                    "value": val, "round": cand_n,
+                    "baseline": None, "baseline_round": None,
+                    "delta_frac": None,
+                })
             continue
         base_n, base_val = base
         compared += 1
@@ -144,7 +168,18 @@ def gate_bench(
             f"bench_gate: r{cand_n:02d} {key!r} = {val:g} vs "
             f"r{base_n:02d} {base_val:g} ({-drop:+.1%})"
         )
-        if drop > threshold:
+        bad = drop > threshold
+        if verdict is not None:
+            verdict.append({
+                "section": "bench", "cell": key,
+                "status": "fail" if bad else "pass",
+                "value": val, "round": cand_n,
+                "baseline": base_val, "baseline_round": base_n,
+                # signed relative change, positive = improved (the same
+                # (b-a)/a convention as doctor diff's delta_frac)
+                "delta_frac": round(-drop, 6),
+            })
+        if bad:
             failures.append(
                 line + f" — REGRESSION beyond the {threshold:.0%} threshold"
             )
@@ -191,7 +226,8 @@ def load_mix_records(path: str) -> List[Dict[str, float]]:
 
 
 def gate_mix(
-    records: List[Dict[str, float]], threshold: float
+    records: List[Dict[str, float]], threshold: float,
+    verdict: Optional[List[Dict[str, Any]]] = None,
 ) -> Tuple[List[str], List[str]]:
     """Newest mixture record vs the previous one: throughput keys must not
     drop, drift keys must not grow, beyond ``threshold``."""
@@ -220,6 +256,14 @@ def gate_mix(
                 f"bench_gate[mix]: {key!r} = {have:g} vs {want:g} ({-drop:+.1%})"
             )
             bad = drop > threshold
+        if verdict is not None:
+            verdict.append({
+                "section": "mix", "cell": key,
+                "status": "fail" if bad else "pass",
+                "value": have, "baseline": want,
+                "delta_frac": round((have - want) / want, 6),
+                "lower_is_better": bool(MIX_LOWER_RE.search(key)),
+            })
         if bad:
             failures.append(
                 line + f" — REGRESSION beyond the {threshold:.0%} threshold"
@@ -237,13 +281,6 @@ def gate_mix(
 # ---------------------------------------------------------------------------
 # trace-derived stage timings
 # ---------------------------------------------------------------------------
-
-
-def _percentile(sorted_vals: List[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
-    return sorted_vals[idx]
 
 
 def trace_stage_stats(trace_path: str) -> Dict[str, Dict[str, float]]:
@@ -265,11 +302,8 @@ def trace_stage_stats(trace_path: str) -> Dict[str, Dict[str, float]]:
                 continue
             if "host" in rec:
                 hosts.add(rec["host"])
-            try:
-                dur_ms = (
-                    int(rec["endTimeUnixNano"]) - int(rec["startTimeUnixNano"])
-                ) / 1e6
-            except (KeyError, ValueError):
+            dur_ms = span_duration_ms(rec)
+            if dur_ms is None:
                 continue
             durations.setdefault(str(rec.get("name", "?")), []).append(dur_ms)
     out: Dict[str, Dict[str, float]] = {}
@@ -288,6 +322,7 @@ def gate_trace(
     stats: Dict[str, Dict[str, float]],
     baseline: Dict[str, Dict[str, float]],
     threshold: float,
+    verdict: Optional[List[Dict[str, Any]]] = None,
 ) -> Tuple[List[str], List[str]]:
     failures: List[str] = []
     report: List[str] = []
@@ -320,7 +355,16 @@ def gate_trace(
                 f"bench_gate[trace]: {name} {q} = {have:.3f}ms vs baseline "
                 f"{want:.3f}ms ({ratio - 1:+.1%})"
             )
-            if ratio > 1.0 + threshold:
+            bad = ratio > 1.0 + threshold
+            if verdict is not None:
+                verdict.append({
+                    "section": "trace", "cell": f"{name} :: {q}",
+                    "status": "fail" if bad else "pass",
+                    "value": have, "baseline": want,
+                    "delta_frac": round(ratio - 1.0, 6),
+                    "lower_is_better": True,
+                })
+            if bad:
                 failures.append(
                     line
                     + f" — REGRESSION beyond the {threshold:.0%} threshold"
@@ -361,13 +405,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="max tolerated relative p50/p99 growth per stage")
     ap.add_argument("--write-trace-baseline", default=None, metavar="PATH",
                     help="derive a stage baseline from --trace and write it")
+    ap.add_argument("--verdict-out", default=None, metavar="PATH",
+                    help="machine-readable per-cell verdict JSON (default: "
+                         "logs/gate_verdict.json under --repo; 'off' "
+                         "disables)")
     args = ap.parse_args(argv)
 
     failures: List[str] = []
     compared_something = False
+    verdict_cells: List[Dict[str, Any]] = []
 
     rounds = load_rounds(args.repo)
-    bench_failures, report = gate_bench(rounds, args.threshold)
+    bench_failures, report = gate_bench(
+        rounds, args.threshold, verdict=verdict_cells
+    )
     failures.extend(bench_failures)
     compared_something |= any(" ok" in l or "REGRESSION" in l for l in report)
     compared_something |= bool(bench_failures)
@@ -382,6 +433,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.mix_threshold
                 if args.mix_threshold is not None
                 else args.threshold,
+                verdict=verdict_cells,
             )
             failures.extend(m_failures)
             compared_something |= any(" ok" in l for l in m_report) or bool(
@@ -415,7 +467,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"bench_gate: cannot read trace baseline: {e}")
                 return 2
             t_failures, t_report = gate_trace(
-                stats, trace_base, args.trace_threshold
+                stats, trace_base, args.trace_threshold,
+                verdict=verdict_cells,
             )
             failures.extend(t_failures)
             compared_something |= any(" ok" in l for l in t_report) or bool(
@@ -426,14 +479,43 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     for line in failures:
         print(line, file=sys.stderr)
+    rc = 0
     if failures:
         print(f"bench_gate: FAIL ({len(failures)} regression(s))",
               file=sys.stderr)
-        return 1
-    if args.strict and not compared_something:
+        rc = 1
+    elif args.strict and not compared_something:
         print("bench_gate: FAIL (--strict and nothing was comparable)",
               file=sys.stderr)
-        return 1
+        rc = 1
+    # machine-readable verdict beside the exit code (the doctor's diff
+    # mode and the serving/HPO promotion gates ingest this)
+    verdict_path = args.verdict_out
+    if verdict_path is None:
+        verdict_path = os.path.join(args.repo, "logs", "gate_verdict.json")
+    if str(verdict_path).lower() != "off":
+        import time
+
+        try:
+            os.makedirs(os.path.dirname(verdict_path) or ".", exist_ok=True)
+            with open(verdict_path, "w") as fh:
+                json.dump(
+                    {
+                        "v": 1,
+                        "ts": round(time.time(), 3),
+                        "threshold": args.threshold,
+                        "rc": rc,
+                        "failures": failures,
+                        "cells": verdict_cells,
+                    },
+                    fh, indent=2,
+                )
+            print(f"bench_gate: verdict written to {verdict_path}")
+        except OSError as e:
+            print(f"bench_gate: could not write verdict ({e})",
+                  file=sys.stderr)
+    if rc:
+        return rc
     print("bench_gate: OK")
     return 0
 

@@ -64,6 +64,9 @@ python run-scripts/trace_smoke.py
 echo "== fleet smoke (2-process simulated fleet: aggregated hydragnn_fleet_* gauges, injected straggler -> typed events + coordinated host-disambiguated dumps on both hosts, stitched trace, per-spec comm table, zero3 sharding inspector, fleet on/off byte-identical + <=2% A/B) =="
 python run-scripts/fleet_smoke.py
 
+echo "== run-doctor smoke (fault drills: planted NaN/stall/corrupt/wedge/straggler each named exactly, clean run zero findings, dump-only forensics, watch mode, doctor diff consistent with gate_verdict.json) =="
+python run-scripts/doctor_smoke.py
+
 echo "== BENCH_MIX cells (mixture stream + balanced-train goodput, per-source graphs/sec, loss drift) =="
 BENCH_MIX=1 BENCH_MIX_EPOCHS=2 BENCH_MIX_CONFIGS=120 python bench.py
 
