@@ -1,0 +1,29 @@
+"""graftlint — the repo-native static-analysis plane.
+
+``python -m hydragnn_tpu.analysis`` runs every checker over the repo and
+exits nonzero on any unwaived finding (the ci.sh gate). One checker = one
+module in this package; docs/ANALYSIS.md is the catalog. Pure host-side
+AST/text analysis — importing this package never imports jax.
+"""
+
+from .core import (  # noqa: F401
+    ANALYSIS_SCHEMA_VERSION,
+    Checker,
+    Finding,
+    Repo,
+    apply_baseline,
+    baseline_key,
+    checkers,
+    default_root,
+    run_checkers,
+    summarize,
+    to_json,
+)
+
+
+def analyze(root=None, only=None):
+    """Run the full checker suite over ``root`` (default: the repo this
+    package sits in). Returns the finding list — the API the run doctor's
+    ``static_findings`` record and the fixture tests share with the CLI."""
+    repo = Repo(root or default_root())
+    return run_checkers(repo, only=only)

@@ -1,0 +1,116 @@
+"""CLI: ``python -m hydragnn_tpu.analysis [--json] [--baseline FILE]
+[--write-baseline FILE] [--only id,...] [--env-table] [--list] [--root DIR]``.
+
+Exit codes: 0 = clean (no unwaived, unbaselined findings), 1 = findings,
+2 = usage/environment error — the same contract as config.lint, so CI
+and migration scripts branch the same way on both gates.
+
+The ``--baseline`` flag exists for LOCAL incremental burn-downs only:
+run-scripts/ci.sh invokes the gate baseline-free, so the committed tree
+must stay at zero unwaived findings (docs/ANALYSIS.md "The gate").
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    Repo,
+    apply_baseline,
+    baseline_key,
+    checkers,
+    default_root,
+    run_checkers,
+    summarize,
+    to_json,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hydragnn_tpu.analysis",
+        description="graftlint: repo-native static analysis "
+                    "(docs/ANALYSIS.md has the checker catalog)",
+    )
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings (the CI artifact)")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="suppress findings recorded in FILE "
+                             "(LOCAL incremental use only; CI is baseline-free)")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="record current unwaived findings to FILE and exit 0")
+    parser.add_argument("--only", metavar="IDS",
+                        help="comma-separated checker ids to run")
+    parser.add_argument("--env-table", action="store_true",
+                        help="print the regenerated docs/CONFIG.md env-flag "
+                             "table from the census and exit")
+    parser.add_argument("--list", action="store_true",
+                        help="print the checker catalog and exit")
+    parser.add_argument("--root", metavar="DIR", default=None,
+                        help="repo root to analyze (default: this checkout)")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    root = args.root or default_root()
+    repo = Repo(root)
+
+    if args.list:
+        for c in checkers():
+            print(f"{c.id}: {c.title}")
+            print(f"    rationale: {c.rationale}")
+        return 0
+
+    if args.env_table:
+        from .env_census import render_env_table
+
+        print(render_env_table(repo))
+        return 0
+
+    only = None
+    if args.only:
+        only = {s.strip().replace("-", "_") for s in args.only.split(",") if s.strip()}
+        known = {c.id for c in checkers()}
+        bad = only - known
+        if bad:
+            print(f"unknown checker id(s): {sorted(bad)}; known: {sorted(known)}",
+                  file=sys.stderr)
+            return 2
+
+    findings = run_checkers(repo, only=only)
+
+    if args.write_baseline:
+        active = [f for f in findings if not f.waived]
+        with open(args.write_baseline, "w") as fh:
+            json.dump([baseline_key(f) for f in active], fh, indent=2)
+        print(f"wrote {len(active)} finding keys to {args.write_baseline}")
+        return 0
+
+    if args.baseline:
+        try:
+            with open(args.baseline) as fh:
+                baseline = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"cannot read baseline {args.baseline}: {e}", file=sys.stderr)
+            return 2
+        findings = apply_baseline(findings, baseline)
+
+    summary = summarize(findings)
+    if args.json:
+        print(to_json(findings))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"graftlint: {summary['active']} finding(s), "
+            f"{summary['waived']} waived"
+            + (f" [{args.baseline} applied]" if args.baseline else "")
+        )
+    return 0 if summary["clean"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

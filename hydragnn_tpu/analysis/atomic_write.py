@@ -1,0 +1,148 @@
+"""atomic_write: checkpoint-adjacent state is published atomically
+(tmp + fsync + ``os.replace``), never written in place.
+
+The convention comes from the fault drills: ``HYDRAGNN_FAULT_KILL_AT``
+SIGKILLs the process between a tmp write and its rename, and the resume
+tests assert the reader never sees a torn file (train/checkpoint.py
+``_fsync_replace`` is the one blessed publish primitive; the quarantine
+manifest rotation and the LapPE cache both adopted the same shape after
+review). A plain ``open(path, "w")`` in these modules is a torn-state
+bug waiting for a preemption.
+
+Scope: the modules that own checkpoint / quarantine / mixture-state /
+hot-reload / resume-cursor files. Rule: any ``open(..., "w"/"wb")``
+whose enclosing function does not also call ``os.replace`` (or the
+``_fsync_replace`` helper / a ``*_atomic*`` wrapper) is a finding —
+append-mode streams (manifests, JSONL sinks) are exempt by design, their
+consumers tolerate a truncated tail.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import Checker, Finding, Repo, dotted, register, str_const
+
+CHECKER_ID = "atomic_write"
+
+# modules owning durable, resume-critical state
+SCOPED_SUFFIXES: Tuple[str, ...] = (
+    "train/checkpoint.py",
+    "data/validate.py",     # quarantine manifest
+    "data/lappe.py",        # eigendecomposition cache
+    "mix/plane.py",         # mixture resume state
+    "mix/sampler.py",
+    "serve/reload.py",      # hot-reload pointer handling
+    "utils/preemption.py",  # mid-epoch resume cursor
+)
+
+_ATOMIC_MARKERS = ("replace", "_fsync_replace", "atomic")
+
+
+def _write_mode(call: ast.Call) -> Optional[str]:
+    if dotted(call.func) != "open":
+        return None
+    mode = None
+    if len(call.args) > 1:
+        mode = str_const(call.args[1])
+    for k in call.keywords:
+        if k.arg == "mode":
+            mode = str_const(k.value)
+    if mode and "w" in mode:
+        return mode
+    return None
+
+
+def _is_atomic_call(node: ast.Call) -> bool:
+    tail = dotted(node.func).rsplit(".", 1)[-1]
+    return any(m in tail for m in _ATOMIC_MARKERS)
+
+
+def _fn_calls_atomic(fn: ast.AST) -> bool:
+    return any(
+        _is_atomic_call(n) for n in ast.walk(fn) if isinstance(n, ast.Call)
+    )
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in repo.python_files():
+        norm = rel.replace("\\", "/")
+        if not any(norm.endswith(s) for s in SCOPED_SUFFIXES):
+            continue
+        src = repo.source(rel)
+        if src.tree is None:
+            continue
+        # attribute every write-mode open to its innermost function (or
+        # the module scope for top-level opens), and require the atomic
+        # publish pattern in that same scope
+        fns = [
+            n for n in ast.walk(src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        in_some_fn = {
+            id(c)
+            for f in fns
+            for c in ast.walk(f)
+            if isinstance(c, ast.Call)
+        }
+        for scope in fns + [src.tree]:
+            body_calls = [
+                n for n in ast.walk(scope)
+                if isinstance(n, ast.Call) and _write_mode(n)
+            ]
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # only opens directly in THIS function (not nested fns)
+                nested = {
+                    id(c)
+                    for f in ast.walk(scope)
+                    if isinstance(f, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and f is not scope
+                    for c in ast.walk(f)
+                    if isinstance(c, ast.Call)
+                }
+                body_calls = [c for c in body_calls if id(c) not in nested]
+                where = repr(scope.name)
+            else:
+                # module scope: top-level opens only
+                body_calls = [c for c in body_calls if id(c) not in in_some_fn]
+                where = "module scope"
+            if not body_calls:
+                continue
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                atomic = _fn_calls_atomic(scope)
+            else:
+                # module scope: a replace inside some function does not
+                # excuse a top-level in-place write
+                atomic = any(
+                    id(c) not in in_some_fn
+                    for c in ast.walk(scope)
+                    if isinstance(c, ast.Call) and _is_atomic_call(c)
+                )
+            if atomic:
+                continue
+            for call in body_calls:
+                findings.append(Finding(
+                    CHECKER_ID, rel, call.lineno,
+                    f"open(..., {_write_mode(call)!r}) in {where} writes "
+                    "resume-critical state in place — a kill mid-write "
+                    "leaves a torn file",
+                    hint="publish via tmp + fsync + os.replace "
+                         "(train/checkpoint._fsync_replace is the "
+                         "blessed primitive)",
+                ))
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="checkpoint-adjacent writes are tmp+fsync+os.replace atomic",
+    rationale=(
+        "the HYDRAGNN_FAULT_KILL_AT drills SIGKILL between write and "
+        "rename; every resume guarantee (verified restore, quarantine "
+        "manifest, LapPE cache, mixture fingerprint-exact resume) assumes "
+        "no reader ever sees a torn file"
+    ),
+    run=run,
+))

@@ -1,0 +1,242 @@
+"""config_keys: the JSON config surface cannot drift between its three
+authorities — config/lint.py's key census, docs/CONFIG.md's tables, and
+the top-level sections the code actually reads.
+
+The convention: every key the framework consumes is (a) listed in
+``config/lint.py``'s ``_HANDLED`` set (so migration lint classifies it
+"handled" instead of "unknown — likely a typo"), and (b) documented in
+the matching ``docs/CONFIG.md`` section table. Both are hand-maintained;
+PRs 6–14 each added a config section and at least one of them forgot one
+side (the seed of this checker: a dozen ``_HANDLED`` keys with no docs
+row, and docs rows for keys migration lint calls unknown).
+
+Enforced contracts:
+
+1. every ``_HANDLED`` leaf path whose section has a CONFIG.md table must
+   appear in that table (backtick-quoted in the Key column);
+2. every CONFIG.md table key under a linted section must be ``_HANDLED``
+   (or inside an ``_OPAQUE`` subtree — those members are schema'd
+   elsewhere by design);
+3. every top-level section name the package reads via
+   ``config["X"]`` / ``config.get("X")`` must be in ``_TOPLEVEL_SECTIONS``
+   — a new section that migration lint would flag as unknown on every
+   user config is a bug in lint, not in the user.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Repo, call_name, register, str_const, walk_calls
+
+CHECKER_ID = "config_keys"
+
+LINT_MODULE_SUFFIX = "config/lint.py"
+
+# docs/CONFIG.md section headers that mirror lint sections 1:1
+_DOC_SECTION_FOR = {
+    "Verbosity": "Verbosity",
+    "Dataset": "Dataset",
+    "NeuralNetwork.Architecture": "NeuralNetwork.Architecture",
+    "NeuralNetwork.Variables_of_interest": "NeuralNetwork.Variables_of_interest",
+    "NeuralNetwork.Training": "NeuralNetwork.Training",
+    "NeuralNetwork.Profile": "NeuralNetwork.Profile",
+    "Visualization": "Visualization",
+    "Serving": "Serving",
+    "Telemetry": "Telemetry",
+    "Mixture": "Mixture",
+}
+
+# the variables code reads top-level sections from (heuristic, kept tight:
+# a `cfg["Dataset"]` on some unrelated dict must not fire)
+_CONFIG_VARS = {"config", "cfg", "conf", "config_json"}
+
+_KEY_CELL_RE = re.compile(r"`([A-Za-z_][A-Za-z0-9_.]*)`")
+
+
+def _literal_str_set(node: ast.AST) -> Optional[Set[str]]:
+    """The string members of a set/tuple/dict literal, or None."""
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out = set()
+        for elt in node.elts:
+            s = str_const(elt)
+            if s is not None:
+                out.add(s)
+        return out
+    if isinstance(node, ast.Dict):
+        out = set()
+        for k in node.keys:
+            s = str_const(k) if k is not None else None
+            if s is not None:
+                out.add(s)
+        return out
+    return None
+
+
+def lint_sets(repo: Repo) -> Tuple[Optional[str], Dict[str, Set[str]]]:
+    """(lint.py relpath, {_HANDLED, _OPAQUE, _TOPLEVEL_SECTIONS, _LEGACY,
+    _NOT_APPLICABLE}) parsed statically from config/lint.py."""
+    target = None
+    for rel in repo.python_files():
+        if rel.replace("\\", "/").endswith(LINT_MODULE_SUFFIX):
+            target = rel
+            break
+    sets: Dict[str, Set[str]] = {}
+    if target is None:
+        return None, sets
+    tree = repo.source(target).tree
+    if tree is None:
+        return target, sets
+    wanted = {"_HANDLED", "_OPAQUE", "_TOPLEVEL_SECTIONS", "_LEGACY", "_NOT_APPLICABLE"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id in wanted:
+                vals = _literal_str_set(node.value)
+                if vals is not None:
+                    sets[t.id] = vals
+    return target, sets
+
+
+def doc_section_keys(repo: Repo) -> Dict[str, Dict[str, int]]:
+    """CONFIG.md: section -> {leaf key path fragment -> line}. A table row
+    may document several comma/backtick-separated keys; each backticked
+    identifier in the first cell counts."""
+    text = repo.read_text("docs/CONFIG.md")
+    out: Dict[str, Dict[str, int]] = {}
+    if text is None:
+        return out
+    section = None
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.startswith("## "):
+            title = line[3:].strip()
+            section = title if title in _DOC_SECTION_FOR.values() else None
+            continue
+        if section is None or not line.strip().startswith("|"):
+            continue
+        cells = line.strip().strip("|").split("|")
+        if not cells:
+            continue
+        first = cells[0]
+        if set(first.strip()) <= {"-", " ", ":"}:  # separator row
+            continue
+        if first.strip() in ("Key", "Flag"):
+            continue
+        # the key cell may carry inline qualifiers — "`dropout` (default
+        # `0.25`)" — whose backticked VALUES are not keys; strip every
+        # parenthesized chunk before collecting key tokens
+        bare = re.sub(r"\([^)]*\)", "", first)
+        for key in _KEY_CELL_RE.findall(bare):
+            out.setdefault(section, {})[key] = i
+    return out
+
+
+def _opaque_covers(path: str, opaque: Set[str]) -> bool:
+    return any(path == o or path.startswith(o + ".") for o in opaque)
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    lint_rel, sets = lint_sets(repo)
+    if lint_rel is None or "_HANDLED" not in sets:
+        return findings  # fixture trees without a config lint: nothing to drift
+    handled = sets["_HANDLED"]
+    opaque = sets.get("_OPAQUE", set())
+    toplevel = sets.get("_TOPLEVEL_SECTIONS", set())
+    legacy = sets.get("_LEGACY", set()) | sets.get("_NOT_APPLICABLE", set())
+    docs = doc_section_keys(repo)
+    if docs:
+        # contract 1: every handled leaf is documented in its section table
+        for path in sorted(handled):
+            section, _, leaf = path.rpartition(".")
+            if not section:
+                continue  # bare section entries ("NeuralNetwork.Profile" etc.)
+            if section not in docs:
+                continue  # section has no table (not a linted doc section)
+            if leaf in docs[section]:
+                continue
+            if _opaque_covers(path, opaque):
+                continue
+            if path in {s + "." + k for s in docs for k in docs[s]}:
+                continue
+            findings.append(Finding(
+                CHECKER_ID, lint_rel, 0,
+                f"config key {path!r} is HANDLED by config lint but has no "
+                f"docs/CONFIG.md row under '## {section}'",
+                hint="document the key (or drop it from _HANDLED if it is "
+                     "no longer consumed)",
+            ))
+        # contract 2: every documented key under a linted section is handled
+        for section, keys in sorted(docs.items()):
+            for leaf, line in sorted(keys.items()):
+                path = f"{section}.{leaf}"
+                if (
+                    path in handled
+                    or path in legacy
+                    or leaf in toplevel
+                    or _opaque_covers(path, opaque)
+                    or any(  # key documented as a dotted sub-path of an opaque/handled parent
+                        path.startswith(h + ".") for h in handled
+                    )
+                    or "." in leaf  # dotted doc keys (path.total) resolve below
+                    and (
+                        f"{section}.{leaf.split('.')[0]}" in handled
+                        or _opaque_covers(f"{section}.{leaf.split('.')[0]}", opaque)
+                    )
+                ):
+                    continue
+                findings.append(Finding(
+                    CHECKER_ID, "docs/CONFIG.md", line,
+                    f"documented config key {path!r} is unknown to "
+                    "config/lint.py — migration lint will call a user's "
+                    "use of it a typo",
+                    hint="add it to _HANDLED (if consumed) or fix the docs",
+                ))
+    # contract 3: top-level section reads are declared sections
+    for rel in repo.python_files():
+        if rel.replace("\\", "/").endswith(LINT_MODULE_SUFFIX):
+            continue
+        src = repo.source(rel)
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            key = None
+            line = 0
+            if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+                base = node.value
+                if isinstance(base, ast.Name) and base.id in _CONFIG_VARS:
+                    key, line = str_const(node.slice), node.lineno
+            elif isinstance(node, ast.Call) and call_name(node).split(".")[-1] == "get":
+                base = node.func.value if isinstance(node.func, ast.Attribute) else None
+                if isinstance(base, ast.Name) and base.id in _CONFIG_VARS and node.args:
+                    key, line = str_const(node.args[0]), node.lineno
+            if (
+                key
+                and key[:1].isupper()
+                and toplevel
+                and key not in toplevel
+                and "_" not in key  # section names are CamelCase words
+            ):
+                findings.append(Finding(
+                    CHECKER_ID, rel, line,
+                    f"top-level config section {key!r} is read here but not "
+                    "declared in config/lint.py _TOPLEVEL_SECTIONS",
+                    hint="declare the section in config/lint.py (and "
+                         "document it in docs/CONFIG.md)",
+                ))
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="config-key drift: lint census == docs tables == code reads",
+    rationale=(
+        "config/lint.py and docs/CONFIG.md are both hand-maintained; by "
+        "PR 14 a dozen handled keys had no docs row and several documented "
+        "keys were 'unknown' to migration lint — every new config section "
+        "(Serving, Telemetry, Mixture) drifted at least once"
+    ),
+    run=run,
+))

@@ -1,0 +1,269 @@
+"""env_census: every ``HYDRAGNN_*`` read goes through utils/envflags.py
+and has a docs/CONFIG.md row.
+
+The convention (and its failure history): the ``HYDRAGNN_*`` channel is
+the stack's out-of-band control surface — 150+ mentions across the
+package vs a docs table that drifted to a third of that, and hand-rolled
+``int(os.getenv(...))`` parses that crashed multi-hour runs on a typo'd
+value (the PR 4 ``HYDRAGNN_DDSTORE_RETRIES`` incident). Two enforced
+contracts:
+
+1. **One parse boundary.** A direct ``os.environ`` / ``os.getenv`` read
+   of a ``HYDRAGNN_*`` name anywhere outside ``utils/envflags.py`` is a
+   finding — route it through ``env_flag`` / ``env_force`` / ``env_int``
+   / ``env_float`` / ``env_str`` so the malformed-value fallback and the
+   tri-state grammars cannot drift per module.
+2. **Census == docs.** Every ``HYDRAGNN_*`` name the package mentions
+   must have a ``docs/CONFIG.md`` env-table row, and every table row must
+   name a flag that still exists somewhere in the tree (package, tests,
+   run-scripts, bench, examples, native sources) — stale rows are as
+   misleading as missing ones.
+
+``python -m hydragnn_tpu.analysis --env-table`` regenerates the docs
+table from this census (name, parse helper, default, reading module),
+preserving the hand-written Meaning column of existing rows.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import Checker, Finding, Repo, call_name, register, str_const, walk_calls
+
+# a concrete flag name: prefix + a real suffix. The lookahead rejects
+# family-prefix mentions ("HYDRAGNN_FAULT_", "HYDRAGNN_FAULT_*") that doc
+# prose and remediation strings legitimately use — backtracking would
+# otherwise shorten them into phantom flags
+ENV_NAME_RE = re.compile(r"HYDRAGNN_[A-Z0-9_]*[A-Z0-9](?![A-Z0-9_*])")
+
+ENVFLAGS_MODULE = "utils/envflags.py"
+ENV_HELPERS = ("env_flag", "env_force", "env_int", "env_float", "env_str", "env_set")
+
+# CONFIG.md env table row: "| `HYDRAGNN_X` | parse | default | owner | meaning |"
+_DOC_ROW_RE = re.compile(r"^\|\s*`(HYDRAGNN_[A-Z0-9_]+)`\s*\|(.*)$")
+
+CHECKER_ID = "env_census"
+
+
+def _env_read_calls(tree: ast.AST) -> List[Tuple[int, str, str]]:
+    """(line, flag_name, call_spelling) for direct os env reads of
+    HYDRAGNN_* literals: os.getenv(...), os.environ.get(...),
+    os.environ[...] loads."""
+    out = []
+    for call in walk_calls(tree):
+        name = call_name(call)
+        if name.endswith("getenv") or name.endswith("environ.get"):
+            key = str_const(call.args[0]) if call.args else None
+            if key and key.startswith("HYDRAGNN_"):
+                out.append((call.lineno, key, name))
+    from .core import dotted
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+            if dotted(node.value).endswith("environ"):
+                key = str_const(node.slice)
+                if key and key.startswith("HYDRAGNN_"):
+                    out.append((node.lineno, key, "os.environ[...]"))
+    return out
+
+
+def _helper_reads(tree: ast.AST) -> List[Tuple[str, str, Optional[str]]]:
+    """(flag_name, helper, default_repr) for envflags helper calls."""
+    out = []
+    for call in walk_calls(tree):
+        name = call_name(call)
+        # local aliases keep their helper identity ("from ..obs.telemetry
+        # import env_flag as _env_flag" is still the shared parse)
+        helper = name.rsplit(".", 1)[-1].lstrip("_")
+        if helper not in ENV_HELPERS:
+            continue
+        key = str_const(call.args[0]) if call.args else None
+        if not key or not key.startswith("HYDRAGNN_"):
+            continue
+        default = None
+        if len(call.args) > 1:
+            default = ast.unparse(call.args[1])
+        out.append((key, helper, default))
+    return out
+
+
+def census(repo: Repo) -> Dict[str, Dict[str, object]]:
+    """name -> {helpers: {helper}, defaults: {repr}, modules: {relpath},
+    mentions: {relpath}} over the package tree."""
+    info: Dict[str, Dict[str, object]] = {}
+
+    def entry(name: str) -> Dict[str, object]:
+        return info.setdefault(
+            name,
+            {"helpers": set(), "defaults": set(), "modules": set(), "mentions": set()},
+        )
+
+    for rel in repo.python_files():
+        # the analysis plane and the envflags boundary document flags by
+        # name without consuming them — their docstrings must not seed
+        # phantom census entries
+        norm = rel.replace("\\", "/")
+        if "/analysis/" in norm or norm.endswith(ENVFLAGS_MODULE):
+            continue
+        src = repo.source(rel)
+        for name in set(ENV_NAME_RE.findall(src.text)):
+            entry(name)["mentions"].add(rel)  # type: ignore[union-attr]
+        if src.tree is None:
+            continue
+        for flag, helper, default in _helper_reads(src.tree):
+            e = entry(flag)
+            e["helpers"].add(helper)  # type: ignore[union-attr]
+            if default is not None:
+                e["defaults"].add(default)  # type: ignore[union-attr]
+            e["modules"].add(rel)  # type: ignore[union-attr]
+    return info
+
+
+def doc_rows(repo: Repo) -> Dict[str, Tuple[int, List[str]]]:
+    """CONFIG.md env-table rows: name -> (line, [cells after the name])."""
+    text = repo.read_text("docs/CONFIG.md")
+    rows: Dict[str, Tuple[int, List[str]]] = {}
+    if text is None:
+        return rows
+    for i, line in enumerate(text.splitlines(), 1):
+        m = _DOC_ROW_RE.match(line.strip())
+        if m:
+            cells = [c.strip() for c in m.group(2).split("|")]
+            rows[m.group(1)] = (i, cells)
+    return rows
+
+
+def _tree_mentions(repo: Repo) -> set:
+    """Every HYDRAGNN_* name mentioned anywhere evidence can live — the
+    stale-docs-row oracle (a row may document a tests-only knob like
+    HYDRAGNN_CI_FAST, or a native-launcher one like HYDRAGNN_MASTER_PORT).
+    The analysis plane and the envflags boundary are excluded: their
+    docstrings catalog flags by name, and a linter whose own prose keeps
+    dead flags "alive" can never flag a stale row."""
+    names = set()
+    for rel in repo.python_files() + repo.aux_files(
+        "tests", "run-scripts", "examples", exts=(".py", ".sh", ".sbatch")
+    ):
+        norm = rel.replace("\\", "/")
+        if "/analysis/" in norm or norm.endswith(ENVFLAGS_MODULE):
+            continue
+        text = repo.read_text(rel)
+        if text:
+            names.update(ENV_NAME_RE.findall(text))
+    for extra in ("bench.py", "__graft_entry__.py"):
+        text = repo.read_text(extra)
+        if text:
+            names.update(ENV_NAME_RE.findall(text))
+    native = repo.package + "/native"
+    import os as _os
+
+    base = _os.path.join(repo.root, native)
+    if _os.path.isdir(base):
+        for f in sorted(_os.listdir(base)):
+            if f.endswith((".cpp", ".h", ".cc")):
+                text = repo.read_text(f"{native}/{f}")
+                if text:
+                    names.update(ENV_NAME_RE.findall(text))
+    return names
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    # contract 1: one parse boundary
+    for rel in repo.python_files():
+        if rel.replace("\\", "/").endswith(ENVFLAGS_MODULE):
+            continue
+        src = repo.source(rel)
+        if src.tree is None:
+            continue
+        for line, flag, spelling in _env_read_calls(src.tree):
+            findings.append(Finding(
+                CHECKER_ID, rel, line,
+                f"direct {spelling} read of {flag} bypasses the shared "
+                "parse boundary",
+                hint="route through utils/envflags.py (env_flag/env_force/"
+                     "env_int/env_float/env_str) — the malformed-value "
+                     "fallback and tri-state grammars live there",
+            ))
+    # contract 2: census == docs (only when the repo carries docs at all —
+    # fixture trees without a docs/ dir still exercise contract 1)
+    if repo.has("docs/CONFIG.md"):
+        info = census(repo)
+        rows = doc_rows(repo)
+        for name in sorted(info):
+            if name not in rows:
+                mods = sorted(info[name]["modules"] or info[name]["mentions"])  # type: ignore[arg-type]
+                findings.append(Finding(
+                    CHECKER_ID, mods[0] if mods else "docs/CONFIG.md", 0,
+                    f"{name} is read in code but has no docs/CONFIG.md "
+                    "env-table row",
+                    hint="add the row (python -m hydragnn_tpu.analysis "
+                         "--env-table regenerates the table from the census)",
+                ))
+        known = _tree_mentions(repo)
+        for name, (line, _cells) in sorted(rows.items()):
+            if name not in known:
+                findings.append(Finding(
+                    CHECKER_ID, "docs/CONFIG.md", line,
+                    f"env-table row documents {name}, which no code in the "
+                    "tree mentions any more",
+                    hint="delete the stale row (or restore the flag)",
+                ))
+    return findings
+
+
+HELPER_GRAMMAR = {
+    "env_flag": "on/off (0/off/false/empty = off, else on)",
+    "env_force": "force/deny (1 = force, else deny)",
+    "env_int": "int (malformed -> default)",
+    "env_float": "float (malformed -> default)",
+    "env_str": "string",
+    "env_set": "armed-if-set",
+}
+
+
+def render_env_table(repo: Repo) -> str:
+    """The regenerated CONFIG.md env table: census-derived Flag / Parse /
+    Default / Read-by columns, Meaning preserved from the existing table
+    (new flags get a placeholder the checker will keep surfacing until a
+    human writes the meaning)."""
+    info = census(repo)
+    rows = doc_rows(repo)
+    lines = [
+        "| Flag | Parse | Default | Read by | Meaning |",
+        "|---|---|---|---|---|",
+    ]
+    for name in sorted(info):
+        e = info[name]
+        helpers = sorted(e["helpers"])  # type: ignore[arg-type]
+        parse = ", ".join(HELPER_GRAMMAR.get(h, h) for h in helpers) or "—"
+        defaults = sorted(e["defaults"])  # type: ignore[arg-type]
+        default = ", ".join(f"`{d}`" for d in defaults) or "—"
+        modules = sorted(e["modules"]) or sorted(e["mentions"])  # type: ignore[arg-type]
+        owner = ", ".join(
+            m.split("/", 1)[-1] for m in modules[:3]
+        ) + (", …" if len(modules) > 3 else "")
+        meaning = "(document me)"
+        if name in rows:
+            # last non-empty cell (a trailing "|" yields an empty tail cell)
+            cells = [c for c in rows[name][1] if c]
+            if cells and cells[-1] != "—":
+                meaning = cells[-1]
+        lines.append(
+            f"| `{name}` | {parse} | {default} | {owner or '—'} | {meaning} |"
+        )
+    return "\n".join(lines)
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="HYDRAGNN_* env reads: one parse boundary, docs row per flag",
+    rationale=(
+        "PR 4's HYDRAGNN_DDSTORE_RETRIES malformed-value crash (hand-rolled "
+        "int(os.getenv()) with no fallback) and a CONFIG.md env table that "
+        "had drifted to a fraction of the names the code reads"
+    ),
+    run=run,
+))

@@ -1,0 +1,74 @@
+"""error_codes: typed-error ``code`` strings are unique across the
+package.
+
+The serving plane's failure vocabulary (serve/errors.py) promises that
+clients "branch on the failure *kind* without parsing messages" — every
+exception class carries a stable ``code`` string, and the chaos smokes
+assert on those codes. That promise dies quietly if two classes ever
+claim the same code (a client's ``except``-by-code dispatch silently
+handles the wrong failure), and nothing enforced it: the codes are plain
+class attributes in whatever module grows the next typed error family
+(serve today; the data plane's typed loader errors are the obvious next
+one).
+
+Rule: collect every class-level ``code = "<literal>"`` assignment in the
+package; two classes sharing a literal is a finding on the second
+definition.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from .core import Checker, Finding, Repo, register, str_const
+
+CHECKER_ID = "error_codes"
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Dict[str, Tuple[str, str, int]] = {}  # code -> (class, rel, line)
+    for rel in sorted(repo.python_files()):
+        src = repo.source(rel)
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and stmt.targets[0].id == "code"
+                ):
+                    code = str_const(stmt.value)
+                    if code is None:
+                        continue
+                    if code in seen:
+                        cls, prel, pline = seen[code]
+                        findings.append(Finding(
+                            CHECKER_ID, rel, stmt.lineno,
+                            f"typed-error code {code!r} on {node.name} is "
+                            f"already claimed by {cls} ({prel}:{pline}) — "
+                            "clients dispatching by code will handle the "
+                            "wrong failure",
+                            hint="pick a distinct code string; codes are "
+                                 "API, never recycled",
+                        ))
+                    else:
+                        seen[code] = (node.name, rel, stmt.lineno)
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="typed-error code strings unique package-wide",
+    rationale=(
+        "serve/errors.py promises code-string dispatch to clients and the "
+        "chaos smokes assert on codes; a duplicated code silently routes "
+        "a client's error handling to the wrong failure kind"
+    ),
+    run=run,
+))

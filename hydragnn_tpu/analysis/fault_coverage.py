@@ -1,0 +1,89 @@
+"""fault_coverage: every ``HYDRAGNN_FAULT_*`` injection point is
+exercised by at least one test or smoke.
+
+The fault-injection surface (utils/faultinject.py) exists so recovery
+paths are *proven*, not trusted — a NaN at a known step, a SIGKILL inside
+the checkpoint writer, a socket drop on the nth call. An injection point
+nobody arms is worse than none: it documents a recovery path as tested
+while the drill silently stopped running (the exact rot the doctor's
+fault drills guard against at the diagnosis layer; this guards it at the
+source layer).
+
+Rule: parse the ``configure()`` keymap in utils/faultinject.py (the
+authoritative point registry — a new point cannot exist without a keymap
+entry, ``_get`` only reads through it and the env). For every
+``HYDRAGNN_FAULT_*`` value, at least one file under ``tests/`` or
+``run-scripts/`` must mention either the env name or its ``configure()``
+keyword — otherwise the point is declared-but-undrilled.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .core import Checker, Finding, Repo, register, str_const
+
+CHECKER_ID = "fault_coverage"
+
+FAULTINJECT_SUFFIX = "utils/faultinject.py"
+
+
+def fault_points(repo: Repo) -> Dict[str, Dict[str, object]]:
+    """env name -> {"key": configure keyword, "line": keymap line} from
+    the faultinject keymap dict literal."""
+    target: Optional[str] = None
+    for rel in repo.python_files():
+        if rel.replace("\\", "/").endswith(FAULTINJECT_SUFFIX):
+            target = rel
+            break
+    out: Dict[str, Dict[str, object]] = {}
+    if target is None:
+        return out
+    tree = repo.source(target).tree
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for k, v in zip(node.keys, node.values):
+            key = str_const(k) if k is not None else None
+            val = str_const(v)
+            if key and val and val.startswith("HYDRAGNN_FAULT_"):
+                out[val] = {"key": key, "line": v.lineno, "rel": target}
+    return out
+
+
+def run(repo: Repo) -> List[Finding]:
+    points = fault_points(repo)
+    if not points:
+        return []
+    evidence = ""
+    for rel in repo.aux_files("tests", "run-scripts", exts=(".py", ".sh", ".sbatch")):
+        evidence += repo.read_text(rel) or ""
+    findings: List[Finding] = []
+    for env_name, meta in sorted(points.items()):
+        key = str(meta["key"])
+        if env_name in evidence or f'"{key}"' in evidence or f"{key}=" in evidence:
+            continue
+        findings.append(Finding(
+            CHECKER_ID, str(meta["rel"]), int(meta["line"]),  # type: ignore[arg-type]
+            f"fault-injection point {env_name} ({key!r}) is declared but "
+            "no test or smoke arms it — its recovery path is documented "
+            "as drilled while nothing drills it",
+            hint="add a drill (tests/ or run-scripts/ smoke) that arms "
+                 "the point and asserts the recovery, or delete the point",
+        ))
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="every HYDRAGNN_FAULT_* point armed by a test or smoke",
+    rationale=(
+        "the fault-tolerance layer's guarantees are only as real as their "
+        "drills; an unarmed injection point is a recovery path that rotted "
+        "out of CI without anyone noticing"
+    ),
+    run=run,
+))

@@ -1,0 +1,226 @@
+"""obs_contract: the observability plane's vocabularies cannot drift from
+their declarations.
+
+PR 14's ``obs/schema.py`` drift test already pins the *record shapes*;
+this checker pins the *vocabularies* around them, at review time:
+
+1. ``obs/events.py`` is internally closed: every ``EV_*`` constant is a
+   member of ``EVENT_KINDS``, and ``EVENT_KINDS`` and ``DEFAULT_SEVERITY``
+   cover exactly the same kinds with severities from ``SEVERITIES`` — an
+   event kind without a default severity rank breaks the run doctor's
+   incident ordering (the exact gap PR 14 closed by hand).
+2. every ``emit(...)`` call site in the package uses a declared kind:
+   a string-literal kind must be in ``EVENT_KINDS``; an ``EV_*`` name must
+   be one of the declared constants. An undeclared kind is invisible to
+   the doctor's rulebook and unrankable by the flight recorder's census.
+3. every ``hydragnn_*`` metric series registered via
+   ``registry().counter/gauge/histogram`` is named in
+   ``docs/OBSERVABILITY.md``'s catalog (brace groups like
+   ``hydragnn_fleet_{min,mean,max}`` expand) — a series nobody can find
+   in the catalog is a dashboard nobody builds.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Repo, call_name, register, str_const, walk_calls
+
+CHECKER_ID = "obs_contract"
+
+EVENTS_MODULE_SUFFIX = "obs/events.py"
+_SERIES_METHODS = {"counter", "gauge", "histogram", "summary"}
+_BRACE_RE = re.compile(r"\{([a-z0-9_,]+)\}")
+
+
+def events_vocabulary(repo: Repo) -> Tuple[Optional[str], Dict[str, object]]:
+    """Statically parse obs/events.py: EV_* constants, EVENT_KINDS,
+    DEFAULT_SEVERITY, SEVERITIES."""
+    target = None
+    for rel in repo.python_files():
+        if rel.replace("\\", "/").endswith(EVENTS_MODULE_SUFFIX):
+            target = rel
+            break
+    out: Dict[str, object] = {
+        "consts": {},        # EV_NAME -> kind string
+        "kinds_tuple": set(),    # member names of EVENT_KINDS
+        "severity_keys": set(),  # member names of DEFAULT_SEVERITY keys
+        "severity_vals": {},     # member name -> severity literal
+        "severities": set(),
+    }
+    if target is None:
+        return None, out
+    tree = repo.source(target).tree
+    if tree is None:
+        return target, out
+    for node in ast.walk(tree):
+        # both plain and annotated assignments (DEFAULT_SEVERITY is
+        # declared as ``DEFAULT_SEVERITY: Dict[str, str] = {...}``)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            t = node.target
+        else:
+            continue
+        if not isinstance(t, ast.Name):
+            continue
+        if t.id.startswith("EV_"):
+            s = str_const(node.value)
+            if s is not None:
+                out["consts"][t.id] = s  # type: ignore[index]
+        elif t.id == "EVENT_KINDS" and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Name):
+                    out["kinds_tuple"].add(elt.id)  # type: ignore[union-attr]
+                s = str_const(elt)
+                if s is not None:
+                    out["kinds_tuple"].add(s)  # type: ignore[union-attr]
+        elif t.id == "DEFAULT_SEVERITY" and isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                name = k.id if isinstance(k, ast.Name) else str_const(k)
+                if name is not None:
+                    out["severity_keys"].add(name)  # type: ignore[union-attr]
+                    sv = str_const(v)
+                    if sv is not None:
+                        out["severity_vals"][name] = sv  # type: ignore[index]
+        elif t.id == "SEVERITIES" and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                s = str_const(elt)
+                if s is not None:
+                    out["severities"].add(s)  # type: ignore[union-attr]
+    return target, out
+
+
+def _doc_series_names(repo: Repo) -> Set[str]:
+    """hydragnn_* names in docs/OBSERVABILITY.md, with {a,b,c} brace
+    groups expanded (the docs' compact spelling for aggregate families)."""
+    text = repo.read_text("docs/OBSERVABILITY.md") or ""
+    names: Set[str] = set()
+    for raw in re.findall(r"hydragnn_[a-z0-9_{},]*", text):
+        raw = raw.rstrip(",_")
+        # docs write labeled series as name{label,...} — the name before
+        # an unclosed brace group is the series
+        if raw.count("{") != raw.count("}"):
+            names.add(raw.split("{", 1)[0])
+            continue
+        expansions: List[List[str]] = [
+            m.group(1).split(",") for m in _BRACE_RE.finditer(raw)
+        ]
+        parts = _BRACE_RE.sub("\0", raw).split("\0")
+        combos = [parts[0]]
+        for i, opts in enumerate(expansions):
+            combos = [c + o + parts[i + 1] for c in combos for o in opts]
+        for c in combos:
+            names.add(c)
+            names.add(c.rstrip("_"))
+    return names
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    events_rel, vocab = events_vocabulary(repo)
+    consts: Dict[str, str] = vocab["consts"]  # type: ignore[assignment]
+    kinds_tuple: Set[str] = vocab["kinds_tuple"]  # type: ignore[assignment]
+    severity_keys: Set[str] = vocab["severity_keys"]  # type: ignore[assignment]
+    severities: Set[str] = vocab["severities"]  # type: ignore[assignment]
+    declared_kind_strings = {consts[n] for n in consts}
+
+    if events_rel is not None and consts:
+        for name in sorted(consts):
+            if name not in kinds_tuple:
+                findings.append(Finding(
+                    CHECKER_ID, events_rel, 0,
+                    f"event constant {name} is not a member of EVENT_KINDS",
+                    hint="add it to the EVENT_KINDS tuple",
+                ))
+            if name not in severity_keys:
+                findings.append(Finding(
+                    CHECKER_ID, events_rel, 0,
+                    f"event kind {name} has no DEFAULT_SEVERITY entry — "
+                    "the doctor/flight-recorder cannot rank its incidents",
+                    hint="add the kind to DEFAULT_SEVERITY with its rank",
+                ))
+        for name in sorted(severity_keys - set(consts)):
+            findings.append(Finding(
+                CHECKER_ID, events_rel, 0,
+                f"DEFAULT_SEVERITY ranks {name!r}, which is not a declared "
+                "EV_* constant",
+                hint="remove the stale entry (or declare the kind)",
+            ))
+        for name, sv in sorted(vocab["severity_vals"].items()):  # type: ignore[union-attr]
+            if severities and sv not in severities:
+                findings.append(Finding(
+                    CHECKER_ID, events_rel, 0,
+                    f"DEFAULT_SEVERITY[{name}] = {sv!r} is not in SEVERITIES",
+                    hint=f"use one of {sorted(severities)}",
+                ))
+
+    # contract 2: emit call sites use declared kinds
+    if consts:
+        for rel in repo.python_files():
+            if rel.replace("\\", "/").endswith(EVENTS_MODULE_SUFFIX):
+                continue
+            src = repo.source(rel)
+            if src.tree is None:
+                continue
+            for call in walk_calls(src.tree):
+                fn = call_name(call).rsplit(".", 1)[-1]
+                if fn not in ("emit", "_emit") or not call.args:
+                    continue
+                first = call.args[0]
+                lit = str_const(first)
+                if lit is not None:
+                    if lit not in declared_kind_strings:
+                        findings.append(Finding(
+                            CHECKER_ID, rel, call.lineno,
+                            f"emit() of undeclared event kind {lit!r}",
+                            hint="declare the kind in obs/events.py "
+                                 "(EV_* constant + EVENT_KINDS + "
+                                 "DEFAULT_SEVERITY) and emit the constant",
+                        ))
+                elif isinstance(first, ast.Name) and first.id.startswith("EV_"):
+                    if first.id not in consts:
+                        findings.append(Finding(
+                            CHECKER_ID, rel, call.lineno,
+                            f"emit() of unknown event constant {first.id}",
+                            hint="declare it in obs/events.py",
+                        ))
+
+    # contract 3: registered hydragnn_* series are in the docs catalog
+    if repo.has("docs/OBSERVABILITY.md"):
+        documented = _doc_series_names(repo)
+        for rel in repo.python_files():
+            src = repo.source(rel)
+            if src.tree is None:
+                continue
+            for call in walk_calls(src.tree):
+                fn = call_name(call).rsplit(".", 1)[-1]
+                if fn not in _SERIES_METHODS or not call.args:
+                    continue
+                series = str_const(call.args[0])
+                if not series or not series.startswith("hydragnn_"):
+                    continue
+                if series not in documented:
+                    findings.append(Finding(
+                        CHECKER_ID, rel, call.lineno,
+                        f"metric series {series!r} is registered but not "
+                        "named in docs/OBSERVABILITY.md",
+                        hint="add it to the metrics catalog table "
+                             "(docs/OBSERVABILITY.md)",
+                    ))
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="obs vocabularies: event kinds declared+ranked, series documented",
+    rationale=(
+        "PR 14 found event kinds without severity ranks while building the "
+        "doctor's rulebook, and the fleet/mix/trace series families landed "
+        "in code without catalog rows — the schema drift test covers record "
+        "shapes but not the vocabularies around them"
+    ),
+    run=run,
+))

@@ -1,0 +1,97 @@
+"""threads: every background thread daemonized, every join bounded,
+every queue get timed out (or explicitly waived with the reason written
+down).
+
+The failure history: the serving plane's wedged-step recovery works
+*because* its step runners are daemon threads (an abandoned runner must
+not block process exit — serve/server.py); the compile plane's background
+warm-up worker and the fleet collector both grew ``join(timeout=...)``
+bounds after hangs in teardown paths; and a bare ``q.get()`` is exactly
+the shape that wedged the loader before the stall watchdog existed
+(docs/ROBUSTNESS.md "Data plane"). The ROADMAP-1 sharding refactor will
+rewrite the files these threads live in — this checker keeps the
+conventions through that churn.
+
+Rules (package-wide):
+
+- ``threading.Thread(...)`` without ``daemon=True`` — a non-daemon
+  background thread can hold the process open past SIGTERM drain;
+- ``<thread>.join()`` with no timeout — an unbounded join in a teardown
+  path is a hang, not a wait;
+- ``<queue>.get()`` with no arguments — dict ``.get()`` always takes
+  arguments, so a zero-arg ``.get()`` is a blocking queue read with no
+  timeout; a wedged producer turns it into a silent hang. Sites that
+  *want* to block forever (a daemon worker's idle loop) carry a waiver
+  pragma saying so.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Checker, Finding, Repo, dotted, register, walk_calls
+
+CHECKER_ID = "threads"
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for rel in repo.python_files():
+        src = repo.source(rel)
+        if src.tree is None:
+            continue
+        for call in walk_calls(src.tree):
+            name = dotted(call.func)
+            tail = name.rsplit(".", 1)[-1]
+            if name.endswith("threading.Thread") or name == "Thread":
+                kw = {k.arg: k.value for k in call.keywords}
+                daemon = kw.get("daemon")
+                is_true = (
+                    isinstance(daemon, ast.Constant) and daemon.value is True
+                )
+                if not is_true:
+                    findings.append(Finding(
+                        CHECKER_ID, rel, call.lineno,
+                        "threading.Thread(...) without daemon=True — the "
+                        "thread can hold the process open past drain/"
+                        "teardown",
+                        hint="pass daemon=True (teardown still joins with "
+                             "a bound; daemonization is the backstop)",
+                    ))
+            elif tail == "join" and not call.args and not call.keywords:
+                # thread/process join is zero-arg; str.join/os.path.join
+                # always take an argument, so no-arg .join() is a join()
+                findings.append(Finding(
+                    CHECKER_ID, rel, call.lineno,
+                    ".join() with no timeout — an unbounded join in a "
+                    "teardown path is a hang",
+                    hint="join(timeout=<bound>) and handle the "
+                         "still-alive case (daemon threads may be "
+                         "abandoned)",
+                ))
+            elif tail == "get" and not call.args and not call.keywords:
+                # dict.get() requires an argument — a zero-arg .get() is a
+                # queue read that blocks forever
+                findings.append(Finding(
+                    CHECKER_ID, rel, call.lineno,
+                    "bare queue .get() with no timeout — a dead/wedged "
+                    "producer turns this into a silent hang",
+                    hint="get(timeout=...) in a loop (or waive with the "
+                         "reason the block-forever is safe, e.g. a daemon "
+                         "worker's idle loop)",
+                ))
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="threads daemonized, joins bounded, queue gets timed out",
+    rationale=(
+        "the serve wedge recovery depends on daemon step runners; the "
+        "compile-plane worker and fleet collector both grew bounded joins "
+        "after teardown hangs; a bare q.get() is the pre-watchdog loader "
+        "wedge shape (docs/ROBUSTNESS.md)"
+    ),
+    run=run,
+))

@@ -1,0 +1,163 @@
+"""trace_hazard: no host syncs or counter dtype casts inside the jitted
+step builders.
+
+The incident this encodes: in PR 3 an explicit int32 cast on the
+TrainState step counter flipped the leaf's weak type, which made every
+specialization a *new* trace signature — one silent full XLA recompile
+per step, found by hand in round 7 (docs/PERFORMANCE.md "Retrace sentinel
+semantics"). The retrace sentinel now catches that class at RUNTIME;
+this checker catches it at REVIEW time, before a run is ever launched.
+
+Scope: the step-builder modules and functions only — the bodies that jit
+traces (``train/loop.py`` ``make_train_step``/``make_eval_step``,
+``parallel/dp.py`` and ``parallel/branch.py`` builders). Inside them:
+
+- ``.item()``, ``jax.device_get(...)``, ``np.asarray``/``np.array``:
+  host syncs — a device round-trip per step inside what must stay a
+  pure traced program;
+- ``float(x)`` / ``int(x)`` where ``x`` mentions a ``state.`` attribute:
+  concretization of a traced value (raises under jit, or silently hides
+  a host pull when applied pre-trace);
+- ``.astype(...)`` / ``jnp.asarray(..., dtype=...)`` / ``jnp.int32(...)``
+  / ``jnp.int64(...)`` applied to a TrainState counter leaf
+  (``state.step`` and the guard's skip counters): the weak-type flip
+  itself — the PR 3 cast, verbatim.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Tuple
+
+from .core import Checker, Finding, Repo, dotted, register, walk_calls
+
+CHECKER_ID = "trace_hazard"
+
+# (module path suffix, builder function names) — the jitted-step surface
+STEP_BUILDERS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
+    ("train/loop.py", ("make_train_step", "make_eval_step")),
+    ("parallel/dp.py", ("make_parallel_train_step", "make_parallel_eval_step")),
+    ("parallel/branch.py", (
+        "make_branch_parallel_train_step", "make_branch_parallel_eval_step",
+    )),
+)
+
+# TrainState integer counter leaves whose weak type the compile ladder
+# depends on (train/state.py; the PR 3 flip was on .step)
+COUNTER_ATTRS = ("step", "skipped_steps", "consecutive_skipped", "rollbacks")
+
+_HOST_SYNC_CALLS = ("jax.device_get", "np.asarray", "np.array", "onp.asarray")
+_CAST_CALLS = ("jnp.int32", "jnp.int64", "jnp.uint32", "jnp.float32")
+
+
+def _mentions_counter(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Attribute)
+            and sub.attr in COUNTER_ATTRS
+            and isinstance(sub.value, ast.Name)
+            and sub.value.id in ("state", "new_state", "self")
+        ):
+            return True
+    return False
+
+
+def _builder_functions(tree: ast.AST, names: Iterable[str]) -> List[ast.FunctionDef]:
+    out = []
+    wanted = set(names)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.name in wanted:
+                out.append(node)
+    return out
+
+
+def _scan_body(rel: str, fn: ast.FunctionDef) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        tail = name.rsplit(".", 1)[-1]
+        # .item() — the canonical per-step host sync
+        if tail == "item" and not node.args and isinstance(node.func, ast.Attribute):
+            findings.append(Finding(
+                CHECKER_ID, rel, node.lineno,
+                f".item() inside step builder {fn.name!r} is a host sync "
+                "per step",
+                hint="keep the value on device (jnp) or move the read to "
+                     "the epoch boundary the loop already syncs on",
+            ))
+            continue
+        if name in _HOST_SYNC_CALLS:
+            findings.append(Finding(
+                CHECKER_ID, rel, node.lineno,
+                f"{name}(...) inside step builder {fn.name!r} pulls a "
+                "traced value to host",
+                hint="use jnp inside the traced body; host-side work "
+                     "belongs outside the builder",
+            ))
+            continue
+        if name in ("float", "int") and node.args and _mentions_counter(node.args[0]):
+            findings.append(Finding(
+                CHECKER_ID, rel, node.lineno,
+                f"{name}() on a TrainState counter inside step builder "
+                f"{fn.name!r} concretizes a traced value",
+                hint="keep the counter traced; read it host-side after "
+                     "the step returns",
+            ))
+            continue
+        # the PR 3 weak-type flip: an explicit dtype cast on a counter leaf
+        is_astype = (
+            tail == "astype"
+            and isinstance(node.func, ast.Attribute)
+            and _mentions_counter(node.func.value)
+        )
+        is_ctor_cast = name in _CAST_CALLS and any(
+            _mentions_counter(a) for a in node.args
+        )
+        is_asarray_dtype = (
+            tail == "asarray"
+            and name.startswith("jnp")
+            and (len(node.args) > 1 or any(k.arg == "dtype" for k in node.keywords))
+            and any(_mentions_counter(a) for a in node.args)
+        )
+        if is_astype or is_ctor_cast or is_asarray_dtype:
+            findings.append(Finding(
+                CHECKER_ID, rel, node.lineno,
+                "explicit dtype cast on a TrainState counter inside step "
+                f"builder {fn.name!r} flips the leaf's weak type — every "
+                "specialization becomes a new trace (the PR 3 silent-"
+                "recompile incident)",
+                hint="drop the cast: counters stay weakly-typed python "
+                     "ints under `state.step + 1` (docs/PERFORMANCE.md "
+                     "'Retrace sentinel semantics')",
+            ))
+    return findings
+
+
+def run(repo: Repo) -> List[Finding]:
+    findings: List[Finding] = []
+    for suffix, names in STEP_BUILDERS:
+        for rel in repo.python_files():
+            if not rel.replace("\\", "/").endswith(suffix):
+                continue
+            src = repo.source(rel)
+            if src.tree is None:
+                continue
+            for fn in _builder_functions(src.tree, names):
+                findings.extend(_scan_body(rel, fn))
+    return findings
+
+
+register(Checker(
+    id=CHECKER_ID,
+    title="no host syncs / counter dtype casts in jitted step builders",
+    rationale=(
+        "the PR 3 weak_type incident: an int32 cast on state.step made "
+        "every specialization recompile silently each step; the runtime "
+        "retrace sentinel catches it in CI smokes, this catches it in "
+        "review before a TPU hour is spent"
+    ),
+    run=run,
+))

@@ -43,6 +43,7 @@ from .train.checkpoint import (
 from .train.loop import test_model, train_validate_test
 from .train.optimizer import make_optimizer
 from .train.state import TrainState
+from .utils import envflags
 
 
 def _localize_loader(loader: GraphLoader) -> GraphLoader:
@@ -470,7 +471,7 @@ def prepare_data(
         sample_weights=sample_weights,
         # background batch building (HYDRAGNN_NUM_WORKERS=0 disables; the
         # reference's env of the same name sizes its thread-pool loader)
-        prefetch=max(int(os.getenv("HYDRAGNN_NUM_WORKERS", "2")), 0),
+        prefetch=max(envflags.env_int("HYDRAGNN_NUM_WORKERS", 2), 0),
         # multi-host batches must stay full so every process steps in
         # lockstep with identical shard shapes
         drop_last=jax.process_count() > 1,

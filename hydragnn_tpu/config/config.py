@@ -18,6 +18,7 @@ import numpy as np
 
 from ..data.graph import Graph
 from ..data.pipeline import VariablesOfInterest
+from ..utils import envflags
 
 
 def _jit_target_inference() -> tuple:
@@ -120,9 +121,9 @@ def average_degree(graphs: Sequence[Graph]) -> float:
 
 def check_if_graph_size_variable(*datasets: Sequence[Graph]) -> bool:
     """(reference: graph_samples_checks_and_updates.py:32-87)"""
-    env = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
+    env = envflags.env_flag("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
     if env is not None:
-        return bool(int(env))
+        return env
     sizes = {g.num_nodes for ds in datasets for g in ds}
     return len(sizes) > 1
 
@@ -166,8 +167,8 @@ def update_config(
     # [B, Nmax, C] instead of batch-wide [N, N] — reference semantics:
     # to_dense_batch in hydragnn/globalAtt/gps.py:125-141)
     sizes = {g.num_nodes for ds in (trainset, valset, testset) for g in ds}
-    env = os.getenv("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
-    graph_size_variable = bool(int(env)) if env is not None else len(sizes) > 1
+    env = envflags.env_flag("HYDRAGNN_USE_VARIABLE_GRAPH_SIZE")
+    graph_size_variable = env if env is not None else len(sizes) > 1
     arch["graph_size_variable"] = graph_size_variable
     arch["max_nodes_per_graph"] = max(sizes, default=0)
 

@@ -35,6 +35,7 @@ _OPAQUE = {
     "Dataset.path",
     "Dataset.synthetic",
     "Dataset.lennard_jones",
+    "Dataset.Descriptors",
     "Mixture.weights",
     "Mixture.branch_loss_weights",
 }
@@ -55,6 +56,10 @@ _HANDLED = {
     "Dataset.lennard_jones",
     "Dataset.bad_sample_policy",
     "Dataset.lappe_cache",
+    "Dataset.edge_features",
+    "Dataset.Descriptors",
+    "Dataset.charge_density_correction",
+    "Dataset.mode",
     "NeuralNetwork.Profile",
     "NeuralNetwork.Profile.enable",
     "NeuralNetwork.Profile.target_epoch",
@@ -150,6 +155,11 @@ _HANDLED = {
     "NeuralNetwork.Training.double_buffer",
     "NeuralNetwork.Training.warmup_epochs",
     "NeuralNetwork.Training.walltime_minutes",
+    "NeuralNetwork.Training.return_best",
+    "NeuralNetwork.Training.oversampling",
+    "NeuralNetwork.Training.num_samples",
+    "NeuralNetwork.Training.balance_branch_sampling",
+    "NeuralNetwork.Training.CheckRemainingTime",
     "Visualization.create_plots",
     "Serving.max_queue_requests",
     "Serving.micro_batch_graphs",

@@ -30,6 +30,7 @@ import numpy as np
 
 from .datasets import AbstractBaseDataset
 from .graph import Graph
+from ..utils import envflags
 
 
 _LIB = None
@@ -210,20 +211,6 @@ class DDStore:
             pass
 
 
-def _env_float(key: str, default: float) -> float:
-    try:
-        return float(os.environ.get(key, default))
-    except ValueError:
-        return default
-
-
-def _env_int(key: str, default: int) -> int:
-    try:
-        return int(os.environ.get(key, default))
-    except ValueError:
-        return default
-
-
 class RemoteStoreClient:
     """Persistent TCP connection fetching blobs from a serving DDStore on
     another host (the MPI one-sided get analog, distdataset.py:159-183).
@@ -256,18 +243,18 @@ class RemoteStoreClient:
         self._lib = _load_lib()
         self.host, self.port = host, port
         self.timeout_s = (
-            _env_float("HYDRAGNN_DDSTORE_TIMEOUT", 30.0)
+            envflags.env_float("HYDRAGNN_DDSTORE_TIMEOUT", 30.0)
             if timeout_s is None
             else float(timeout_s)
         )
         self.retries = max(
-            _env_int("HYDRAGNN_DDSTORE_RETRIES", 4)
+            envflags.env_int("HYDRAGNN_DDSTORE_RETRIES", 4)
             if retries is None
             else int(retries),
             1,
         )
         self.retry_base = (
-            _env_float("HYDRAGNN_DDSTORE_RETRY_BASE", 0.25)
+            envflags.env_float("HYDRAGNN_DDSTORE_RETRY_BASE", 0.25)
             if retry_base is None
             else float(retry_base)
         )

@@ -29,6 +29,7 @@ from typing import List, Optional
 import numpy as np
 
 from .graph import Graph
+from ..utils import envflags
 
 _CACHE_ENV = "HYDRAGNN_LAPPE_CACHE"
 _DEFAULT_CACHE_DIR = os.path.join("logs", "lappe_cache")
@@ -39,7 +40,7 @@ def resolve_cache_dir(cache=True) -> Optional[str]:
     ``Dataset.lappe_cache``: True (default dir), False/None (off), or a
     path. The env always wins: ``0``/``off``/``false`` disables, ``1``
     keeps the config resolution, anything else is the directory."""
-    env = os.getenv(_CACHE_ENV)
+    env = envflags.env_str(_CACHE_ENV)
     if env is not None:
         s = env.strip()
         if s.lower() in ("0", "off", "false", "none", ""):

@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 from scipy.spatial import cKDTree
+from ..utils import envflags
 
 # node count above which the C++ cell-list builder takes over from scipy.
 # Measured on this image: the KD-tree (itself C) matches the cell list up
@@ -108,7 +109,7 @@ def radius_graph(
     scipy's KD-tree. Both produce the same edge SET; ordering differs.
     """
     pos = np.asarray(pos, np.float64)
-    native_pref = os.getenv("HYDRAGNN_NATIVE_NEIGHBORS")
+    native_pref = envflags.env_str("HYDRAGNN_NATIVE_NEIGHBORS")
     use_native = (
         native_pref == "1"
         or (native_pref != "0" and pos.shape[0] >= _NATIVE_MIN_N)

@@ -31,6 +31,7 @@ import sys
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+from .utils import envflags
 
 
 def parse_slurm_nodelist(node_list: str) -> List[str]:
@@ -174,14 +175,14 @@ def run_hpo(
     ``<study_dir>/trials/trial_<id>/`` (docs/OBSERVABILITY.md "HPO").
     """
     if study_dir is None:
-        study_dir = os.getenv("HYDRAGNN_HPO_STUDY_DIR") or None
+        study_dir = envflags.env_str("HYDRAGNN_HPO_STUDY_DIR") or None
     # worker-qualified labels: launch_hpo_workers gives every worker an
     # overlapping trial_offset range (offset+i seeds the sampler stream),
     # so bare numeric ids would collide across workers — two workers'
     # trials/trial_3/ dirs silently overwriting each other. The exported
     # HYDRAGNN_HPO_WORKER index disambiguates both the surfaced dirs and
     # the "trial" labels in metrics.jsonl.
-    worker = os.getenv("HYDRAGNN_HPO_WORKER")
+    worker = envflags.env_str("HYDRAGNN_HPO_WORKER")
     surf_offsets: Dict[str, int] = {}
 
     if objective is None:
@@ -194,7 +195,7 @@ def run_hpo(
             if study_dir:
                 _surface_trial_metrics(
                     os.path.join("./logs", get_log_name_config(cfg_out)),
-                    os.environ.get("HYDRAGNN_TRIAL_ID", "unknown"),
+                    envflags.env_str("HYDRAGNN_TRIAL_ID", "unknown"),
                     study_dir,
                     offsets=surf_offsets,
                 )
@@ -208,7 +209,7 @@ def run_hpo(
 
     def objective(config: Dict[str, Any]) -> float:
         tid = next(trial_counter, trial_offset + num_trials)
-        prev = os.environ.get("HYDRAGNN_TRIAL_ID")
+        prev = envflags.env_str("HYDRAGNN_TRIAL_ID")
         os.environ["HYDRAGNN_TRIAL_ID"] = (
             f"w{worker}.{tid}" if worker is not None else str(tid)
         )

@@ -37,7 +37,6 @@ tally line through the loop's ``mixture_epoch_hook``.
 from __future__ import annotations
 
 import dataclasses
-import os
 import sys
 import zlib
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
@@ -55,6 +54,7 @@ from ..data.graph import (
 from ..data.pipeline import spec_template_batches as _module_templates
 from .balance import DriftMonitor
 from .sampler import SourceCursor, draw_source, temperature_weights
+from ..utils import envflags
 
 
 class MixtureExhaustedError(RuntimeError):
@@ -175,7 +175,7 @@ class MixturePlane:
             decay=float(settings.get("drift_ema_decay", 0.9)),
             threshold=float(settings.get("drift_threshold", 2.0)),
         )
-        self._fingerprint = os.getenv("HYDRAGNN_MIX_FINGERPRINT", "0") == "1"
+        self._fingerprint = bool(envflags.env_force("HYDRAGNN_MIX_FINGERPRINT"))
         # per-batch position journal of the CURRENT epoch: batch index ->
         # (draw, cursors) at that batch's first draw. state_dict(next_batch)
         # reads the journal so a snapshot pairs the cursor state with the

@@ -29,7 +29,6 @@ position centering (MACEStack.py:405-418) is unnecessary here.
 from __future__ import annotations
 
 import math
-import os
 from typing import Dict, Optional, Tuple
 
 import flax.linen as nn
@@ -52,6 +51,7 @@ from ..ops.segment import segment_sum
 from ..ops.segment import masked_global_mean_pool
 from .base import ModelConfig, NodeHeadConfig, _branch_bank
 from .layers import MLP, get_activation
+from ..utils import envflags
 
 NUM_ELEMENTS = 118
 
@@ -69,9 +69,9 @@ def _dense_cg_enabled() -> bool:
     MXU shape, the wrong trade off-TPU). Evaluated at trace time like
     ops/segment._pallas_route_enabled, so the backend exists by then;
     HYDRAGNN_MACE_DENSE_CG=0/1 overrides."""
-    pref = os.getenv("HYDRAGNN_MACE_DENSE_CG")
+    pref = envflags.env_force("HYDRAGNN_MACE_DENSE_CG")
     if pref is not None:
-        return pref == "1"
+        return pref
     return jax.default_backend() == "tpu"
 
 

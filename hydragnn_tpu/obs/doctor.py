@@ -1074,6 +1074,34 @@ def diagnose(
     return findings, report
 
 
+def static_findings_record(root: Optional[str] = None) -> Dict[str, Any]:
+    """The graftlint verdict for doctor.json (HYDRAGNN_DOCTOR teardown):
+    whether the tree the diagnosed binary ran from was clean under
+    ``python -m hydragnn_tpu.analysis``, so post-hoc forensics can rule
+    convention rot in or out before chasing runtime causes. Analysis is
+    pure host-side AST work (no jax import); any failure degrades to an
+    ``error`` field — the verdict hook must never take teardown down."""
+    try:
+        from .. import analysis
+
+        findings = analysis.analyze(root)
+        summary = analysis.summarize(findings)
+        rec: Dict[str, Any] = {
+            "v": analysis.ANALYSIS_SCHEMA_VERSION,
+            "clean": summary["clean"],
+            "active": summary["active"],
+            "waived": summary["waived"],
+            "by_checker": summary["by_checker"],
+        }
+        if summary["active"]:
+            rec["findings"] = [
+                f.to_dict() for f in findings if not f.waived
+            ][:50]  # bounded: doctor.json is a forensic record, not a report
+        return rec
+    except Exception as e:  # noqa: BLE001 — degrade, never raise
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def run_summary(streams: RunStreams) -> Dict[str, Any]:
     """Comparable scalar summary of one run (the diff mode's per-side
     metric table)."""

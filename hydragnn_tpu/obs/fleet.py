@@ -50,7 +50,6 @@ is untouched either way — the fleet is host-side only by construction.
 from __future__ import annotations
 
 import json
-import os
 import threading
 import time
 import urllib.error
@@ -62,6 +61,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 from .events import EV_FLEET_DESYNC, EV_FLEET_HOST_STALE, EV_FLEET_STRAGGLER
 from .events import emit as emit_event
 from .registry import MetricsRegistry, registry
+from ..utils import envflags
 
 # push payload schema version (the fleet analog of metrics.jsonl "v")
 FLEET_SCHEMA_VERSION = 1
@@ -91,8 +91,8 @@ def host_identity() -> Tuple[int, int]:
     (``WORLD_SIZE``/``RANK``, SLURM, OMPI — parallel/mesh.py
     ``local_host_info``, which also knows a skipped rendezvous means the
     process really is alone); (0, 1) without any of them."""
-    env_i = os.getenv("HYDRAGNN_FLEET_HOST_INDEX")
-    env_c = os.getenv("HYDRAGNN_FLEET_HOST_COUNT")
+    env_i = envflags.env_str("HYDRAGNN_FLEET_HOST_INDEX")
+    env_c = envflags.env_str("HYDRAGNN_FLEET_HOST_COUNT")
     if env_i is not None or env_c is not None:
         try:
             return int(env_i or 0), max(int(env_c or 1), 1)
@@ -687,7 +687,7 @@ class FleetPlane:
     def __init__(self, settings: Dict[str, Any], run_dir: Optional[str] = None):
         self.run_dir = run_dir
         self.host, self.host_count = host_identity()
-        addr = os.getenv("HYDRAGNN_FLEET_COLLECTOR") or settings.get(
+        addr = envflags.env_str("HYDRAGNN_FLEET_COLLECTOR") or settings.get(
             "fleet_collector"
         )
         if addr is not None and not _valid_collector_addr(str(addr)):

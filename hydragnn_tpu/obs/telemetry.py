@@ -50,6 +50,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..utils import envflags
 from .registry import registry
 
 # record shapes + version live in obs/schema.py (one source of truth the
@@ -114,15 +115,10 @@ PEAK_FLOPS = {
 }
 
 
-def env_flag(name: str) -> Optional[bool]:
-    """Tri-state boolean env parse shared by every HYDRAGNN_* on/off
-    override (HYDRAGNN_TELEMETRY, HYDRAGNN_NUMERICS, ...): None when
-    unset, else False for the falsy tokens and True otherwise — ONE
-    spelling, so the overrides cannot drift between entry points."""
-    v = os.getenv(name)
-    if v is None:
-        return None
-    return v.strip().lower() not in ("0", "off", "false", "")
+# the tri-state on/off env parse moved to the shared boundary module in
+# r15 (utils/envflags.py, enforced by analysis/env_census.py); re-exported
+# here because every plane historically imported it from telemetry
+env_flag = envflags.env_flag
 
 
 def peak_flops(device_kind: str) -> float:
@@ -398,7 +394,7 @@ class MetricsStream:
         # HPO trial labeling (hpo.py run_hpo exports HYDRAGNN_TRIAL_ID per
         # trial): every record of a worker's stream carries its trial id,
         # so a parent study can attribute per-trial signals after the fact
-        trial = os.getenv("HYDRAGNN_TRIAL_ID")
+        trial = envflags.env_str("HYDRAGNN_TRIAL_ID")
         self._trial: Optional[Any] = None
         if trial is not None:
             try:

@@ -53,11 +53,11 @@ profile, the backward pays the gathered-dense recompute.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from ..utils import envflags
 from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_segment import _pad_to
@@ -76,9 +76,9 @@ def _flash_route_enabled() -> bool:
     decides. Off-TPU forcing runs the kernel in interpret mode (the CPU
     dryrun / CI smoke route).
     """
-    pref = os.getenv("HYDRAGNN_PALLAS_FLASH")
+    pref = envflags.env_force("HYDRAGNN_PALLAS_FLASH")
     if pref is not None:
-        return pref == "1"
+        return pref
     return jax.default_backend() == "tpu"
 
 

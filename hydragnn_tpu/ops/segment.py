@@ -12,11 +12,11 @@ All functions take ``num_segments`` statically so shapes stay fixed under jit.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..utils import envflags
 
 
 def _pallas_route_enabled() -> bool:
@@ -27,9 +27,9 @@ def _pallas_route_enabled() -> bool:
     backend); ``HYDRAGNN_PALLAS_SEGMENT=0/1`` overrides for a jit that
     targets a non-default device.
     """
-    pref = os.getenv("HYDRAGNN_PALLAS_SEGMENT")
+    pref = envflags.env_force("HYDRAGNN_PALLAS_SEGMENT")
     if pref is not None:
-        return pref == "1"
+        return pref
     return jax.default_backend() == "tpu"
 
 
@@ -78,7 +78,7 @@ def segment_sum(
     backend, or 1-D messages, falls back to ``jax.ops.segment_sum``.
     """
     msg = _mask_messages(messages, mask)
-    if sorted_ids and os.getenv("HYDRAGNN_DEBUG_SORTED") == "1":
+    if sorted_ids and envflags.env_force("HYDRAGNN_DEBUG_SORTED"):
         _debug_check_sorted(segment_ids)
     if sorted_ids and max_degree and msg.ndim == 2 and _pallas_route_enabled():
         from .pallas_segment import sorted_segment_sum
@@ -115,7 +115,7 @@ def fused_edge_message_sum(
     differentiate to arbitrary order (the kernel's tangent rule is plain
     jnp), so energy-force training composes.
     """
-    if os.getenv("HYDRAGNN_DEBUG_SORTED") == "1":
+    if envflags.env_force("HYDRAGNN_DEBUG_SORTED"):
         _debug_check_sorted(segment_ids)
     if max_degree and _pallas_route_enabled():
         from .pallas_fused_edge import fused_edge_message_sum as _pallas_fused
@@ -137,9 +137,9 @@ def _multiagg_route_enabled() -> bool:
     unset, the decision falls through to ``HYDRAGNN_PALLAS_SEGMENT`` /
     the TPU-backend default, so one env flip drives every sorted kernel
     in an A/B (the multichip dryrun relies on that)."""
-    pref = os.getenv("HYDRAGNN_PALLAS_MULTIAGG")
+    pref = envflags.env_force("HYDRAGNN_PALLAS_MULTIAGG")
     if pref is not None:
-        return pref == "1"
+        return pref
     return _pallas_route_enabled()
 
 
@@ -169,7 +169,7 @@ def multi_moment_agg(
     training composes. ``mask`` is honored only on the dense route — the
     sorted layout neutralizes padding edges by construction (they all
     land on the final dummy node, masked downstream)."""
-    if sorted_ids and os.getenv("HYDRAGNN_DEBUG_SORTED") == "1":
+    if sorted_ids and envflags.env_force("HYDRAGNN_DEBUG_SORTED"):
         _debug_check_sorted(segment_ids)
     from .pallas_multi_agg import fused_multi_agg, reference_multi_agg
 

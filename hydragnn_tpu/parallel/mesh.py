@@ -32,6 +32,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from ..utils import envflags
 
 DATA_AXIS = "data"
 BRANCH_AXIS = "branch"
@@ -356,7 +357,7 @@ def setup_distributed() -> None:
     """
     if jax.distributed.is_initialized():
         return
-    coord = os.environ.get("HYDRAGNN_COORDINATOR") or os.environ.get(
+    coord = envflags.env_str("HYDRAGNN_COORDINATOR") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )
     count, index = _scheduler_host_info()

@@ -204,7 +204,7 @@ class _StepRunner:
 
     def _main(self) -> None:
         while True:
-            thunk = self._in.get()
+            thunk = self._in.get()  # graftlint: disable=threads -- daemon runner's idle loop: blocking for the next thunk IS the design; the wedge watchdog bounds run() on the consumer side and recycles the runner
             if thunk is None:
                 return
             try:

@@ -43,6 +43,7 @@ from flax import serialization
 
 from ..utils import faultinject
 from .state import InferenceState, LoaderState, TrainState
+from ..utils import envflags
 
 _EPOCH_RE = re.compile(r"_epoch(\d+)\.msgpack$")
 _LOADER_STATE_FILE = "loader_state.json"
@@ -58,8 +59,8 @@ def _run_dir(log_name: str, path: str = "./logs") -> str:
 def _retry_plan() -> List[float]:
     """Backoff schedule for transient IO errors: attempt i sleeps
     base * 2^i before retrying (base 0 => no sleeping, the CI setting)."""
-    attempts = max(int(os.getenv("HYDRAGNN_CKPT_RETRIES", "4")), 1)
-    base = float(os.getenv("HYDRAGNN_CKPT_RETRY_BASE", "0.25"))
+    attempts = max(envflags.env_int("HYDRAGNN_CKPT_RETRIES", 4), 1)
+    base = envflags.env_float("HYDRAGNN_CKPT_RETRY_BASE", 0.25)
     return [base * (2.0**i) for i in range(attempts)]
 
 
@@ -146,7 +147,7 @@ def _observe_duration(op: str, t0: float) -> None:
 def _epoch_from_env() -> Optional[int]:
     """HYDRAGNN_EPOCH, hardened: a malformed value at the very end of a run
     must not crash the save — warn and fall back to the unsuffixed name."""
-    env = os.getenv("HYDRAGNN_EPOCH")
+    env = envflags.env_str("HYDRAGNN_EPOCH")
     if env is None:
         return None
     try:

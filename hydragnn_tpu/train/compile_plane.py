@@ -56,6 +56,7 @@ import warnings
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
+from ..utils import envflags
 
 PRECOMPILE_MODES = ("off", "blocking", "background", "analysis")
 RETRACE_POLICIES = ("warn", "error")
@@ -324,7 +325,7 @@ def setup_compile_cache(
     jax's min-compile-time write threshold (the smokes pin 0 so CPU-sized
     compiles are cached too). Returns the active directory, or None.
     """
-    env = os.getenv("HYDRAGNN_COMPILE_CACHE")
+    env = envflags.env_str("HYDRAGNN_COMPILE_CACHE")
     cfg = training.get("compile_cache_dir")
     if env is not None:
         s = env.strip()
@@ -345,7 +346,7 @@ def setup_compile_cache(
         path = cfg
     else:
         path = os.path.join("./logs", log_name or "run", "xla_cache")
-    min_secs = os.getenv("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
+    min_secs = envflags.env_str("HYDRAGNN_COMPILE_CACHE_MIN_SECS")
     return set_cache_dir(
         path, float(min_secs) if min_secs is not None else None
     )

@@ -27,13 +27,13 @@ verified checkpoint with an LR backoff — agreed across hosts the same way
 
 from __future__ import annotations
 
-import os
 import sys
 from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
+from ..utils import envflags
 
 
 def guard_enabled(guard: Optional[bool] = None) -> bool:
@@ -43,7 +43,7 @@ def guard_enabled(guard: Optional[bool] = None) -> bool:
     cost is one global-norm pass bounded by the BENCH_GUARD A/B cell)."""
     if guard is not None:
         return bool(guard)
-    return os.getenv("HYDRAGNN_STEP_GUARD", "1") == "1"
+    return envflags.env_force("HYDRAGNN_STEP_GUARD") is not False
 
 
 def step_ok(tot, grads):

@@ -27,6 +27,7 @@ import optax
 
 from ..data.graph import GraphBatch
 from ..models.base import HydraModel
+from ..utils import envflags
 from .loss import compute_loss
 from .optimizer import ReduceLROnPlateau
 from .state import TrainState
@@ -288,7 +289,7 @@ def device_prefetch(iterator, depth: int = 2, device=None):
     threading.Thread(target=producer, daemon=True).start()
     try:
         while True:
-            item = q.get()
+            item = q.get()  # graftlint: disable=threads -- producer is a daemon doing only device_put; it always posts _END/_ERR, and the loader-side stall watchdog (data/pipeline.py) owns stall detection
             if item is _END:
                 return
             if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
@@ -305,9 +306,8 @@ def _maybe_device_prefetch(iterator, depth: Optional[int] = None):
     queue depth); the HYDRAGNN_DEVICE_PREFETCH env always wins (0
     disables), and None means "no config reached here" — the historical
     env-or-2 default, so direct callers keep their behavior."""
-    env = os.getenv("HYDRAGNN_DEVICE_PREFETCH")
-    if env is not None:
-        depth = int(env)
+    if envflags.env_set("HYDRAGNN_DEVICE_PREFETCH"):
+        depth = envflags.env_int("HYDRAGNN_DEVICE_PREFETCH", 2)
     elif depth is None:
         depth = 2
     active = (
@@ -468,8 +468,8 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
             # this epoch never stepped (docs/ROBUSTNESS.md "Data plane")
             cursor = offset + consumed
             break
-        max_batches = os.getenv("HYDRAGNN_MAX_NUM_BATCH")
-        if max_batches is not None and i + 1 >= int(max_batches):
+        max_batches = envflags.env_int("HYDRAGNN_MAX_NUM_BATCH", 0)
+        if max_batches > 0 and i + 1 >= max_batches:
             break
     if nan_watch is not None:
         # drain the watch ring at the boundary the loop syncs on anyway
@@ -589,7 +589,7 @@ def train_validate_test(
     """
     training = config["NeuralNetwork"]["Training"]
     num_epoch = training["num_epoch"]
-    do_valtest = os.getenv("HYDRAGNN_VALTEST", "1") != "0"
+    do_valtest = envflags.env_flag("HYDRAGNN_VALTEST") is not False
 
     compute_grad_energy = training.get("compute_grad_energy", False)
     # bf16 compute against f32 master weights (MXU-native; make_train_step)
@@ -640,7 +640,13 @@ def train_validate_test(
         log_name=log_name,
     )
 
-    profiler = Profiler(config.get("Profile"), log_dir=f"./logs/{log_name}/profile")
+    profiler = Profiler(
+        # documented location first (docs/CONFIG.md "NeuralNetwork.Profile");
+        # the historical top-level section keeps working
+        config["NeuralNetwork"].get("Profile")
+        or config.get("Profile"),  # graftlint: disable=config_keys -- legacy top-level Profile accepted for pre-r15 configs; NeuralNetwork.Profile is the documented home
+        log_dir=f"./logs/{log_name}/profile",
+    )
     check_remaining = training.get("CheckRemainingTime", False)
     preemption.install()
     tr.enable()
@@ -1186,6 +1192,11 @@ def train_validate_test(
                             "target": run_dir,
                             "findings": [f.to_dict() for f in findings],
                             "report": d_report,
+                            # was the binary under diagnosis built from a
+                            # clean tree? (graftlint verdict — the static
+                            # analog of the runtime evidence above)
+                            "static_findings":
+                                _doctor.static_findings_record(),
                         },
                         fh, indent=2, default=str,
                     )
@@ -1255,7 +1266,7 @@ def test_model(
     # HYDRAGNN_DUMP_TESTDATA, train_validate_test.py:642-652). "0"/"false"
     # disable (matching HYDRAGNN_VALTEST semantics); "1"/"true" use the
     # default directory; anything else is the output directory.
-    dump = os.getenv("HYDRAGNN_DUMP_TESTDATA", "")
+    dump = envflags.env_str("HYDRAGNN_DUMP_TESTDATA", "")
     if dump and dump.lower() not in ("0", "false"):
         import pickle
 

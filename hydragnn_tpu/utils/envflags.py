@@ -1,0 +1,114 @@
+"""The one parse boundary for the ``HYDRAGNN_*`` environment channel.
+
+Before graftlint, each module hand-rolled its env parsing: ``int(os.getenv
+(...))`` crashing a multi-hour run on a typo'd value (the
+``HYDRAGNN_DDSTORE_RETRIES`` malformed-value crash class, fixed piecemeal
+in data/ddstore.py and train/checkpoint.py with private ``_env_int``
+copies), three spellings of tri-state booleans, and ``== "1"`` force
+checks scattered across the kernel routers. This module is the single
+shared vocabulary, and the ``env_census`` checker (analysis/env_census.py)
+enforces that every ``HYDRAGNN_*`` read in the package routes through it —
+a direct ``os.environ``/``os.getenv`` read of a ``HYDRAGNN_*`` name
+anywhere else is a CI-gated finding.
+
+Parse helpers and their grammars (docs/CONFIG.md "Environment flags"):
+
+- ``env_flag``: tri-state on/off — None unset, else False for the falsy
+  tokens (``0``/``off``/``false``/empty) and True otherwise. The
+  HYDRAGNN_TELEMETRY-style overrides.
+- ``env_force``: tri-state force/deny — None unset, True for exactly
+  ``"1"``, False for anything else set. The kernel-route preferences
+  (HYDRAGNN_PALLAS_SEGMENT=0/1), where an unrecognized token must mean
+  "deny", never "force".
+- ``env_int`` / ``env_float``: numeric with a default; a malformed value
+  WARNS and falls back instead of crashing (the DDSTORE_RETRIES class).
+- ``env_str``: raw string passthrough (paths, host:port addresses,
+  fault-point specs whose grammar belongs to the consumer).
+
+Every helper funnels through ``env_str`` so the census has exactly one
+syscall site to audit.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Optional
+
+ENV_PREFIX = "HYDRAGNN_"
+
+_FALSY = ("0", "off", "false", "")
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw env read — the single ``os.environ`` touch point for the
+    HYDRAGNN_* channel (and the helper consumers use for path/spec-valued
+    flags whose grammar is their own)."""
+    return os.environ.get(name, default)
+
+
+def env_set(name: str) -> bool:
+    """Whether the flag is present at all (some fault points are armed by
+    existence, parsed later by their own grammar)."""
+    return env_str(name) is not None
+
+
+def env_flag(name: str) -> Optional[bool]:
+    """Tri-state boolean: None when unset, else False for the falsy
+    tokens (``0``/``off``/``false``/empty, case-insensitive) and True
+    otherwise — ONE spelling for every HYDRAGNN_* on/off override
+    (HYDRAGNN_TELEMETRY, HYDRAGNN_NUMERICS, HYDRAGNN_DOCTOR, ...), so the
+    overrides cannot drift between entry points."""
+    v = env_str(name)
+    if v is None:
+        return None
+    return v.strip().lower() not in _FALSY
+
+
+def env_force(name: str) -> Optional[bool]:
+    """Tri-state force/deny preference: None when unset, True for exactly
+    ``"1"``, anything else False. The kernel-route override grammar
+    (HYDRAGNN_PALLAS_SEGMENT / _FLASH / _MULTIAGG / MACE_DENSE_CG ...):
+    an unrecognized token denies the special route — falling back to the
+    reference path is always correct, force-enabling it is not."""
+    v = env_str(name)
+    if v is None:
+        return None
+    return v == "1"
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer env value; a malformed value warns and returns ``default``
+    instead of raising — a typo'd knob must degrade the feature, never
+    crash the run (the HYDRAGNN_DDSTORE_RETRIES incident class)."""
+    v = env_str(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        warnings.warn(
+            f"{name}={v!r} is not an integer; using the default "
+            f"{default!r} instead",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env value with the same malformed-value fallback contract as
+    ``env_int``."""
+    v = env_str(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        warnings.warn(
+            f"{name}={v!r} is not a number; using the default "
+            f"{default!r} instead",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return default

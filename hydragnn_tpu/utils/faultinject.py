@@ -85,6 +85,8 @@ import os
 import signal
 from typing import Dict, Optional
 
+from . import envflags
+
 # per-point counters for maybe_ioerror (per process — checkpoint saves run
 # in-process, so a counter here is exactly "the first n attempts")
 _io_error_counts: Dict[str, int] = {}
@@ -130,7 +132,7 @@ def reset() -> None:
 
 
 def _get(key: str) -> Optional[str]:
-    env = os.environ.get(key)
+    env = envflags.env_str(key)
     return env if env is not None else _config.get(key)
 
 

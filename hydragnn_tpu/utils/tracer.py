@@ -16,9 +16,9 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import os
 import time
 from typing import Dict, Optional
+from . import envflags
 
 _enabled = False
 _regions: Dict[str, Dict[str, float]] = {}
@@ -48,7 +48,7 @@ def _sync_devices() -> None:
 
 
 def _trace_level() -> int:
-    return int(os.getenv("HYDRAGNN_TRACE_LEVEL", "0"))
+    return envflags.env_int("HYDRAGNN_TRACE_LEVEL", 0)
 
 
 def initialize() -> None:

@@ -25,6 +25,18 @@ else
   export HYDRAGNN_CI_FAST=1
 fi
 
+# fast pre-test gate: graftlint static analysis, BASELINE-FREE by design —
+# the committed tree must be at zero unwaived findings (every violation is
+# fixed or carries an in-source pragma with a written reason). --baseline
+# exists only for local incremental burn-downs (docs/ANALYSIS.md).
+echo "== graftlint static-analysis gate (baseline-free) =="
+python -m hydragnn_tpu.analysis --json > logs/graftlint_ci.json 2>/dev/null || {
+  echo "graftlint gate RED — findings:" >&2
+  python -m hydragnn_tpu.analysis >&2 || true
+  exit 1
+}
+echo "graftlint gate green ($(python -c "import json;print(json.load(open('logs/graftlint_ci.json'))['summary']['waived'])") waived)"
+
 echo "== $TIER suite (8-device CPU mesh) =="
 python -m pytest tests/ -x -q --deselect tests/test_multihost.py "$@"
 

@@ -47,15 +47,24 @@ def _client(port, **kw):
 
 def pytest_env_knobs_tolerate_malformed_values(monkeypatch):
     # a robustness knob must not itself be a run-killer: malformed env
-    # values fall back to the defaults instead of crashing client __init__
-    from hydragnn_tpu.data.ddstore import _env_float, _env_int
+    # values fall back to the defaults instead of crashing client __init__.
+    # Since r15 the parse lives in the ONE shared boundary every module
+    # uses (utils/envflags.py, enforced by analysis/env_census.py), and a
+    # malformed value additionally warns so the typo is attributable.
+    import warnings
+
+    from hydragnn_tpu.utils.envflags import env_float, env_int
 
     monkeypatch.setenv("HYDRAGNN_DDSTORE_RETRIES", "four")
     monkeypatch.setenv("HYDRAGNN_DDSTORE_TIMEOUT", "soon")
-    assert _env_int("HYDRAGNN_DDSTORE_RETRIES", 4) == 4
-    assert _env_float("HYDRAGNN_DDSTORE_TIMEOUT", 30.0) == 30.0
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert env_int("HYDRAGNN_DDSTORE_RETRIES", 4) == 4
+        assert env_float("HYDRAGNN_DDSTORE_TIMEOUT", 30.0) == 30.0
+    assert len(caught) == 2
+    assert "HYDRAGNN_DDSTORE_RETRIES='four'" in str(caught[0].message)
     monkeypatch.setenv("HYDRAGNN_DDSTORE_RETRIES", "7")
-    assert _env_int("HYDRAGNN_DDSTORE_RETRIES", 4) == 7
+    assert env_int("HYDRAGNN_DDSTORE_RETRIES", 4) == 7
 
 
 def pytest_remote_fetch_roundtrip():
