@@ -222,6 +222,7 @@ def checkers() -> List[Checker]:
         fault_coverage,
         obs_contract,
         threads,
+        tile_constants,
         trace_hazard,
     )
 

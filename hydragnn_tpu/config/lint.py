@@ -143,6 +143,9 @@ _HANDLED = {
     "NeuralNetwork.Training.compile_cache_dir",
     "NeuralNetwork.Training.precompile",
     "NeuralNetwork.Training.retrace_policy",
+    "NeuralNetwork.Training.autotune",
+    "NeuralNetwork.Training.autotune_budget",
+    "NeuralNetwork.Training.autotune_cache_dir",
     "NeuralNetwork.Training.compute_grad_energy",
     "NeuralNetwork.Training.conv_checkpointing",
     "NeuralNetwork.Training.remat_policy",
@@ -174,6 +177,7 @@ _HANDLED = {
     "Serving.drain_timeout_s",
     "Serving.http_port",
     "Serving.http_host",
+    "Serving.weights_dtype",
     "Telemetry.enabled",
     "Telemetry.interval_steps",
     "Telemetry.http_port",

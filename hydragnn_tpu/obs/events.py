@@ -56,6 +56,7 @@ EV_FLEET_STRAGGLER = "fleet_straggler"    # fleet watchdog flagged a slow host
 EV_FLEET_DESYNC = "fleet_desync"          # step progress skewed past the bound
 EV_FLEET_HOST_STALE = "fleet_host_stale"  # host heartbeat missing past timeout
 EV_SHARDING_AUDIT = "sharding_audit"      # inspector flagged an over-replicated leaf
+EV_TILE_PLAN = "tile_plan"                # kernel tile-plan choice (tune/runtime.py)
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
@@ -65,7 +66,7 @@ EVENT_KINDS = (
     EV_MIX_SOURCE_ADD, EV_MIX_SOURCE_REMOVE, EV_MIX_DEMOTE, EV_MIX_DRIFT,
     EV_NUMERICS_PROVENANCE,
     EV_FLEET_STRAGGLER, EV_FLEET_DESYNC, EV_FLEET_HOST_STALE,
-    EV_SHARDING_AUDIT,
+    EV_SHARDING_AUDIT, EV_TILE_PLAN,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")
@@ -102,6 +103,7 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     EV_FLEET_DESYNC: "error",
     EV_FLEET_HOST_STALE: "warn",
     EV_SHARDING_AUDIT: "warn",
+    EV_TILE_PLAN: "info",
 }
 
 

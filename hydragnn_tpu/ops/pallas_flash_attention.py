@@ -67,6 +67,22 @@ from .pallas_segment import _pad_to
 # the kernel and the jnp references so their masked maxima agree exactly
 _NEG = -1.0e30
 
+# tuned-table key component (tune/table.py): bump on any change to the
+# kernel's schedule, block layout, or semantics — stale tuned entries must
+# miss, not steer a different program
+KERNEL_VERSION = 1
+
+
+def normalize_tiles(block_q=128, block_k=128):
+    """Snap a candidate tile plan to the kernel's alignment contract —
+    ``block_q`` to the 16-row sublane tile (covers bf16), ``block_k`` to
+    the 128-lane tile. The one clamp site shared by the routing layer and
+    the tune plane's table keys (tune/plans.py); the kernel itself requires
+    already-aligned blocks."""
+    bq = max(16, block_q - block_q % 16)
+    bk = max(128, block_k - block_k % 128)
+    return bq, bk
+
 
 def _flash_route_enabled() -> bool:
     """Whether GPS attention routes to the Pallas flash kernel.

@@ -116,21 +116,27 @@ def _kernel(estart_ref, ids_ref, nrecv_ref, ein_ref, w_ref, b_ref, out_ref):
     )
 
 
-def _forward(
-    node_recv, edge_in, weights, bias, segment_ids, num_segments, max_degree,
-    block_rows, block_edges, block_cols, interpret,
-):
-    e, ci = edge_in.shape
-    ci_w, co = weights.shape
-    assert ci_w == ci, (ci_w, ci)
-    assert node_recv.shape[1] == ci, (node_recv.shape, ci)
-    nb, eb = block_rows, block_edges
-    dtype = edge_in.dtype
+# tuned-table key component (tune/table.py): bump on any change to the
+# kernel's schedule, block layout, or semantics — stale tuned entries must
+# miss, not steer a different program
+KERNEL_VERSION = 1
 
-    # channel padding: input width streams whole (the dense contracts over
-    # it). Output width: ONE block when it fits a lane-aligned <=1024 tile
-    # (the production hidden 866 -> 896, no pad waste and no re-streaming
-    # of the edge operand per output block); otherwise block_cols-blocks.
+
+def normalize_tiles(
+    ci, co, dtype,
+    block_rows=128, block_edges=512, block_cols=512,
+):
+    """Clamp a candidate tile plan to what ``_forward`` will actually run —
+    the one clamp site, shared by the kernel, the routing layer (so nondiff
+    specialization args are pre-clamped) and the tune plane's table keys
+    (tune/plans.py).
+
+    Channel padding: input width streams whole (the dense contracts over
+    it). Output width: ONE block when it fits a lane-aligned <=1024 tile
+    (the production hidden 866 -> 896, no pad waste and no re-streaming
+    of the edge operand per output block); otherwise block_cols-blocks.
+    """
+    nb, eb = block_rows, block_edges
     ci_pad = ci + (-ci) % 128
     co128 = co + (-co) % 128
     cb = co128 if co128 <= 1024 else min(block_cols, co128)
@@ -155,6 +161,22 @@ def _forward(
 
     while eb > 128 and _vmem_estimate(eb) > 12 * 1024 * 1024:
         eb //= 2
+    return nb, eb, cb
+
+
+def _forward(
+    node_recv, edge_in, weights, bias, segment_ids, num_segments, max_degree,
+    block_rows, block_edges, block_cols, interpret,
+):
+    e, ci = edge_in.shape
+    ci_w, co = weights.shape
+    assert ci_w == ci, (ci_w, ci)
+    assert node_recv.shape[1] == ci, (node_recv.shape, ci)
+    dtype = edge_in.dtype
+    ci_pad = ci + (-ci) % 128
+    nb, eb, cb = normalize_tiles(
+        ci, co, dtype, block_rows, block_edges, block_cols,
+    )
     ids = segment_ids.astype(jnp.int32)
     ein = _pad_to(_pad_to(edge_in, eb, 0), 128, 1)
     nrecv = _pad_to(_pad_to(node_recv, nb, 0), 128, 1)

@@ -183,20 +183,27 @@ def _make_kernel(has_recv: bool, has_gate: bool, chunk: int):
     return kernel
 
 
-def _forward(
-    node_recv, edge_in, gate, segment_ids, num_segments, max_degree,
-    block_rows, block_edges, block_cols, chunk_edges, interpret,
-):
-    e, c = edge_in.shape
-    nb, eb = block_rows, block_edges
-    dtype = edge_in.dtype
-    has_recv = node_recv is not None
-    has_gate = gate is not None
-    if has_recv:
-        assert node_recv.shape[1] == c, (node_recv.shape, c)
-    if has_gate:
-        assert gate.shape == edge_in.shape, (gate.shape, edge_in.shape)
+# tuned-table key component (tune/table.py): bump on any change to the
+# kernel's schedule, block layout, or semantics — stale tuned entries must
+# miss, not steer a different program
+KERNEL_VERSION = 1
 
+
+def normalize_tiles(
+    c, dtype, has_recv, has_gate,
+    block_rows=128, block_edges=512, block_cols=128, chunk_edges=32,
+):
+    """Clamp a candidate tile plan to what ``_forward`` will actually run:
+    ``block_cols`` to the lane-padded channel width, ``block_edges`` by the
+    VMEM-fit shrink loop, ``chunk_edges`` to the surviving edge window.
+
+    This is the one clamp site — ``_forward`` consumes its result, and the
+    routing layer (ops/segment.py) normalizes BEFORE the values become
+    ``custom_jvp`` nondiff args, so equivalent plans share one jit
+    specialization instead of keying the executable cache on the unclamped
+    request (tune/plans.py builds tuned-table keys from the same values).
+    """
+    nb, eb = block_rows, block_edges
     c128 = c + (-c) % 128
     cb = min(block_cols, c128)
     chunk = min(chunk_edges, eb)
@@ -220,6 +227,26 @@ def _forward(
     while eb > 128 and _vmem_estimate(eb) > 12 * 1024 * 1024:
         eb //= 2
     chunk = min(chunk, eb)
+    return nb, eb, cb, chunk
+
+
+def _forward(
+    node_recv, edge_in, gate, segment_ids, num_segments, max_degree,
+    block_rows, block_edges, block_cols, chunk_edges, interpret,
+):
+    e, c = edge_in.shape
+    dtype = edge_in.dtype
+    has_recv = node_recv is not None
+    has_gate = gate is not None
+    if has_recv:
+        assert node_recv.shape[1] == c, (node_recv.shape, c)
+    if has_gate:
+        assert gate.shape == edge_in.shape, (gate.shape, edge_in.shape)
+
+    nb, eb, cb, chunk = normalize_tiles(
+        c, dtype, has_recv, has_gate,
+        block_rows, block_edges, block_cols, chunk_edges,
+    )
 
     ids = segment_ids.astype(jnp.int32)
     ein = _pad_to(_pad_to(edge_in, eb, 0), cb, 1)

@@ -106,13 +106,27 @@ def sorted_segment_sum(
     )
 
 
+# tuned-table key component (tune/table.py): bump on any change to the
+# kernel's schedule, block layout, or semantics — stale tuned entries must
+# miss, not steer a different program
+KERNEL_VERSION = 1
+
+
+def normalize_tiles(c, block_rows=128, block_edges=512, block_cols=512):
+    """Clamp a candidate tile plan to what ``_forward`` will actually run
+    (``block_cols`` never exceeds the lane-padded channel width) — the one
+    clamp site, shared by the kernel, the routing layer (so nondiff
+    specialization args are pre-clamped) and the tune plane's table keys
+    (tune/plans.py)."""
+    return block_rows, block_edges, min(block_cols, max(c, 128))
+
+
 def _forward(
     messages, segment_ids, num_segments, max_degree, block_rows, block_edges,
     block_cols, interpret,
 ):
     e, c = messages.shape
-    nb, eb, cb = block_rows, block_edges, block_cols
-    cb = min(cb, max(c, 128))
+    nb, eb, cb = normalize_tiles(c, block_rows, block_edges, block_cols)
     dtype = messages.dtype
 
     ids = segment_ids.astype(jnp.int32)

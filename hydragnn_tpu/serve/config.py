@@ -59,6 +59,11 @@ class ServeConfig:
     drain_timeout_s: float = 30.0
     http_port: int = 0
     http_host: str = "127.0.0.1"
+    # reduced-precision serving (docs/SERVING.md): "bfloat16" casts the
+    # restored InferenceState's floating params once at install (halved
+    # weight HBM + bf16 MXU streams); batch stats stay f32. Applied to hot
+    # reloads too. Default keeps the checkpoint's own precision.
+    weights_dtype: str = "float32"
 
     _KNOWN = (
         "max_queue_requests",
@@ -74,7 +79,10 @@ class ServeConfig:
         "drain_timeout_s",
         "http_port",
         "http_host",
+        "weights_dtype",
     )
+
+    WEIGHTS_DTYPES = ("float32", "bfloat16")
 
     def __post_init__(self):
         from ..train.compile_plane import RETRACE_POLICIES
@@ -106,6 +114,11 @@ class ServeConfig:
             raise ValueError(
                 f"Serving.http_host must be a non-empty bind address, got "
                 f"{self.http_host!r}"
+            )
+        if self.weights_dtype not in ServeConfig.WEIGHTS_DTYPES:
+            raise ValueError(
+                f"Serving.weights_dtype {self.weights_dtype!r} must be one "
+                f"of {ServeConfig.WEIGHTS_DTYPES}"
             )
 
     @staticmethod

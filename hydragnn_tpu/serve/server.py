@@ -268,7 +268,7 @@ class GraphServer:
         self.mixed_precision = mixed_precision
         self.sort_edges = sort_edges
         self.current_checkpoint = checkpoint_label
-        self._state = state
+        self._state = self._cast_weights(state)
         templates = [_strip_targets(g) for g in template_graphs]
         clean = [g for g in templates if validate_graph(g) is None]
         if not clean:
@@ -1118,12 +1118,23 @@ class GraphServer:
                 return
             self._fail_request(req.handle, err)
 
+    def _cast_weights(self, state):
+        """Apply ``Serving.weights_dtype`` to an incoming state — the one
+        precision gate for both the startup restore and every hot-reload
+        swap, so a reloaded checkpoint cannot silently revert the server
+        to f32 weights."""
+        if self.cfg.weights_dtype == "float32":
+            return state
+        from ..train.state import cast_inference_weights
+
+        return cast_inference_weights(state, self.cfg.weights_dtype)
+
     def _install_state(self, state, label: Optional[str]) -> None:
         """Stage a reloaded state; the serve loop swaps it in at the next
         batch boundary (in-flight batches keep the weights they started
         with)."""
         with self._swap_lock:
-            self._pending_state = (state, label)
+            self._pending_state = (self._cast_weights(state), label)
 
     def _bump(self, key: str, by: int = 1) -> None:
         with self._stats_lock:

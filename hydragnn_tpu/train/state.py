@@ -82,6 +82,28 @@ class InferenceState:
         return v
 
 
+def cast_inference_weights(state, dtype):
+    """Cast the state's floating-point params to ``dtype`` (the
+    ``Serving.weights_dtype: bfloat16`` reduced-precision serving step —
+    halved weight HBM and bf16 MXU streams at inference).
+
+    Batch stats keep f32: they are running moments, and bf16 quantizing
+    them shifts normalization statistics for no bandwidth win (they are
+    a rounding error of the params' footprint). Integer/bool leaves pass
+    through. Works on ``InferenceState`` and ``TrainState`` alike (the
+    orbax restore path serves a full TrainState; its optimizer moments
+    are dead at inference either way)."""
+    dt = jax.numpy.dtype(dtype)
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jax.numpy.issubdtype(x.dtype, jax.numpy.floating):
+            return x.astype(dt)
+        return x
+
+    params = jax.tree_util.tree_map(_cast, state.params)
+    return state.replace(params=params)
+
+
 @dataclasses.dataclass(frozen=True)
 class LoaderState:
     """Sampler/loader position serialized beside the TrainState checkpoint

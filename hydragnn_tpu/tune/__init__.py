@@ -1,0 +1,28 @@
+"""Kernel autotuning plane (docs/TUNING.md).
+
+Per-(kernel id + version, device kind, ladder spec slot, dtype) tile
+sweeps with a content-addressed tuned-table cache, retiring the
+hand-picked Pallas block constants:
+
+- tune/plans.py — what is tunable: per-kernel params, pinned defaults,
+  candidate grids, and the shared normalization (the kernel's own clamp,
+  applied before a plan becomes a jit or table key);
+- tune/table.py — the sha256-keyed on-disk table (atomic publishes,
+  corrupt entries degrade to defaults);
+- tune/sweep.py — the offline sweep: bench-discipline medians over
+  normalized candidates on shape-exact synthetic operands;
+- tune/runtime.py — the process-global lookup the kernel routing layer
+  consults (``tile_plan``), with the choice emitted for the run doctor;
+- ``python -m hydragnn_tpu.tune`` — the offline CLI over a config's full
+  SpecLadder (interpret-mode on CPU, so CI exercises the plane end to
+  end).
+
+``Training.autotune`` (off | cached | sweep) threads the plane through
+train warm-up and serve startup (docs/CONFIG.md).
+"""
+
+from . import plans, runtime, sweep, table  # noqa: F401
+from .plans import KERNELS, candidates, default_plan, normalize  # noqa: F401
+from .runtime import deactivate, install, setup_autotune, tile_plan  # noqa: F401
+from .table import TunedTable, device_kind, resolve_tune_cache  # noqa: F401
+from .sweep import config_slots, sweep_kernel, sweep_slots  # noqa: F401

@@ -1,0 +1,205 @@
+"""Process-global tuned-table runtime: what the kernel routing layer asks.
+
+The routing layer (ops/segment.py, models/gps.py, parallel/
+ring_attention.py) cannot see the config — it runs at trace time inside
+jitted model code. So the train/serve entry points *install* the resolved
+tuned table here (``train/loop.py`` warm-up, ``serve/server.py`` startup,
+the ``python -m hydragnn_tpu.tune`` CLI), and every kernel call site asks
+:func:`tile_plan` for its block constants:
+
+    tuned-table entry for (kernel+version, device kind, dtype, shapes)
+        -> the swept winner
+    no entry / no table / autotune off
+        -> the pinned defaults, normalized — bit-identical to the
+           pre-tune-plane behavior (the kernel applied the same clamp
+           internally; only the jit cache key is now the clamped value)
+
+Either way the choice is emitted once per (key, source) as an
+``EV_TILE_PLAN`` telemetry event and counted in
+``hydragnn_tune_lookups_total{kernel,source}``, so the run doctor can
+flag TPU runs still riding defaults (obs/doctor.py ``untuned_kernel``).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from . import plans
+from .table import TunedTable, device_kind
+
+MODES = ("off", "cached", "sweep")
+
+_lock = threading.Lock()
+_active: Optional[TunedTable] = None
+_mode: str = "off"
+# (sha-key, source) pairs already announced — dedups the choice event and
+# counter across retraces/re-lookups of the same specialization
+_announced: set = set()
+
+
+def install(table: Optional[TunedTable], mode: str = "cached") -> None:
+    """Make ``table`` the process-wide tuned table (None deactivates).
+    Last install wins — one live run per process, the same contract as the
+    tracer/event-sink installs."""
+    global _active, _mode
+    if mode not in MODES:
+        raise ValueError(f"autotune mode {mode!r} must be one of {MODES}")
+    with _lock:
+        _active = table if mode != "off" else None
+        _mode = mode
+        _announced.clear()
+    if table is not None and mode != "off":
+        _entries_gauge().set(float(table.size()))
+
+
+def deactivate() -> None:
+    install(None, "off")
+
+
+def active() -> Optional[TunedTable]:
+    return _active
+
+
+def mode() -> str:
+    return _mode
+
+
+def _entries_gauge():
+    from ..obs.registry import registry
+
+    return registry().gauge(
+        "hydragnn_tune_table_entries",
+        "Tuned-table entries on disk for the installed table "
+        "(docs/TUNING.md)",
+    )
+
+
+def _lookup_counter():
+    from ..obs.registry import registry
+
+    return registry().counter(
+        "hydragnn_tune_lookups_total",
+        "Tile-plan lookups by kernel and winning source "
+        "(tuned = table entry, default = pinned fallback)",
+        labelnames=("kernel", "source"),
+    )
+
+
+def tile_plan(
+    kernel: str,
+    shapes: Dict[str, Any],
+    dtype: Any = "float32",
+) -> Dict[str, int]:
+    """The block constants this kernel call should run with.
+
+    ``shapes`` is the kernel's shape signature — every static fact that
+    distinguishes tuned entries (pad-spec sizes, channel widths, operand
+    census; see tune/plans.py ``normalize`` for the per-kernel fields) —
+    and doubles as the normalization input. ``dtype`` is the streaming
+    operand dtype (its own table axis: bf16 tiles do not transfer to f32).
+
+    Always returns a normalized plan; never raises on table trouble (a
+    corrupt entry warns inside TunedTable and falls through to defaults).
+    """
+    dt = str(dtype)
+    spec = plans.KERNELS[kernel]
+    table = _active
+    tuned: Optional[Dict[str, int]] = None
+    if table is not None:
+        tuned = table.lookup(
+            kernel, spec.version, device_kind(), dt, _shape_key(shapes)
+        )
+    source = "tuned" if tuned else "default"
+    plan = plans.normalize(kernel, tuned or spec.defaults, shapes)
+    _announce(kernel, dt, shapes, plan, source)
+    return plan
+
+
+def _shape_key(shapes: Dict[str, Any]) -> Dict[str, Any]:
+    """The table-key view of a shape signature: scalars only, canonical
+    types (bools stay bools, numbers become ints, anything else strs)."""
+    out: Dict[str, Any] = {}
+    for k, v in shapes.items():
+        if isinstance(v, bool):
+            out[k] = v
+        elif isinstance(v, (int, float)):
+            out[k] = int(v)
+        else:
+            out[k] = str(v)
+    return out
+
+
+def setup_autotune(config: Dict[str, Any], loader=None,
+                   log_name: Optional[str] = None) -> Optional[str]:
+    """Resolve and install the run's tuned table per ``Training.autotune``
+    — the entry-point hook train warm-up and serve startup call BEFORE any
+    jit trace, so every kernel route's ``tile_plan`` lookup sees it.
+
+    ``off`` deactivates (pinned defaults, no lookups); ``cached`` installs
+    the resolved table read-only (missing entries fall back to defaults);
+    ``sweep`` first fills missing entries for the config's ladder slots
+    (budget-capped, ``loader.ladder`` supplies the pad levels) and then
+    installs. Returns the active table directory, or None.
+    """
+    import warnings
+
+    from .table import resolve_tune_cache
+
+    training = config["NeuralNetwork"]["Training"]
+    autotune = str(training.get("autotune", "cached"))
+    if autotune == "off":
+        deactivate()
+        return None
+    cache_dir = resolve_tune_cache(training, log_name)
+    if not cache_dir:
+        deactivate()
+        return None
+    table = TunedTable(cache_dir)
+    if autotune == "sweep":
+        from .sweep import config_slots, sweep_slots
+
+        ladder = getattr(loader, "ladder", None)
+        slots = config_slots(config, ladder) if ladder is not None else []
+        if slots:
+            try:
+                sweep_slots(
+                    slots, table,
+                    budget=int(training.get("autotune_budget") or 0),
+                )
+            except Exception as e:
+                warnings.warn(
+                    f"autotune sweep failed ({e}); continuing with the "
+                    "existing tuned table",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+    install(table, autotune)
+    return cache_dir
+
+
+def _announce(kernel: str, dtype: str, shapes: Dict[str, Any],
+              plan: Dict[str, int], source: str) -> None:
+    sig: Tuple = (kernel, dtype, tuple(sorted(_shape_key(shapes).items())),
+                  source)
+    with _lock:
+        if sig in _announced:
+            return
+        _announced.add(sig)
+    try:
+        from ..obs.events import EV_TILE_PLAN, emit
+
+        emit(
+            EV_TILE_PLAN,
+            kernel=kernel,
+            source=source,
+            mode=_mode,
+            device=device_kind(),
+            dtype=dtype,
+            plan=json.dumps(plan, sort_keys=True),
+            shape=json.dumps(_shape_key(shapes), sort_keys=True),
+        )
+        _lookup_counter().inc(kernel=kernel, source=source)
+    except Exception:
+        pass  # the choice reporter must never fail the kernel call

@@ -530,6 +530,56 @@ def pytest_serve_config_validation():
         ServeConfig.from_config({"Serving": {"no_such_knob": 1}})
 
 
+def pytest_serve_config_weights_dtype_validated():
+    with pytest.raises(ValueError, match="weights_dtype"):
+        ServeConfig(weights_dtype="float16")
+    assert ServeConfig(weights_dtype="bfloat16").weights_dtype == "bfloat16"
+    assert ServeConfig().weights_dtype == "float32"  # default: no cast
+
+
+def pytest_bf16_weights_cast_applies_to_params_only(serve_world):
+    import jax
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.train.state import cast_inference_weights
+
+    cfg, model, state, ladder, ready = serve_world
+    cast = cast_inference_weights(state, "bfloat16")
+    p_dtypes = {x.dtype for x in jax.tree_util.tree_leaves(cast.params)
+                if jnp.issubdtype(x.dtype, jnp.floating)}
+    assert p_dtypes == {jnp.dtype(jnp.bfloat16)}, p_dtypes
+    # the original state is untouched (functional cast)
+    assert all(
+        x.dtype != jnp.bfloat16
+        for x in jax.tree_util.tree_leaves(state.params)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+    )
+    # a server built with weights_dtype=bfloat16 holds the cast state and
+    # still answers close to the f32 reference
+    server = _server(serve_world, serve_config=ServeConfig(
+        micro_batch_graphs=8, batch_window_s=0.005, step_timeout_s=20.0,
+        weights_dtype="bfloat16",
+    )).start()
+    try:
+        assert server.wait_ready(120), f"warm-up failed: {server.failed}"
+        held = {x.dtype for x in
+                jax.tree_util.tree_leaves(server._state.params)
+                if jnp.issubdtype(x.dtype, jnp.floating)}
+        assert held == {jnp.dtype(jnp.bfloat16)}
+        g = ready[3]
+        result = server.submit(g).result(30)
+        spec = ladder.select_for([g])
+        batch = batch_graphs([dataclasses.replace(
+            g, graph_targets=None, node_targets=None, graph_y=None)], spec)
+        direct = jax.device_get(
+            model.apply(state.variables(), batch, train=False))
+        np.testing.assert_allclose(
+            result["s"], np.asarray(direct["s"])[0], rtol=0.05, atol=0.05
+        )
+    finally:
+        server.close(drain=False)
+
+
 def pytest_update_config_validates_serving_section():
     cfg = _config()
     cfg["Serving"] = {"retrace_policy": "bogus"}

@@ -1,0 +1,423 @@
+"""Tuned-table cache + autotune runtime tests (docs/TUNING.md).
+
+The contracts proven here are the plane's safety story:
+
+- invalidation lives entirely in the content-addressed key — a kernel
+  version bump, a different device kind, a dtype or shape change each
+  land on a different sha256, so stale entries never match;
+- a corrupt/hand-edited/schema-drifted entry degrades to pinned defaults
+  with a warning naming the repair CLI — never an exception;
+- concurrent writers race safely through the atomic tmp+fsync+replace
+  publish (readers never observe a torn entry);
+- the tuned-vs-default regression: routing a kernel through a tuned plan
+  must be bit-identical to the pinned-default plan (tiles change the
+  schedule, never the math), and with no table installed ``tile_plan``
+  returns exactly the normalized defaults.
+"""
+
+import json
+import os
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.tune import plans
+from hydragnn_tpu.tune.runtime import (
+    deactivate,
+    install,
+    setup_autotune,
+    tile_plan,
+)
+from hydragnn_tpu.tune.sweep import config_slots, sweep_kernel
+from hydragnn_tpu.tune.table import (
+    TABLE_SCHEMA_VERSION,
+    TunedTable,
+    device_kind,
+    entry_key,
+    resolve_tune_cache,
+)
+
+SHAPE = {"edges": 64, "channels": 8, "num_segments": 16, "max_degree": 8}
+
+
+@pytest.fixture(autouse=True)
+def _no_table_leak():
+    deactivate()
+    yield
+    deactivate()
+
+
+# ---------------------------------------------------------------------------
+# content-addressed keys: invalidation is the key
+# ---------------------------------------------------------------------------
+
+def pytest_entry_key_changes_on_every_axis():
+    base = entry_key("segment_sum", 1, "TPU v4", "float32", SHAPE)
+    assert base == entry_key("segment_sum", 1, "TPU v4", "float32", dict(SHAPE))
+    bumped = {
+        "version": entry_key("segment_sum", 2, "TPU v4", "float32", SHAPE),
+        "device": entry_key("segment_sum", 1, "TPU v5e", "float32", SHAPE),
+        "dtype": entry_key("segment_sum", 1, "TPU v4", "bfloat16", SHAPE),
+        "shape": entry_key("segment_sum", 1, "TPU v4", "float32",
+                           {**SHAPE, "edges": 128}),
+        "kernel": entry_key("multi_agg", 1, "TPU v4", "float32", SHAPE),
+    }
+    assert len({base, *bumped.values()}) == 6, bumped
+
+
+def pytest_store_then_lookup_roundtrips_through_disk(tmp_path):
+    t = TunedTable(str(tmp_path))
+    plan = {"block_rows": 64, "block_edges": 256, "block_cols": 128}
+    path = t.store("segment_sum", 1, "cpu", "float32", SHAPE, plan,
+                   measured_us=12.5, meta={"candidates": 3})
+    assert os.path.isfile(path) and not any(
+        f.endswith(".tmp") for f in os.listdir(tmp_path))
+    # a FRESH table instance (no memo) must read it back from disk
+    assert TunedTable(str(tmp_path)).lookup(
+        "segment_sum", 1, "cpu", "float32", SHAPE) == plan
+    assert t.size() == 1
+
+
+def pytest_stale_entries_never_match(tmp_path):
+    t = TunedTable(str(tmp_path))
+    plan = {"block_rows": 64, "block_edges": 256, "block_cols": 128}
+    t.store("segment_sum", 1, "cpu", "float32", SHAPE, plan)
+    # the v1 entry is inert, not wrong, under every axis change
+    assert t.lookup("segment_sum", 2, "cpu", "float32", SHAPE) is None
+    assert t.lookup("segment_sum", 1, "TPU v4", "float32", SHAPE) is None
+    assert t.lookup("segment_sum", 1, "cpu", "bfloat16", SHAPE) is None
+    assert t.lookup("segment_sum", 1, "cpu", "float32",
+                    {**SHAPE, "channels": 16}) is None
+    assert t.lookup("segment_sum", 1, "cpu", "float32", SHAPE) == plan
+
+
+# ---------------------------------------------------------------------------
+# degradation: corrupt entries read as absent, never raise
+# ---------------------------------------------------------------------------
+
+def pytest_corrupt_json_degrades_to_defaults_with_warning(tmp_path):
+    t = TunedTable(str(tmp_path))
+    key = entry_key("segment_sum", 1, "cpu", "float32", SHAPE)
+    os.makedirs(tmp_path, exist_ok=True)
+    (tmp_path / f"{key}.json").write_text("{ torn mid-write")
+    with pytest.warns(RuntimeWarning, match="python -m hydragnn_tpu.tune"):
+        assert t.lookup("segment_sum", 1, "cpu", "float32", SHAPE) is None
+    # the miss is memoized: a second lookup is silent and still None
+    assert t.lookup("segment_sum", 1, "cpu", "float32", SHAPE) is None
+
+
+def pytest_hand_edited_entry_fails_self_validation(tmp_path):
+    t = TunedTable(str(tmp_path))
+    plan = {"block_rows": 64, "block_edges": 256, "block_cols": 128}
+    path = t.store("segment_sum", 1, "cpu", "float32", SHAPE, plan)
+    entry = json.loads(open(path).read())
+    entry["key_fields"]["dtype"] = "bfloat16"  # fields drifted from filename
+    with open(path, "w") as fh:
+        json.dump(entry, fh)
+    with pytest.warns(RuntimeWarning, match="failed validation"):
+        assert TunedTable(str(tmp_path)).lookup(
+            "segment_sum", 1, "cpu", "float32", SHAPE) is None
+
+
+def pytest_schema_version_mismatch_reads_as_absent(tmp_path):
+    t = TunedTable(str(tmp_path))
+    plan = {"block_rows": 64, "block_edges": 256, "block_cols": 128}
+    path = t.store("segment_sum", 1, "cpu", "float32", SHAPE, plan)
+    entry = json.loads(open(path).read())
+    entry["schema"] = TABLE_SCHEMA_VERSION + 1
+    with open(path, "w") as fh:
+        json.dump(entry, fh)
+    with pytest.warns(RuntimeWarning):
+        assert TunedTable(str(tmp_path)).lookup(
+            "segment_sum", 1, "cpu", "float32", SHAPE) is None
+
+
+def pytest_concurrent_writers_race_safely(tmp_path):
+    """N threads publishing the same key: every replace lands a complete
+    file; the survivor is one of the written plans, never a torn mix."""
+    written = [
+        {"block_rows": 64 * (i + 1), "block_edges": 256, "block_cols": 128}
+        for i in range(8)
+    ]
+    errs = []
+
+    def _write(plan):
+        try:
+            TunedTable(str(tmp_path)).store(
+                "segment_sum", 1, "cpu", "float32", SHAPE, plan)
+        except Exception as e:  # noqa: BLE001 — collected for the assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=_write, args=(p,), daemon=True)
+               for p in written]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not errs
+    got = TunedTable(str(tmp_path)).lookup(
+        "segment_sum", 1, "cpu", "float32", SHAPE)
+    assert got in written
+    assert not any(".tmp" in f for f in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# cache-dir resolution grammar (mirrors the compile cache)
+# ---------------------------------------------------------------------------
+
+def pytest_resolve_tune_cache_grammar(monkeypatch):
+    monkeypatch.delenv("HYDRAGNN_TUNE_CACHE", raising=False)
+    assert resolve_tune_cache({}, "runA") == os.path.join(
+        "./logs", "runA", "tuned_table")
+    assert resolve_tune_cache({"autotune_cache_dir": "/x/table"}) == "/x/table"
+    assert resolve_tune_cache({"autotune_cache_dir": False}) is None
+    assert resolve_tune_cache({"autotune_cache_dir": "off"}) is None
+    monkeypatch.setenv("HYDRAGNN_TUNE_CACHE", "0")
+    assert resolve_tune_cache({"autotune_cache_dir": "/x/table"}) is None
+    monkeypatch.setenv("HYDRAGNN_TUNE_CACHE", "/env/table")
+    assert resolve_tune_cache({"autotune_cache_dir": "/x/table"}) == "/env/table"
+    monkeypatch.setenv("HYDRAGNN_TUNE_CACHE", "1")  # force-on beats config off
+    assert resolve_tune_cache({"autotune_cache_dir": False}, "runB") == \
+        os.path.join("./logs", "runB", "tuned_table")
+
+
+# ---------------------------------------------------------------------------
+# runtime: tile_plan routing, normalization, events
+# ---------------------------------------------------------------------------
+
+def pytest_tile_plan_defaults_when_no_table_installed():
+    deactivate()
+    plan = tile_plan("segment_sum", SHAPE, "float32")
+    # exactly the normalized pinned defaults — the pre-plane behavior
+    assert plan == plans.normalize(
+        "segment_sum", plans.KERNELS["segment_sum"].defaults, SHAPE)
+    assert plan["block_cols"] == 128  # clamped for 8 channels
+
+
+def pytest_tile_plan_consults_installed_table_and_normalizes(tmp_path):
+    t = TunedTable(str(tmp_path))
+    # an unclamped tuned plan: block_cols=512 for an 8-channel slot must
+    # come back clamped — the table value is normalized BEFORE it becomes
+    # a jit specialization key (the PR 16 multi_agg bug regression)
+    t.store("segment_sum", plans.kernel_version("segment_sum"),
+            device_kind(), "float32",
+            {k: int(v) for k, v in SHAPE.items()},
+            {"block_rows": 64, "block_edges": 256, "block_cols": 512})
+    install(t, "cached")
+    plan = tile_plan("segment_sum", SHAPE, "float32")
+    assert plan["block_rows"] == 64 and plan["block_edges"] == 256
+    assert plan["block_cols"] == 128  # min(512, max(8, 128))
+    deactivate()
+    assert tile_plan("segment_sum", SHAPE, "float32")["block_rows"] == 128
+
+
+def pytest_tile_plan_emits_choice_event_once_per_key(tmp_path):
+    from hydragnn_tpu.obs.events import events
+
+    deactivate()
+    events().clear()
+    t = TunedTable(str(tmp_path))
+    install(t, "cached")
+    for _ in range(3):  # retraces of one specialization announce once
+        tile_plan("segment_sum", SHAPE, "float32")
+    evs = [e for e in events().snapshot() if e["kind"] == "tile_plan"]
+    assert len(evs) == 1, evs
+    ev = evs[0]
+    assert ev["source"] == "default" and ev["mode"] == "cached"
+    assert ev["kernel"] == "segment_sum" and ev["device"] == device_kind()
+    assert json.loads(ev["plan"])["block_cols"] == 128
+    assert json.loads(ev["shape"])["edges"] == 64
+
+
+# ---------------------------------------------------------------------------
+# sweep: winner persisted, second run is a cache hit
+# ---------------------------------------------------------------------------
+
+def pytest_sweep_kernel_publishes_winner_then_hits_cache(tmp_path):
+    t = TunedTable(str(tmp_path))
+    res = sweep_kernel("segment_sum", SHAPE, "float32", t,
+                       budget=2, trials=1, interpret=True)
+    assert res["cached"] is False and res["candidates"] >= 1
+    assert set(res["plan"]) == {"block_rows", "block_edges", "block_cols"}
+    # second invocation (fresh instance = the CLI's second run): 100% hit
+    res2 = sweep_kernel("segment_sum", SHAPE, "float32",
+                        TunedTable(str(tmp_path)),
+                        budget=2, trials=1, interpret=True)
+    assert res2["cached"] is True and res2["plan"] == res["plan"]
+
+
+def pytest_tuned_and_default_plans_are_bit_identical():
+    """Tiles change the schedule, never the math: the same operands
+    through a non-default plan must match the default plan bit-for-bit
+    (this is what makes the no-table fallback safe by construction)."""
+    import jax.numpy as jnp
+
+    from hydragnn_tpu.ops.pallas_segment import sorted_segment_sum
+
+    rng = np.random.default_rng(7)
+    msg = jnp.asarray(rng.standard_normal((64, 24)), jnp.float32)
+    ids = jnp.asarray(np.minimum(np.arange(64) // 4, 15).astype(np.int32))
+    default = plans.default_plan("segment_sum", {"channels": 24})
+    tuned = plans.normalize(
+        "segment_sum",
+        {"block_rows": 64, "block_edges": 256, "block_cols": 256},
+        {"channels": 24})
+    assert tuned != default
+    out_d = sorted_segment_sum(msg, ids, 16, 8, default["block_rows"],
+                               default["block_edges"], default["block_cols"],
+                               True)
+    out_t = sorted_segment_sum(msg, ids, 16, 8, tuned["block_rows"],
+                               tuned["block_edges"], tuned["block_cols"],
+                               True)
+    assert np.array_equal(np.asarray(out_d), np.asarray(out_t))
+
+
+# ---------------------------------------------------------------------------
+# config plumbing: slots + setup_autotune
+# ---------------------------------------------------------------------------
+
+def _ladder(*levels):
+    return types.SimpleNamespace(specs=[
+        types.SimpleNamespace(n_nodes=n, n_edges=e, n_graphs=2, n_triplets=0)
+        for n, e in levels
+    ])
+
+
+def _full_config(tmp_path):
+    return {
+        "NeuralNetwork": {
+            "Architecture": {
+                "hidden_dim": 16,
+                "max_in_degree": 8,
+                "max_nodes_per_graph": 12,
+                "global_attn_heads": 2,
+                "mpnn_type": "PNA",
+                "use_sorted_aggregation": True,
+                "use_fused_edge_kernel": True,
+                "use_flash_attention": True,
+            },
+            "Training": {
+                "autotune": "cached",
+                "autotune_budget": 2,
+                "autotune_cache_dir": str(tmp_path / "table"),
+            },
+        },
+    }
+
+
+def pytest_config_slots_cover_all_four_kernels(tmp_path):
+    slots = config_slots(_full_config(tmp_path), _ladder((32, 64), (64, 128)))
+    kernels = [k for k, _, _ in slots]
+    assert sorted(set(kernels)) == sorted(
+        ["segment_sum", "fused_edge", "multi_agg", "flash_attention"])
+    assert len(slots) == 8  # 4 kernels x 2 ladder levels
+    # the slot shapes carry the ladder's padded sizes
+    seg = [s for k, s, _ in slots if k == "segment_sum"]
+    assert {s["edges"] for s in seg} == {64, 128}
+    assert all(d == "float32" for _, _, d in slots)
+
+
+def pytest_setup_autotune_modes(tmp_path, monkeypatch):
+    from hydragnn_tpu.tune import runtime
+
+    monkeypatch.delenv("HYDRAGNN_TUNE_CACHE", raising=False)
+    cfg = _full_config(tmp_path)
+    out = setup_autotune(cfg, None, "runT")
+    assert out == str(tmp_path / "table") and runtime.active() is not None
+    assert runtime.mode() == "cached"
+    cfg["NeuralNetwork"]["Training"]["autotune"] = "off"
+    assert setup_autotune(cfg, None, "runT") is None
+    assert runtime.active() is None and runtime.mode() == "off"
+
+
+def pytest_setup_autotune_sweep_fills_table(tmp_path, monkeypatch):
+    from hydragnn_tpu.tune import runtime
+
+    monkeypatch.delenv("HYDRAGNN_TUNE_CACHE", raising=False)
+    cfg = _full_config(tmp_path)
+    cfg["NeuralNetwork"]["Architecture"].update(
+        # keep the inline sweep to the cheapest kernel: tiny segment slots
+        use_fused_edge_kernel=False, use_flash_attention=False,
+        mpnn_type="GIN",
+    )
+    cfg["NeuralNetwork"]["Training"]["autotune"] = "sweep"
+    loader = types.SimpleNamespace(ladder=_ladder((16, 32)))
+    setup_autotune(cfg, loader, "runS")
+    table = runtime.active()
+    assert table is not None and runtime.mode() == "sweep"
+    assert table.size() == 1  # one kernel x one ladder level, swept
+    plan = tile_plan("segment_sum",
+                     {"edges": 32, "channels": 16, "num_segments": 16,
+                      "max_degree": 8}, "float32")
+    assert set(plan) == {"block_rows", "block_edges", "block_cols"}
+
+
+def _completion_config(**training_over):
+    from hydragnn_tpu.data import (
+        VariablesOfInterest,
+        deterministic_graph_dataset,
+        extract_variables,
+        split_dataset,
+    )
+
+    raw = deterministic_graph_dataset(8, seed=97)
+    voi = VariablesOfInterest([0], ["sum_x_x2_x3"], ["graph"], [0],
+                              [1, 1, 1], [1])
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Training": {
+                "num_epoch": 1,
+                "batch_size": 4,
+                "Optimizer": {"learning_rate": 0.01},
+                **training_over,
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+    return config, tr, va, te
+
+
+def pytest_config_completion_defaults_and_validates_autotune():
+    from hydragnn_tpu.config import update_config
+
+    config, tr, va, te = _completion_config()
+    done = update_config(config, tr, va, te)
+    training = done["NeuralNetwork"]["Training"]
+    assert training["autotune"] == "cached"
+    assert training["autotune_budget"] == 32
+    assert training["autotune_cache_dir"] is None
+
+    config, tr, va, te = _completion_config(autotune="aggressive")
+    with pytest.raises(ValueError, match="autotune"):
+        update_config(config, tr, va, te)
+
+    config, tr, va, te = _completion_config(autotune_budget=-1)
+    with pytest.raises(ValueError, match="autotune_budget"):
+        update_config(config, tr, va, te)
