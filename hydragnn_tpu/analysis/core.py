@@ -221,6 +221,7 @@ def checkers() -> List[Checker]:
         error_codes,
         fault_coverage,
         obs_contract,
+        sharding_rules,
         threads,
         tile_constants,
         trace_hazard,

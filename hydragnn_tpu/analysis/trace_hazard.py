@@ -9,8 +9,10 @@ semantics"). The retrace sentinel now catches that class at RUNTIME;
 this checker catches it at REVIEW time, before a run is ever launched.
 
 Scope: the step-builder modules and functions only — the bodies that jit
-traces (``train/loop.py`` ``make_train_step``/``make_eval_step``,
-``parallel/dp.py`` and ``parallel/branch.py`` builders). Inside them:
+traces (``train/loop.py`` ``make_train_step``/``make_eval_step``, the
+rule engine's ``parallel/engine.py`` mesh-step builders, plus the
+``parallel/dp.py``/``parallel/branch.py`` deprecation shims over them).
+Inside them:
 
 - ``.item()``, ``jax.device_get(...)``, ``np.asarray``/``np.array``:
   host syncs — a device round-trip per step inside what must stay a
@@ -36,6 +38,8 @@ CHECKER_ID = "trace_hazard"
 # (module path suffix, builder function names) — the jitted-step surface
 STEP_BUILDERS: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("train/loop.py", ("make_train_step", "make_eval_step")),
+    ("parallel/engine.py", ("make_mesh_train_step", "make_mesh_eval_step")),
+    # deprecation shims — scanned so a hazard can't sneak back in via them
     ("parallel/dp.py", ("make_parallel_train_step", "make_parallel_eval_step")),
     ("parallel/branch.py", (
         "make_branch_parallel_train_step", "make_branch_parallel_eval_step",

@@ -167,6 +167,50 @@ def _wants_zero2_mesh(training: Dict[str, Any]) -> bool:
     return jax.process_count() == 1 and jax.local_device_count() > 1
 
 
+def resolve_parallel(config: Dict[str, Any]):
+    """Resolve the run's sharding rule table (parallel/rules.py) — the ONE
+    placement decision every entry point shares (train / predict / serve,
+    all via prepare_data, and run_training's step selection).
+
+    ``Parallel.rules`` (preset name or inline table) wins; otherwise the
+    table derives from the legacy ``Training`` keys. Validation is EAGER
+    (bad regex / unknown axis / preset-vs-flag conflicts raise here, never
+    from inside a trace), the resolved table is recorded under
+    ``Parallel.resolved_rules`` so the saved run config replays the
+    identical placement on restore, and the legacy gate keys are
+    normalized to match the table so prepare_data's loader routing and
+    run_training's step selection can never disagree:
+
+    - a routed (branch/mp) table sets ``Training.branch_parallel``;
+    - a non-routed table with grads/params/opt_state rules raises
+      ``Optimizer.zero_stage`` to the implied stage (never lowers it).
+
+    Idempotent — safe to call from prepare_data AND run_training."""
+    from .parallel import rules as parallel_rules
+
+    table = parallel_rules.resolve(config)
+    section = config.setdefault("Parallel", {})
+    section["resolved_rules"] = table.to_config()
+    training = config.setdefault("NeuralNetwork", {}).setdefault(
+        "Training", {}
+    )
+    if table.routed:
+        training["branch_parallel"] = True
+    else:
+        implied = (
+            3
+            if table.shards("params")
+            else 2
+            if table.shards("grads")
+            else 1
+            if table.shards("opt_state")
+            else 0
+        )
+        if implied > _zero_stage(training):
+            training.setdefault("Optimizer", {})["zero_stage"] = implied
+    return table
+
+
 def _make_validator(config: Dict[str, Any]):
     """Run-level SampleValidator from ``Dataset.bad_sample_policy``
     (docs/ROBUSTNESS.md "Data plane"): one instance spans ingest filtering
@@ -197,6 +241,10 @@ def prepare_data(
     dirty samples are dropped (or raised on, per
     ``Dataset.bad_sample_policy``) at the door; the validator rides on the
     returned loaders so the epoch loop can log the tally."""
+    # resolve + record the sharding rule table FIRST: it validates the
+    # Parallel section eagerly and normalizes the Training gate keys the
+    # loader-routing decisions below read (resolve_parallel)
+    resolve_parallel(config)
     validator = _make_validator(config)
     from .utils import faultinject
 
@@ -375,10 +423,15 @@ def prepare_data(
     if config.get("Mixture"):
         if bool(training.get("branch_parallel", False)):
             raise ValueError(
-                "the Mixture section is not supported together with "
-                "Training.branch_parallel yet: the mixture plane emits "
-                "unstacked dense-multibranch batches (dataset_id routing); "
-                "drop one of the two"
+                "the Mixture section is not supported together with routed "
+                "(branch/mp) parallelism yet: the mixture plane emits "
+                "unstacked dense-multibranch batches (dataset_id routing) "
+                "while routed rule tables need branch-routed shard rows "
+                "(parallel/routing.py). Drop the Mixture section, or pick a "
+                "non-routed rule table — Parallel.rules = 'dp'/'zero1'/"
+                "'zero2'/'zero3' (or drop Training.branch_parallel) all "
+                "compose with Mixture; mixture x branch-parallel is ROADMAP "
+                "item 2 on top of the rule engine"
             )
         if pack:
             raise ValueError(
@@ -427,10 +480,10 @@ def prepare_data(
                 "(branch-routed rows need fixed graph counts); use "
                 "num_pad_buckets"
             )
-        # branch-parallel decoders need branch-routed shard rows
-        # (parallel/branch.py BranchRoutedLoader); ONE ladder over all
+        # routed rule tables need branch-routed shard rows
+        # (parallel/routing.py BranchRoutedLoader); ONE ladder over all
         # splits so eval reuses the train step's compilations
-        from .parallel.branch import BranchRoutedLoader
+        from .parallel.routing import BranchRoutedLoader
 
         route_kw = dict(
             branch_count=num_branches,
@@ -441,7 +494,7 @@ def prepare_data(
             # the FULL ladder (shared across splits): each stacked batch
             # selects the smallest level fitting its largest row, and the
             # loader's per-branch template census warms every reachable
-            # level (parallel/branch.py; multi-host collapses to worst-case
+            # level (parallel/routing.py; multi-host collapses to worst-case
             # inside the loader — level choice cannot agree across hosts
             # without a collective)
             spec=spec,
@@ -626,20 +679,21 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
     # resumed one (train/loop.py restore_fn)
     placement_fns: List[Any] = []
 
-    # ZeRO-1 analog (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
-    # hydragnn/utils/optimizer/optimizer.py:43-113): shard the large optimizer
-    # moments over the data axis of the (global) device mesh; params stay
-    # replicated. Works single- and multi-host alike: the parallel step runs
-    # tx.update under the outer jit (outside its shard_map), so XLA
-    # partitions the update by the moments' sharding and all-gathers the
-    # resulting param updates (parallel/dp.py).
-    # ZeRO stage selection: stage 1 = moment sharding, stage 2 adds
-    # gradient sharding over the data axis inside the mesh step
-    # (parallel/dp.py zero2); see _zero_stage/_wants_zero2_mesh
+    # sharding rule table (parallel/rules.py): prepare_data already
+    # resolved + recorded it; re-resolving here is idempotent and hands
+    # this function the table object driving placement AND step building.
+    # ZeRO stage selection (reference: ZeroRedundancyOptimizer / DeepSpeed
+    # stages, hydragnn/utils/optimizer/optimizer.py:43-113): stage 1 =
+    # moment sharding (placement only — tx.update runs under the outer
+    # jit, so XLA partitions the update by the moments' sharding), stage
+    # 2/3 add in-step gradient/param rules and need the mesh step.
+    rule_table = resolve_parallel(config)
     zero_stage = _zero_stage(training)
     use_zero = zero_stage >= 1
     # stage >= 2 needs the mesh step — same predicate prepare_data used
-    # for the loader num_shards gate (unstacked batches would break it)
+    # for the loader num_shards gate (unstacked batches would break it);
+    # resolve_parallel normalized zero_stage from the table, so inline
+    # tables with grads/params rules take this gate too
     zero2_mesh = _wants_zero2_mesh(training) and not multihost
     if (
         use_zero
@@ -648,29 +702,29 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         and not training.get("branch_parallel", False)
         and len(jax.devices()) > 1
     ):
-        from .parallel import make_mesh, replicate_state, shard_optimizer_state
+        # ZeRO-1 placement under the plain-jit loop step: moments sharded
+        # P(data) by the table, everything else replicated
+        from .parallel import make_mesh2d, place_state
 
-        mesh = make_mesh()
+        mesh = make_mesh2d()
 
-        def _place_zero1(st, _mesh=mesh):
-            st = replicate_state(st, _mesh)
-            return st.replace(
-                opt_state=shard_optimizer_state(st.opt_state, _mesh)
-            )
+        def _place_zero1(st, _mesh=mesh, _table=rule_table):
+            return place_state(st, _table, _mesh)
 
         placement_fns.append(_place_zero1)
         state = _place_zero1(state)
 
-    # mesh-step mode: multi-host DP (shard_map over the global (branch,
-    # data) mesh, grads psum over ICI/DCN) and/or branch-parallel decoders —
-    # single-host multi-device branch_parallel runs the same mesh steps
+    # mesh-step mode: multi-host DP (shard_map over the global (data,
+    # model) mesh, grads psum over ICI/DCN) and/or routed decoder sharding
+    # — single-host multi-device branch_parallel runs the same mesh steps
     # (promote_batch no-ops with one process)
     step_fn = eval_fn = None
-    # branch-parallel decoders (Training.branch_parallel): decoder
-    # params/compute sharded over the mesh's branch axis, data routed by
-    # branch — the MultiTaskModelMP analog (parallel/branch.py). The
-    # predicate must MATCH prepare_data's loader-routing gate exactly:
-    # a branch step on unrouted batches computes garbage.
+    # routed decoder sharding (Training.branch_parallel / the branch-mp
+    # rule presets): decoder banks sharded over the model axis, data
+    # routed by branch — the MultiTaskModelMP analog (parallel/engine.py).
+    # The predicate must MATCH prepare_data's loader-routing gate exactly
+    # (resolve_parallel normalizes both from the same table): a routed
+    # step on unrouted batches computes garbage.
     branch_parallel = bool(training.get("branch_parallel", False))
     if branch_parallel and (
         getattr(model.cfg, "num_branches", 1) < 2
@@ -683,15 +737,16 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             "prepare_data could not build branch-routed loaders"
         )
     if multihost or branch_parallel or zero2_mesh:
+        # the ONE mesh-step path (parallel/engine.py): the rule table
+        # decides placement, in-step constraints, and routing — dp /
+        # ZeRO-2/3 / branch-parallel are presets, not code paths
         from .parallel import (
-            make_mesh,
+            Objective,
+            make_mesh2d,
+            make_mesh_eval_step,
+            make_mesh_train_step,
+            place_state,
             promote_batch,
-            replicate_state,
-            shard_optimizer_state,
-        )
-        from .parallel.dp import (
-            make_parallel_eval_step,
-            make_parallel_train_step,
         )
 
         cge = training.get("compute_grad_energy", False)
@@ -702,55 +757,30 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         from .obs.telemetry import resolve_telemetry as _resolve_telemetry
 
         numerics_on = bool(_resolve_telemetry(config)["numerics"])
-        if branch_parallel:
-            from .parallel.branch import (
-                make_branch_parallel_eval_step,
-                make_branch_parallel_train_step,
-                place_branch_state,
-            )
+        # 2D (data, model) mesh; model extent 1 unless the table routes
+        # decoder banks over the model axis (branch/mp presets)
+        mesh = make_mesh2d(
+            model_size=rule_table.model_size if rule_table.routed else 1
+        )
 
-            mesh = make_mesh(branch_size=model.cfg.num_branches)
+        def _place_rules(st, _mesh=mesh, _table=rule_table):
+            # table-driven placement: moments/params/decoder banks land on
+            # their rule's spec, unmatched non-scalar leaves replicate with
+            # an audit finding (obs/sharding.py record_unmatched); restored
+            # Adam moments are PLACED, never re-initialized
+            return place_state(st, _table, _mesh)
 
-            def _place_branch(st, _mesh=mesh):
-                return place_branch_state(st, tx, _mesh)
-
-            placement_fns.append(_place_branch)
-            state = _place_branch(state)
-            _pstep = make_branch_parallel_train_step(
-                model, tx, mesh, cge, mp, numerics=numerics_on
-            )
-            _peval = make_branch_parallel_eval_step(model, mesh, cge, mp)
-        else:
-            mesh = make_mesh()
-
-            def _place_mesh(st, _mesh=mesh):
-                st = replicate_state(st, _mesh)
-                if use_zero:
-                    # ZeRO-1 on the multi-host mesh: moment leaves sharded
-                    # P(data) AFTER the replicate (which would otherwise
-                    # clobber them)
-                    st = st.replace(
-                        opt_state=shard_optimizer_state(st.opt_state, _mesh)
-                    )
-                if zero_stage >= 3:
-                    # ZeRO-3/FSDP: params stored sharded between steps, full
-                    # copies transient inside each step (parallel/mesh.py
-                    # shard_params_zero3)
-                    from .parallel import shard_params_zero3
-
-                    st = st.replace(
-                        params=shard_params_zero3(st.params, _mesh)
-                    )
-                return st
-
-            placement_fns.append(_place_mesh)
-            state = _place_mesh(state)
-            _pstep = make_parallel_train_step(
-                model, tx, mesh, cge, mp,
-                zero2=zero_stage >= 2, zero3=zero_stage >= 3,
-                numerics=numerics_on,
-            )
-            _peval = make_parallel_eval_step(model, mesh, cge, mp)
+        placement_fns.append(_place_rules)
+        state = _place_rules(state)
+        _obj = Objective(
+            model=model,
+            tx=tx,
+            compute_grad_energy=cge,
+            mixed_precision=mp,
+            numerics=numerics_on,
+        )
+        _pstep = make_mesh_train_step(_obj, rule_table, mesh)
+        _peval = make_mesh_eval_step(_obj, rule_table, mesh)
         # the wrappers hide the jit objects from the compile plane —
         # attach_lower_fn re-exposes them (same jit object + same batch
         # transform the loop uses) so warm-up lands the identical executable
@@ -759,7 +789,7 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
         step_fn = attach_lower_fn(
             lambda s, b, r: _pstep(s, promote_batch(b, mesh), r),
             # a numerics-enabled builder returns a wrapper carrying the
-            # true jit as _jitted (parallel/dp.py, parallel/branch.py)
+            # true jit as _jitted (parallel/engine.py)
             getattr(_pstep, "_jitted", _pstep),
             lambda b: promote_batch(b, mesh),
         )

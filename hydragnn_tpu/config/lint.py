@@ -38,6 +38,9 @@ _OPAQUE = {
     "Dataset.Descriptors",
     "Mixture.weights",
     "Mixture.branch_loss_weights",
+    # the resolved rule table api.py records for restore replay
+    # (parallel/rules.py table_from_recorded)
+    "Parallel.resolved_rules",
 }
 
 # exact key paths this framework consumes (config/config.py completion,
@@ -209,6 +212,13 @@ _HANDLED = {
     "Mixture.drift_threshold",
     "Mixture.demote_after",
     "Mixture.seed",
+    # sharding rule engine (parallel/rules.py resolve; docs/PARALLELISM.md)
+    "Parallel.rules",
+    "Parallel.min_size",
+    "Parallel.model_size",
+    "Parallel.routed",
+    "Parallel.name",
+    "Parallel.resolved_rules",
 }
 
 # reference keys that are intentionally NOT consumed here, with the
@@ -257,12 +267,12 @@ _LEGACY = {
 }
 
 # top-level Dataset/Architecture synonyms appearing in some reference
-# example configs at non-standard paths ("Serving", "Telemetry" and
-# "Mixture" are this framework's own sections — no reference analog;
-# docs/SERVING.md, docs/OBSERVABILITY.md, docs/GFM.md)
+# example configs at non-standard paths ("Serving", "Telemetry", "Mixture"
+# and "Parallel" are this framework's own sections — no reference analog;
+# docs/SERVING.md, docs/OBSERVABILITY.md, docs/GFM.md, docs/PARALLELISM.md)
 _TOPLEVEL_SECTIONS = (
     "Verbosity", "Dataset", "NeuralNetwork", "Visualization", "Serving",
-    "Telemetry", "Mixture",
+    "Telemetry", "Mixture", "Parallel",
 )
 
 

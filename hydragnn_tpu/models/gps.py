@@ -218,6 +218,7 @@ class RingSelfAttention(nn.Module):
             from ..parallel.ring_attention import ring_self_attention
 
             use_flash = self.use_flash_attention
+            # graftlint: disable=sharding_rules -- ring attention's collective lives with the model's attention math, not the state-placement rule table
             out = shard_map(
                 lambda q_, k_, v_, m_: ring_self_attention(
                     q_, k_, v_, m_, axis_name=axis, use_flash=use_flash

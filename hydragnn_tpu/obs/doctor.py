@@ -243,6 +243,9 @@ class RunStreams:
     dumps: List[Dict[str, Any]] = field(default_factory=list)
     config: Optional[Dict[str, Any]] = None
     memory: Optional[Dict[str, Any]] = None
+    # obs/sharding.py snapshot a flight dump carried (label -> report):
+    # per-leaf PartitionSpec tables + replication audit
+    sharding: Optional[Dict[str, Any]] = None
     parse_warnings: List[str] = field(default_factory=list)
 
     # -- derived views -------------------------------------------------------
@@ -312,6 +315,10 @@ class RunStreams:
             s.dumps.append({"dir": d, "meta": meta or {}})
             if s.memory is None:
                 s.memory = _read_json(os.path.join(d, "memory.json"), w)
+            if s.sharding is None:
+                s.sharding = _read_json(
+                    os.path.join(d, "sharding.json"), w
+                )
             if not event_paths:
                 for ev in (_read_json(
                     os.path.join(d, "events.json"), w,
@@ -347,6 +354,7 @@ class RunStreams:
                 continue
             s.spans.append(sp)
         s.memory = _read_json(os.path.join(dump_dir, "memory.json"), w)
+        s.sharding = _read_json(os.path.join(dump_dir, "sharding.json"), w)
         return s
 
 
@@ -1241,6 +1249,68 @@ def config_diff(a: Optional[Dict], b: Optional[Dict]) -> Dict[str, Any]:
     }
 
 
+def sharding_diff(
+    a: Optional[Dict[str, Any]], b: Optional[Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Placement regression diff between two runs' ``sharding.json``
+    snapshots (obs/sharding.py reports the flight recorder dumped): per
+    label, every leaf whose PartitionSpec changed, leaves present in only
+    one run, and the replicated/per-device byte deltas — the oracle pair
+    for rule-table edits (docs/PARALLELISM.md "Auditing a table"). The
+    ``rule_audit`` entry (unmatched-leaf lists) diffs as path sets."""
+    if a is None or b is None:
+        return {"available": False}
+
+    def _leaves(report: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+        return {
+            e["path"]: e
+            for table in (report.get("sections") or {}).values()
+            for e in table
+        }
+
+    labels: Dict[str, Dict[str, Any]] = {}
+    for label in sorted(set(a) & set(b)):
+        ra, rb = a[label], b[label]
+        if label == "rule_audit" or "summary" not in ra or "summary" not in rb:
+            continue
+        la, lb = _leaves(ra), _leaves(rb)
+        changed = {
+            p: {"a": la[p].get("spec"), "b": lb[p].get("spec")}
+            for p in sorted(set(la) & set(lb))
+            if la[p].get("spec") != lb[p].get("spec")
+        }
+        sa_, sb_ = ra["summary"], rb["summary"]
+        deltas = {
+            k: {"a": sa_.get(k), "b": sb_.get(k),
+                "delta": (sb_.get(k) or 0) - (sa_.get(k) or 0)}
+            for k in ("replicated_bytes", "per_device_bytes",
+                      "sharded_bytes", "sharded_leaves")
+        }
+        labels[label] = {
+            "builder": {
+                "a": (ra.get("builder") or {}).get("name"),
+                "b": (rb.get("builder") or {}).get("name"),
+            },
+            "mesh": {"a": ra.get("mesh"), "b": rb.get("mesh")},
+            "spec_changed": changed,
+            "only_in_a": sorted(set(la) - set(lb)),
+            "only_in_b": sorted(set(lb) - set(la)),
+            "summary": deltas,
+            "audit_warnings": {
+                "a": len(ra.get("audit") or ()),
+                "b": len(rb.get("audit") or ()),
+            },
+        }
+    ua = set((a.get("rule_audit") or {}).get("unmatched") or ())
+    ub = set((b.get("rule_audit") or {}).get("unmatched") or ())
+    return {
+        "available": True,
+        "labels": labels,
+        "unmatched_new_in_b": sorted(ub - ua),
+        "unmatched_resolved_in_b": sorted(ua - ub),
+    }
+
+
 def diff_runs(
     a: str,
     b: str,
@@ -1336,6 +1406,7 @@ def diff_runs(
         "b": {"path": b, "summary": sum_b,
               "findings": [f.to_dict() for f in fb]},
         "config_diff": config_diff(sa.config, sb.config),
+        "sharding": sharding_diff(sa.sharding, sb.sharding),
         "metrics": metrics,
         "trace": trace,
         "findings_new_in_b": sorted(kinds_b - kinds_a),
@@ -1590,6 +1661,27 @@ def main(argv: Optional[List[str]] = None) -> int:
                       f"{len(cd['removed'])} removed")
                 for k, v in list(cd["changed"].items())[:20]:
                     print(f"  {k}: {v['a']!r} -> {v['b']!r}")
+            sh = result["sharding"]
+            if sh.get("available"):
+                for label, entry in sh["labels"].items():
+                    dv = entry["summary"]["replicated_bytes"]
+                    print(
+                        f"doctor[diff]: sharding[{label}] "
+                        f"builder {entry['builder']['a']} -> "
+                        f"{entry['builder']['b']}, "
+                        f"{len(entry['spec_changed'])} leaf spec(s) "
+                        f"changed, replicated_bytes {dv['a']} -> "
+                        f"{dv['b']} ({dv['delta']:+d}), audit warnings "
+                        f"{entry['audit_warnings']['a']} -> "
+                        f"{entry['audit_warnings']['b']}"
+                    )
+                    for p, v in list(entry["spec_changed"].items())[:20]:
+                        print(f"  {p}: {v['a']!r} -> {v['b']!r}")
+                if sh["unmatched_new_in_b"]:
+                    print(
+                        "doctor[diff]: rule_audit unmatched leaves new in "
+                        f"B: {sh['unmatched_new_in_b']}"
+                    )
             for key, entry in result["metrics"].items():
                 delta = entry.get("delta_frac")
                 print(f"  {key}: {entry['a']} -> {entry['b']}"

@@ -1,13 +1,12 @@
 """Sharding-layout inspector: the param tree -> placement oracle
 (docs/OBSERVABILITY.md "Fleet" / sharding audit).
 
-The dp/zero1-3/branch builders each hand-place state (parallel/mesh.py
-``shard_optimizer_state``/``shard_params_zero3``/``place_branch_state``)
-and nothing ever rendered the RESULT: whether a given leaf actually ended
-up sharded, over which axis, and how many bytes of it every device holds.
-That blind spot is exactly what makes the planned rule-table sharding
-refactor (ROADMAP item 1) risky — there is no before/after oracle to diff.
-This module is that oracle:
+The rule engine (parallel/engine.py ``place_state`` over a
+parallel/rules.py table) decides every leaf's placement, and this module
+renders the RESULT: whether a given leaf actually ended up sharded, over
+which axis, and how many bytes of it every device holds. It predates the
+rule-table refactor (ROADMAP item 1) as its before/after oracle and stays
+its regression diff (``doctor diff`` reads the dumped ``sharding.json``):
 
 - ``inspect_state`` walks a (placed) TrainState and tabulates every
   params / opt_state leaf: tree path, PartitionSpec, replicated-vs-
@@ -254,6 +253,47 @@ def record(report: Dict[str, Any], emit_events: bool = True) -> Dict[str, Any]:
         except Exception:
             pass
     return report
+
+
+def record_unmatched(table_name: str, paths: List[str]) -> None:
+    """Audit hook for the rule engine (parallel/engine.py place_state):
+    every non-scalar leaf NO rule matched was placed replicated by the
+    explicit default — legal, but loud, because on a hand-written inline
+    table it usually means a forgotten pattern. Bounded ``sharding_audit``
+    events + a gauge; the full path list rides the report table so flight
+    dumps carry it."""
+    if not paths:
+        return
+    with _LOCK:
+        _REPORTS.setdefault("rule_audit", {"label": "rule_audit"}).update(
+            {"table": str(table_name), "unmatched": [str(p) for p in paths]}
+        )
+    try:
+        from .registry import registry
+
+        registry().gauge(
+            "hydragnn_sharding_unmatched_leaves",
+            "Non-scalar leaves no partition rule matched (replicated by "
+            "the audited default, parallel/rules.py)",
+            labelnames=("table",),
+        ).set(float(len(paths)), table=str(table_name))
+    except Exception:
+        pass
+    try:
+        from .events import EV_SHARDING_AUDIT
+        from .events import emit as emit_event
+
+        for p in paths[:_MAX_AUDIT_EVENTS]:
+            emit_event(
+                EV_SHARDING_AUDIT,
+                severity="warn",
+                label="rule_audit",
+                table=str(table_name),
+                leaf=str(p),
+                reason="no partition rule matched; placed replicated",
+            )
+    except Exception:
+        pass
 
 
 def snapshot() -> Dict[str, Dict[str, Any]]:

@@ -1,53 +1,46 @@
-"""Branch-parallel decoders: shard decoder params/compute over the mesh's
-``branch`` axis.
+"""DEPRECATION SHIM — branch-parallel decoders live in the rule engine.
 
-The reference's ``MultiTaskModelMP`` deletes the branches a rank does not own
-and DDPs each decoder over its branch's process subgroup
-(hydragnn/models/MultiTaskModelMP.py:203-230): decoder memory and FLOPs per
-device stay constant as branches grow, while the shared encoder synchronizes
-globally. The TPU-native equivalent built here:
-
-- ``HydraModel`` decoders are *branch banks* (models/base.py `_branch_bank`):
-  every decoder parameter (and running-stat) leaf carries a leading
-  ``[num_branches]`` axis;
-- those leaves are sharded ``P('branch')`` over the mesh, so a device stores
-  only ``num_branches / branch_axis_size`` branch slices;
-- inside the ``shard_map`` step each device applies a *local* model built for
-  its ``B_local`` branch slice on data routed to its branch block
-  (``BranchRoutedLoader``), so decoder FLOPs per device are independent of
-  the total branch count;
-- encoder gradients ``pmean`` over the whole mesh (DDP analog), decoder
-  gradients ``pmean`` over the ``data`` axis only (the reference's per-branch
-  DDP subgroup) — each branch's decoder trains on the mean loss of *its*
-  dataset, exactly the reference's semantics (which differ from the dense
-  masked decode by a per-branch normalization factor).
-
-Both ``HydraModel`` heads and ``MACEModel`` per-layer readouts are
-branch-banked, so every conv type — MACE included — runs branch-parallel.
+The bespoke ``MultiTaskModelMP``-style step builder this module used to
+hold was retired into ``parallel/engine.py`` (ROADMAP item 1): decoder
+banks shard over the model axis via the ``branch``/``mp`` rule preset
+(``parallel/rules.py``, ``DECODER_PATTERN`` + ``leading_eq=num_branches``),
+and the routed data path moved to ``parallel/routing.py``. Bit-identical
+train loss against the retired builder is asserted in
+tests/test_sharding_rules.py. These wrappers keep the historical call
+signatures; new code uses ``engine.make_mesh_train_step(Objective(...),
+rules.preset("branch", num_branches=B), mesh)``.
 """
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Iterator, List, Optional, Sequence
+import warnings
 
 import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from .mesh import compat_shard_map as shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..models.base import HydraModel
-from ..train.loss import compute_loss
 from ..train.state import TrainState
-from .mesh import BRANCH_AXIS, DATA_AXIS
-
-_BOTH = (BRANCH_AXIS, DATA_AXIS)
+from . import rules as R
+from .engine import Objective, make_mesh_eval_step, make_mesh_train_step
+from .engine import place_state as _engine_place_state
+from .mesh import BRANCH_AXIS
+from .routing import BranchRoutedLoader  # noqa: F401  (re-export)
 
 # top-level variable-collection keys holding branch-banked decoder leaves
-# (models/base.py setup: self.graph_shared, self.heads_NN list)
+# (models/base.py setup) — kept for callers; the engine derives the same
+# set from the rule table's DECODER_PATTERN
 _DECODER_PREFIXES = ("graph_shared", "heads_NN", "readout")
+
+
+def _warn(name: str) -> None:
+    warnings.warn(
+        f"parallel.branch.{name} is a deprecation shim over "
+        "parallel.engine; build steps via engine.make_mesh_train_step("
+        "Objective(...), rules.preset('branch', num_branches=B), mesh) "
+        "(docs/PARALLELISM.md)",
+        DeprecationWarning,
+        stacklevel=3,
+    )
 
 
 def _is_decoder_key(top_key: str) -> bool:
@@ -55,9 +48,10 @@ def _is_decoder_key(top_key: str) -> bool:
 
 
 def branch_specs(tree, branched=P(BRANCH_AXIS), replicated=P()):
-    """PartitionSpec pytree for a params/batch_stats collection: decoder-bank
-    subtrees get ``branched`` (leading [B] axis over the branch mesh axis),
-    everything else ``replicated``."""
+    """PartitionSpec pytree for a params/batch_stats collection: decoder-
+    bank subtrees get ``branched``, everything else ``replicated``.
+    (Engine-internal spec building goes through the rule table now; this
+    stays for external callers.)"""
     if not isinstance(tree, dict):
         return jax.tree_util.tree_map(lambda _: replicated, tree)
     return {
@@ -66,50 +60,6 @@ def branch_specs(tree, branched=P(BRANCH_AXIS), replicated=P()):
         )
         for k, v in tree.items()
     }
-
-
-def _path_branch_specs(tree, num_branches: int):
-    """Per-leaf PartitionSpec for an ARBITRARY pytree (optimizer state
-    included): a leaf whose path passes through a decoder-bank dict key and
-    whose leading dim equals ``num_branches`` gets P('branch'). Optax moment
-    trees mirror the param structure, so the decoder param paths appear as
-    sub-paths inside e.g. ScaleByAdamState.mu."""
-
-    def spec_of(path, leaf):
-        on_decoder = any(
-            isinstance(p, jax.tree_util.DictKey) and _is_decoder_key(str(p.key))
-            for p in path
-        )
-        if (
-            on_decoder
-            and getattr(leaf, "ndim", 0) >= 1
-            and leaf.shape[0] == num_branches
-        ):
-            return P(BRANCH_AXIS)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec_of, tree)
-
-
-def place_branch_state(state: TrainState, tx, mesh: Mesh) -> TrainState:
-    """Place a TrainState for branch-parallel training: decoder param/stat
-    leaves (and the matching optimizer-moment leaves — preserved, NOT
-    re-initialized, so ``Training.continue`` resumes with its restored Adam
-    moments) sharded over ``branch``; everything else replicated."""
-    del tx  # kept for API stability; moments are placed, not re-created
-    num_branches = _bank_size(state.params)
-
-    def put(tree):
-        specs = _path_branch_specs(tree, num_branches)
-        return jax.tree_util.tree_map(
-            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
-        )
-
-    return state.replace(
-        params=put(state.params),
-        batch_stats=put(state.batch_stats),
-        opt_state=put(state.opt_state),
-    )
 
 
 def _bank_size(params) -> int:
@@ -122,19 +72,16 @@ def _bank_size(params) -> int:
     )
 
 
-def _local_model(model, b_local: int):
-    """Rebuild the model for a local branch slice. Works for any model whose
-    decoders are branch BANKS (HydraModel heads, MACEModel readouts) —
-    identical module tree, bank leaves sliced by the shard_map specs.
-    Branch-loss balancing is stripped from the LOCAL cfg: the global weight
-    vector does not slice with the remapped local dataset ids, so the mesh
-    step applies balancing to the decoder gradient scales instead (the
-    per-branch effective-LR equivalent; see make_branch_parallel_train_step)."""
-    cfg = dataclasses.replace(
-        model.cfg, num_branches=b_local,
-        branch_loss_weights=None, branch_loss_metrics=False,
-    )
-    return type(model)(cfg=cfg)
+def place_branch_state(state: TrainState, tx, mesh: Mesh) -> TrainState:
+    """Legacy signature -> engine placement: decoder param/stat leaves
+    (and the matching optimizer-moment leaves — preserved, NOT
+    re-initialized, so ``Training.continue`` resumes with its restored
+    Adam moments) sharded over the model/branch axis; everything else
+    replicated."""
+    _warn("place_branch_state")
+    del tx  # kept for API stability; moments are placed, not re-created
+    table = R.preset("branch", num_branches=_bank_size(state.params))
+    return _engine_place_state(state, table, mesh)
 
 
 def make_branch_parallel_train_step(
@@ -146,223 +93,21 @@ def make_branch_parallel_train_step(
     guard=None,
     numerics=None,
 ):
-    """Jitted (state, stacked_batch, rng) -> (state, loss, tasks): DP over
-    ``data`` x decoder-sharded ``branch``. The stacked batch must be
-    branch-routed (``BranchRoutedLoader``): shard row r carries graphs of
-    branch ``r // data_axis_size`` only."""
-    cfg = model.cfg
-    bsize = mesh.shape[BRANCH_AXIS]
-    assert cfg.num_branches % bsize == 0, (
-        f"num_branches {cfg.num_branches} not divisible by branch axis {bsize}"
-    )
-    b_local = cfg.num_branches // bsize
-    local = _local_model(model, b_local)
-    lcfg = local.cfg
-    # resolve at BUILD time like the other step builders (dp.py, loop.py):
-    # the env default must freeze when the step is constructed, not when it
-    # first traces, and guard=True/False gives programmatic A/B control
-    from ..obs import numerics as obs_numerics
-    from ..obs import sharding as obs_sharding
-    from ..train.guard import guard_enabled
-
-    # sharding-inspector provenance (obs/sharding.py): the branch builder's
-    # decoder banks are the one placement the replication audit must NOT
-    # flag as accidental — the report names the owner
-    obs_sharding.note_builder(
-        "branch_parallel_train_step", dict(mesh.shape),
-        branches=int(cfg.num_branches),
-    )
-    use_guard = guard_enabled(guard)
-    # Telemetry.numerics (obs/numerics.py): probes tap the LOCAL branch
-    # slice's modules per device; activation stats merge across the mesh
-    # inside the shard_map, so one census covers every branch
-    use_numerics = obs_numerics.numerics_enabled(numerics)
-    meta = {"act_names": None, "grad_names": None}
-
-    def per_device_loss(params, batch_stats, batch, rng):
-        if mixed_precision:
-            from ..train.loop import mp_cast, mp_restore_stats
-
-            params, batch = mp_cast(params, batch, compute_grad_energy)
-        variables = {"params": params, "batch_stats": batch_stats}
-        (tot, tasks, mutated, _), acts = obs_numerics.run_probed(
-            use_numerics, meta,
-            lambda: compute_loss(
-                local, variables, batch, lcfg, True, rng, compute_grad_energy
-            ),
-        )
-        if mixed_precision:
-            mutated = mp_restore_stats(mutated)
-        return tot.astype(jnp.float32), (tasks, mutated, acts)
-
-    if cfg.conv_checkpointing:
-        from ..ops.remat import loss_remat
-
-        per_device_loss = loss_remat(per_device_loss, cfg.remat_policy)
-
-    def _mixed_pmean(tree, scale_enc, scale_dec_vec):
-        """pmean with decoder subtrees reduced over data only (per-BRANCH
-        weighted mean — ``scale_dec_vec`` is a [b_local] vector applied
-        along the leading bank axis), encoder subtrees over the whole mesh
-        (global mean)."""
-        out = {}
-        for k, v in tree.items():
-            if _is_decoder_key(k):
-
-                def dec_scale(g):
-                    s = scale_dec_vec.reshape(
-                        (b_local,) + (1,) * (g.ndim - 1)
-                    )
-                    return g * s
-
-                out[k] = jax.lax.pmean(
-                    jax.tree_util.tree_map(dec_scale, v), DATA_AXIS
-                )
-            else:
-                out[k] = jax.lax.pmean(
-                    jax.tree_util.tree_map(lambda g: g * scale_enc, v), _BOTH
-                )
-        return out
-
-    def sharded_grads(params, batch_stats, batch, rng):
-        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        # graphs arrive with GLOBAL dataset ids; remap to this device's
-        # local branch-slice index (padding rows clip harmlessly — their
-        # loss terms are masked out)
-        br = jax.lax.axis_index(BRANCH_AXIS)
-        local_ds = jnp.clip(
-            batch.dataset_id.astype(jnp.int32) - br * b_local, 0, b_local - 1
-        )
-        batch = batch.replace(dataset_id=local_ds)
-        (tot, (tasks, mutated, acts)), grads = jax.value_and_grad(
-            per_device_loss, has_aux=True
-        )(params, batch_stats, batch, rng)
-        gm = batch.graph_mask.astype(jnp.float32)
-        n = jnp.sum(gm)
-        # encoder: weighted mean over every shard (DDP analog)
-        n_tot = jax.lax.psum(n, _BOTH)
-        scale_enc = n * mesh.size / jnp.maximum(n_tot, 1.0)
-        # decoder: weighted mean over each BRANCH's graphs (the reference's
-        # per-branch DDP subgroup, MultiTaskModelMP.py:230). The per-device
-        # loss averages over its shard, so slice j's raw gradient carries a
-        # factor n_j_shard/n_shard; rescaling by n_shard * D / n_j_total
-        # before the data-axis pmean yields exactly the per-branch weighted
-        # mean — also correct when several branches share a device block
-        # (b_local > 1), where a single block-mass scale would train each
-        # branch at ~1/b_local effective LR.
-        branch_mass = jax.ops.segment_sum(
-            gm, batch.dataset_id, num_segments=b_local
-        )  # [b_local] real graphs per local branch slice on this shard
-        branch_tot = jax.lax.psum(branch_mass, DATA_AXIS)
-        scale_dec_vec = (
-            n * mesh.shape[DATA_AXIS] / jnp.maximum(branch_tot, 1.0)
-        )
-        if cfg.branch_loss_weights:
-            # static per-branch loss balancing (Mixture.branch_loss_weights,
-            # mix/balance.py): scale each branch's decoder gradient by its
-            # weight — this device's b_local-slice of the global vector
-            w_all = jnp.asarray(cfg.branch_loss_weights, jnp.float32)
-            w_local = jax.lax.dynamic_slice(w_all, (br * b_local,), (b_local,))
-            scale_dec_vec = scale_dec_vec * w_local
-        grads = _mixed_pmean(grads, scale_enc, scale_dec_vec)
-        tot = jax.lax.pmean(tot * scale_enc, _BOTH)
-        tasks = jax.lax.pmean(
-            jax.tree_util.tree_map(lambda t: t * scale_enc, tasks), _BOTH
-        )
-        stats = mutated.get("batch_stats", batch_stats)
-        new_stats = _mixed_pmean(stats, scale_enc, scale_dec_vec)
-        if use_numerics:
-            acts = obs_numerics.cross_device_reduce(acts, _BOTH)
-            return grads, tot, tasks, new_stats, acts
-        return grads, tot, tasks, new_stats
-
-    rep = P()
-
-    def _specs_like(tree):
-        return branch_specs(tree)
-
-    from ..train.compile_plane import note_trace
-
-    def step(state: TrainState, batch, rng):
-        # retrace sentinel: one execution per jit trace (compile_plane.py)
-        note_trace("branch_train_step", (state, batch, rng))
-        grad_map = shard_map(
-            sharded_grads,
-            mesh=mesh,
-            in_specs=(
-                _specs_like(state.params),
-                _specs_like(state.batch_stats),
-                P(_BOTH),
-                rep,
-            ),
-            out_specs=(
-                _specs_like(state.params),
-                rep,
-                rep,
-                _specs_like(state.batch_stats),
-            ) + ((rep,) if use_numerics else ()),
-            check_vma=False,
-        )
-        acts = None
-        if use_numerics:
-            grads, tot, tasks, new_stats, acts = grad_map(
-                state.params, state.batch_stats, batch, rng
-            )
-        else:
-            grads, tot, tasks, new_stats = grad_map(
-                state.params, state.batch_stats, batch, rng
-            )
-        # chaos-test hook + non-finite step guard (train/guard.py): the
-        # decision rides the reduced loss/grads, so every device agrees
-        from ..train.guard import guarded_update, step_ok
-        from ..utils import faultinject
-
-        grads = faultinject.poison_grads(
-            grads, state.step, faultinject.lr_of(state.opt_state)
-        )
-        numer = None
-        if use_numerics:
-            # branch-sharded decoder grad leaves reduce to replicated
-            # scalars under the outer jit (GSPMD inserts the collectives)
-            gnames, gstats = obs_numerics.grad_group_stats(grads)
-            meta["grad_names"] = gnames
-            numer = {"ok": step_ok(tot, grads), "act": acts, "grad": gstats}
-
-        # optimizer update under the outer jit: decoder grads/moments stay
-        # branch-sharded by propagation, encoder leaves replicated
-        def do_update():
-            updates, opt_state = tx.update(
-                grads, state.opt_state, state.params
-            )
-            return optax.apply_updates(state.params, updates), opt_state
-
-        if use_guard:
-            new_state = guarded_update(
-                state,
-                numer["ok"] if numer is not None else step_ok(tot, grads),
-                do_update,
-                new_stats,
-            )
-        else:
-            params, opt_state = do_update()
-            new_state = state.replace(
-                params=params,
-                opt_state=opt_state,
-                batch_stats=new_stats,
-                step=state.step + 1,
-            )
-        if use_numerics:
-            return new_state, tot, tasks, numer
-        return new_state, tot, tasks
-
-    jitted = jax.jit(step, donate_argnums=0)
-    if not use_numerics:
-        return jitted
-    # numerics build: AOT-reachable jit + name tables + NaN drill-down;
-    # the diagnostic runs the GLOBAL (dense-decode) objective per shard
-    # row — branch ids stay global there, so no local remap is needed
-    return obs_numerics.numerics_step_wrapper(
-        jitted, meta, model, compute_grad_energy, mixed_precision
+    """Legacy signature -> engine: DP over ``data`` x decoder-sharded
+    model axis; the stacked batch must be branch-routed
+    (``routing.BranchRoutedLoader``)."""
+    _warn("make_branch_parallel_train_step")
+    return make_mesh_train_step(
+        Objective(
+            model=model,
+            tx=tx,
+            compute_grad_energy=compute_grad_energy,
+            mixed_precision=mixed_precision,
+            guard=guard,
+            numerics=numerics,
+        ),
+        R.preset("branch", num_branches=model.cfg.num_branches),
+        mesh,
     )
 
 
@@ -372,309 +117,13 @@ def make_branch_parallel_eval_step(
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
 ):
-    cfg = model.cfg
-    bsize = mesh.shape[BRANCH_AXIS]
-    b_local = cfg.num_branches // bsize
-    local = _local_model(model, b_local)
-    lcfg = local.cfg
-
-    def sharded_eval(params, batch_stats, batch):
-        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        br = jax.lax.axis_index(BRANCH_AXIS)
-        local_ds = jnp.clip(
-            batch.dataset_id.astype(jnp.int32) - br * b_local, 0, b_local - 1
-        )
-        batch = batch.replace(dataset_id=local_ds)
-        variables = {"params": params, "batch_stats": batch_stats}
-        if mixed_precision:
-            from ..train.loop import mp_cast_eval
-
-            variables, batch = mp_cast_eval(
-                variables, batch, compute_grad_energy
-            )
-        tot, tasks, _, _ = compute_loss(
-            local, variables, batch, lcfg, False, None, compute_grad_energy
-        )
-        n = jnp.sum(batch.graph_mask.astype(jnp.float32))
-        n_tot = jax.lax.psum(n, _BOTH)
-        scale = n * mesh.size / jnp.maximum(n_tot, 1.0)
-        tot = jax.lax.pmean(tot * scale, _BOTH)
-        tasks = jax.lax.pmean(
-            jax.tree_util.tree_map(lambda t: t * scale, tasks), _BOTH
-        )
-        return tot, tasks
-
-    rep = P()
-    from ..train.compile_plane import note_trace
-
-    def evalf(state: TrainState, batch):
-        note_trace("branch_eval_step", (state, batch))
-        mapped = shard_map(
-            sharded_eval,
-            mesh=mesh,
-            in_specs=(
-                branch_specs(state.params),
-                branch_specs(state.batch_stats),
-                P(_BOTH),
-            ),
-            out_specs=(rep, rep),
-            check_vma=False,
-        )
-        return mapped(state.params, state.batch_stats, batch)
-
-    return jax.jit(evalf)
-
-
-class BranchRoutedLoader:
-    """Stacked-batch loader whose shard rows are grouped by branch block.
-
-    Wraps one ``GraphLoader`` per branch (each over that branch's graphs,
-    with ``rows = num_shards / branch_count`` device rows) and stacks their
-    rows in branch-major order — matching the (branch, data) mesh
-    flattening, so shard row ``r`` lands on mesh position
-    ``(r // data_size, r % data_size)``.
-
-    ``spec`` may be a single worst-case ``PadSpec`` (every batch padded to
-    it — the pre-r10 behavior) or a ``SpecLadder``: each batch is then
-    padded to the smallest level fitting its LARGEST row, so small-graph
-    steps stop paying worst-case padding. Single-host only — every row of
-    a batch must share one static shape, and on multi-host runs the level
-    choice would have to agree across processes without a collective, so
-    ``host_count > 1`` collapses the ladder to its worst level.
-
-    The analog of the reference's per-branch datasets + uneven process
-    groups (examples/multibranch/train.py:166-213).
-
-    Batches are always full (``drop_last``) so every host steps in lockstep:
-    up to ``batch_size-1`` tail graphs per branch are excluded per epoch —
-    the same trade the reference's DistributedSampler makes. The epoch
-    length is the MAX over branches (globally agreed); rows whose branch is
-    exhausted emit all-padding batches, so uneven branch sizes neither
-    truncate the larger branches' metrics nor desynchronize the collective
-    step (empty rows carry zero loss weight).
-    """
-
-    def __init__(
-        self,
-        graphs: Sequence,
-        batch_size: int,
-        branch_count: int,
-        num_shards: int,
-        seed: int = 0,
-        shuffle: bool = True,
-        sort_edges: bool = False,
-        oversampling: bool = True,
-        host_count: int = 1,
-        host_index: int = 0,
-        spec=None,
-    ):
-        """``num_shards``/``batch_size`` are per-host (local rows / local
-        graphs per step). Globally there are ``host_count * num_shards``
-        rows; row ``g`` serves branch ``g // (global_rows/branch_count)``,
-        so one host may serve several branches (many local rows per branch)
-        or one branch may span several hosts (the sub-loader then shards its
-        branch's graphs across exactly those hosts)."""
-        from ..data.graph import SpecLadder
-        from ..data.pipeline import GraphLoader
-
-        L = num_shards
-        G = host_count * L
-        assert G % branch_count == 0, (
-            f"{G} global rows not divisible by {branch_count} branches"
-        )
-        R = G // branch_count  # global rows per branch
-        # a host's rows must not straddle a branch boundary: either whole
-        # branches fit in a host (L % R == 0) or whole hosts fit in a branch
-        # (R % L == 0) — otherwise per-host shards would overlap and step
-        # counts diverge (deadlock in the collective train step)
-        assert (R >= L and R % L == 0) or (R < L and L % R == 0), (
-            f"branch rows R={R} and host rows L={L} misaligned: "
-            f"host_count*local_devices ({G}) must tile branch_count "
-            f"({branch_count}) without a host straddling a branch boundary"
-        )
-        ids = sorted({g.dataset_id for g in graphs})
-        assert len(ids) == branch_count, (
-            f"dataset ids {ids} != branch_count {branch_count}"
-        )
-        # branch of each of this host's local rows (branch-major global order)
-        row_branch = [(host_index * L + r) // R for r in range(L)]
-        served = sorted(set(row_branch))
-        by_branch = {i: [g for g in graphs if g.dataset_id == i] for i in ids}
-        n_max = max(len(b) for b in by_branch.values())
-        # per-shard graph count is identical for every row by construction.
-        # Callers building train/val/test loaders should pass ONE ``spec``
-        # (ladder) computed over all splits so eval reuses the train step's
-        # compilations.
-        assert batch_size % L == 0
-        per_row_bs = batch_size // L
-        if spec is None:
-            spec = SpecLadder.for_dataset(
-                list(graphs), max(per_row_bs, 1), num_buckets=1
-            )
-        if not isinstance(spec, SpecLadder):
-            spec = SpecLadder((spec,))
-        if host_count > 1 and len(spec.specs) > 1:
-            # per-batch level selection is a per-host decision; across hosts
-            # the collective step needs identical global shapes, and
-            # agreeing on max-over-all-hosts would cost a collective per
-            # batch — multi-host keeps the worst-case single level
-            spec = SpecLadder((spec.specs[-1],))
-        self.ladder = spec
-        spec = spec.specs[-1]  # worst case: sub-loader budget + validator cap
-        self.loaders: List = []
-        for b in served:
-            rows_b = row_branch.count(b)  # local rows serving branch b
-            hosts_b = max(R // rows_b, 1)  # hosts sharing branch b
-            # this host's rank within branch b's host group
-            first_global_row = b * R
-            host_rank_b = (host_index * L - first_global_row) // L if hosts_b > 1 else 0
-            bgraphs = by_branch[ids[b]]
-            over = oversampling and len(bgraphs) < n_max
-            self.loaders.append(
-                GraphLoader(
-                    bgraphs,
-                    per_row_bs * rows_b,
-                    shuffle=shuffle,
-                    seed=seed + 17 * b,
-                    num_shards=rows_b,
-                    spec=spec,
-                    sort_edges=sort_edges,
-                    oversampling=over,
-                    num_samples=n_max if over else None,
-                    drop_last=True,
-                    host_count=hosts_b,
-                    host_index=host_rank_b,
-                )
-            )
-        self.graphs = list(graphs)
-        # per-graph triplet counts, memoized by id (DimeNet ladders budget
-        # the triplet channel; _triplet_count is O(E) interpreted python)
-        self._trip_memo: dict = {}
-        self.batch_size = batch_size
-        self.num_shards = L
-        self.host_count = host_count
-        self.host_index = host_index
-        self.sort_edges = sort_edges
-        self.spec = spec
-        # GLOBALLY agreed step count: every host computes the same MAX over
-        # ALL branches (not just the ones it serves) from the full graph
-        # list — hosts serving different branches would otherwise disagree
-        # on epoch length and deadlock in the collective step. Exhausted
-        # branches fill their rows with all-padding batches (zero weight).
-        steps = []
-        for b in range(branch_count):
-            nb = len(by_branch[ids[b]])
-            rows_srv = min(R, L)
-            hosts_b = max(R // rows_srv, 1)
-            n_eff = n_max if (oversampling and nb < n_max) else nb
-            steps.append((n_eff // hosts_b) // (per_row_bs * rows_srv))
-        self._len = max(steps)
-        self._templates: dict = {}
-
-    def _trip_count_of(self, g) -> int:
-        from ..data.graph import _triplet_count
-
-        got = self._trip_memo.get(id(g))
-        if got is None:
-            got = _triplet_count(g)
-            self._trip_memo[id(g)] = got
-        return got
-
-    def _filler_arrs(self, spec):
-        """One all-padding row's array dict at ``spec``: masks false,
-        edges/nodes parked on the dummy slots (the GraphLoader stacked-path
-        template convention, data/pipeline.py _make_stacked)."""
-        from ..data.graph import batch_graphs_np
-
-        key = spec
-        if key not in self._templates:
-            g = next(
-                (
-                    c
-                    for c in self.graphs
-                    if c.num_nodes <= spec.n_nodes - 1
-                    and c.num_edges <= spec.n_edges
-                ),
-                self.graphs[0],
-            )
-            arrs = batch_graphs_np([g], spec)
-            z = {k: np.zeros_like(v) for k, v in arrs.items()}
-            z["senders"] = np.full_like(arrs["senders"], spec.n_nodes - 1)
-            z["receivers"] = z["senders"].copy()
-            z["node_graph"] = np.full_like(arrs["node_graph"], spec.n_graphs - 1)
-            self._templates[key] = z
-        return self._templates[key]
-
-    def _stack_rows(self, rows, spec):
-        """Stack per-row padded batches (branch-major row order preserved);
-        empty rows become all-padding fillers at the same spec."""
-        from ..data.graph import batch_graphs_np, graph_batch_from_np
-
-        arr_list = [
-            batch_graphs_np(r, spec, sort_edges=self.sort_edges)
-            if r
-            else self._filler_arrs(spec)
-            for r in rows
-        ]
-        stacked = {
-            k: np.stack([a[k] for a in arr_list]) for k in arr_list[0]
-        }
-        return graph_batch_from_np(stacked)
-
-    def spec_template_batches(self):
-        """Compile-plane warm-up templates (train/compile_plane.py): one
-        stacked specialization per ladder level ANY branch can land a row
-        in. Pre-r10 this was the single worst-case spec for all branches —
-        warm-up then missed every smaller level a branch's batches actually
-        select, and the first small-graph step of each level retraced.
-        Filler rows fit any level, so the cover is the UNION of the
-        per-branch selectable sets (data/pipeline.selectable_levels)."""
-        from ..data.pipeline import selectable_levels
-
-        by_level = {}
-        for l in self.loaders:
-            for li, g in selectable_levels(l.graphs, self.ladder):
-                by_level.setdefault(li, g)
-        out = []
-        for li in sorted(by_level):
-            spec = self.ladder.specs[li]
-            rows = [[by_level[li]]] + [[] for _ in range(self.num_shards - 1)]
-            out.append((spec, self._stack_rows(rows, spec)))
-        return out
-
-    def set_epoch(self, epoch: int) -> None:
-        for l in self.loaders:
-            l.set_epoch(epoch)
-
-    def __len__(self) -> int:
-        return self._len
-
-    def __iter__(self) -> Iterator:
-        # sub-loaders contribute their deterministic (seed, epoch) index
-        # streams; rows are built HERE so one ladder level can be selected
-        # per stacked batch (the smallest level fitting the largest row)
-        streams = []
-        for l in self.loaders:
-            idx = l._local_indices()
-            streams.append((l, idx, len(idx) // l.batch_size))
-        for step in range(len(self)):
-            rows = []
-            for l, idx, n_full in streams:
-                rows_b = l.num_shards
-                if step < n_full:
-                    sl = idx[step * l.batch_size : (step + 1) * l.batch_size]
-                    graphs = [l.graphs[i] for i in sl]
-                    rows.extend(graphs[s::rows_b] for s in range(rows_b))
-                else:  # branch exhausted: zero-weight filler rows
-                    rows.extend([] for _ in range(rows_b))
-            spec = self.ladder.select(
-                max((sum(g.num_nodes for g in r) for r in rows if r), default=0),
-                max((sum(g.num_edges for g in r) for r in rows if r), default=0),
-                max(
-                    (sum(self._trip_count_of(g) for g in r) for r in rows if r),
-                    default=0,
-                )
-                if self.spec.n_triplets
-                else 0,
-            )
-            yield self._stack_rows(rows, spec)
+    _warn("make_branch_parallel_eval_step")
+    return make_mesh_eval_step(
+        Objective(
+            model=model,
+            compute_grad_energy=compute_grad_energy,
+            mixed_precision=mixed_precision,
+        ),
+        R.preset("branch", num_branches=model.cfg.num_branches),
+        mesh,
+    )

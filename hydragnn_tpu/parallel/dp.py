@@ -1,47 +1,71 @@
-"""SPMD data-parallel training step.
+"""DEPRECATION SHIM — the dp/ZeRO step builders live in the rule engine.
 
-DDP-equivalent semantics on a mesh: every device holds a replica of the
-params and consumes its own statically-padded micro-batch (local node/edge
-indices — no cross-device gathers in message passing), gradients are
-``psum``-ed over the mesh (ICI) exactly where DDP's bucketed NCCL all-reduce
-sits in the reference (loss.backward() inside train(),
-hydragnn/train/train_validate_test.py:534; DDP wrap distributed.py:332-351).
-
-Implementation: ``shard_map`` over a ``(branch, data)`` mesh; the loader emits
-batches with a leading device axis (``GraphLoader(num_shards=D)``), sharded
-over both axes. Metrics are ``pmean``-ed in the same program — the analog of
-``reduce_values_ranks`` (train_validate_test.py:382-407) at zero extra cost.
+The bespoke data-parallel step builder this module used to hold was
+retired into ``parallel/engine.py`` (ROADMAP item 1): the dp and
+ZeRO-2/3 placements are now rule presets (``parallel/rules.py``) driving
+the ONE mesh-step builder, with bit-identical train loss asserted in
+tests/test_sharding_rules.py. These wrappers keep the historical call
+signatures for existing callers (tests, run-scripts, examples); new code
+uses ``engine.make_mesh_train_step(Objective(...), table, mesh)``.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Tuple
+import warnings
 
-import jax
-import jax.numpy as jnp
-import optax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from .mesh import compat_shard_map as shard_map
+from jax.sharding import Mesh
 
 from ..models.base import HydraModel
-from ..train.loss import compute_loss
-from ..train.state import TrainState
-from .mesh import BRANCH_AXIS, DATA_AXIS
-
-_BOTH = (BRANCH_AXIS, DATA_AXIS)
+from . import rules as R
+from .engine import Objective, ensure_stacked  # noqa: F401  (re-export)
+from .engine import make_mesh_eval_step, make_mesh_train_step
 
 
-def ensure_stacked(batch):
-    """Guarantee the leading device axis the shard_map steps expect.
+def _warn(name: str) -> None:
+    warnings.warn(
+        f"parallel.dp.{name} is a deprecation shim over parallel.engine; "
+        "build steps via engine.make_mesh_train_step(Objective(...), "
+        "rule_table, mesh) (docs/PARALLELISM.md)",
+        DeprecationWarning,
+        stacklevel=3,
+    )
 
-    ``GraphLoader(num_shards=1)`` emits unstacked batches (the plain-jit
-    contract); a 1-device mesh still wants ``[1, ...]``. Keeping the shim
-    here keeps the [D, ...] contract in one place for every consumer.
-    """
-    if batch.graph_mask.ndim == 1:
-        return jax.tree_util.tree_map(lambda x: jnp.asarray(x)[None], batch)
-    return batch
+
+def _table(zero2: bool, zero3: bool, min_size: int) -> R.RuleTable:
+    """The legacy flag pair as a rule table. Flags stay independent (a
+    direct caller could ask zero3 without zero2), so the table is built
+    from the flags rather than naming a preset."""
+    rules = []
+    if zero2:
+        rules.append(
+            R.Rule(
+                pattern=r".*",
+                axes=(R.DATA,),
+                scope=("grads",),
+                min_size=min_size,
+                reason="ZeRO-2: gradient reduce-scatter over data",
+            )
+        )
+    if zero3:
+        rules.append(
+            R.Rule(
+                pattern=r".*",
+                axes=(R.DATA,),
+                scope=("params",),
+                min_size=min_size,
+                reason="ZeRO-3: params stored sharded between steps",
+            )
+        )
+    rules.append(
+        R.Rule(
+            pattern=r".*",
+            axes=(),
+            scope=R.PLACED_SCOPES,
+            reason="explicit replicated default",
+        )
+    )
+    name = "zero3" if zero3 else ("zero2" if zero2 else "dp")
+    return R.validate_table(R.RuleTable(name, tuple(rules)))
 
 
 def make_parallel_train_step(
@@ -56,211 +80,20 @@ def make_parallel_train_step(
     guard=None,
     numerics=None,
 ):
-    """Jitted (state, stacked_batch, rng) -> (state, loss, tasks) over mesh.
-
-    ``zero2=True`` shards the gradient leaves over the data axis between the
-    gradient reduction and the optimizer update (ZeRO-2 analog — see
-    mesh.zero2_grad_constraint); compose with ``shard_optimizer_state`` on
-    the state (same ``min_size``) for the full stage-2 memory profile
-    (sharded grads + moments, replicated params). ``zero3=True`` (with
-    ``shard_params_zero3`` applied to the state) additionally keeps the
-    UPDATED params sharded ``P(data)`` at step output — the FSDP profile:
-    full params exist only transiently inside the step. ``guard`` (default
-    on): non-finite step guard, computed on the pmean'd loss/gradients so
-    every device and host takes the same branch (train/guard.py).
-    ``numerics`` (default off; ``Telemetry.numerics``): in-graph layer/
-    gradient statistics ride the step as a 4th output — activation stats
-    reduce across the mesh inside the shard_map (pmax/psum), gradient
-    stats are computed on the already-pmean'd grads under the outer jit
-    (obs/numerics.py; same contract as train/loop.make_train_step)."""
-    cfg = model.cfg
-    from ..obs import numerics as obs_numerics
-    from ..obs import sharding as obs_sharding
-    from ..train.guard import guard_enabled, guarded_update, step_ok
-    from ..utils import faultinject
-
-    # sharding-inspector provenance: the report names the builder + mesh
-    # that own the live placement (obs/sharding.py)
-    obs_sharding.note_builder(
-        "parallel_train_step", dict(mesh.shape), zero2=zero2, zero3=zero3,
-    )
-    use_guard = guard_enabled(guard)
-    use_numerics = obs_numerics.numerics_enabled(numerics)
-    meta = {"act_names": None, "grad_names": None}
-
-    def per_device_loss(params, batch_stats, batch, rng):
-        if mixed_precision:
-            from ..train.loop import mp_cast, mp_restore_stats
-
-            params, batch = mp_cast(params, batch, compute_grad_energy)
-        variables = {"params": params, "batch_stats": batch_stats}
-        (tot, tasks, mutated, _), acts = obs_numerics.run_probed(
-            use_numerics, meta,
-            lambda: compute_loss(
-                model, variables, batch, cfg, True, rng, compute_grad_energy
-            ),
-        )
-        if mixed_precision:
-            mutated = mp_restore_stats(mutated)
-        return tot.astype(jnp.float32), (tasks, mutated, acts)
-
-    if cfg.conv_checkpointing:
-        from ..ops.remat import loss_remat
-
-        per_device_loss = loss_remat(per_device_loss, cfg.remat_policy)
-
-    def sharded_grads(params, batch_stats, batch, rng):
-        # batch leaves arrive with leading axis [D_local=1, ...] inside the
-        # shard; drop it to recover the per-device batch.
-        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        (tot, (tasks, mutated, acts)), grads = jax.value_and_grad(
-            per_device_loss, has_aux=True
-        )(params, batch_stats, batch, rng)
-        # weight each shard by its real-graph count so empty/remainder shards
-        # neither dilute gradients nor corrupt running batch-norm statistics
-        n = jnp.sum(batch.graph_mask.astype(jnp.float32))
-        n_tot = jax.lax.psum(n, _BOTH)
-        scale = n * mesh.size / jnp.maximum(n_tot, 1.0)
-        # gradient all-reduce over the whole mesh (DDP analog)
-        grads = jax.lax.pmean(
-            jax.tree_util.tree_map(lambda g: g * scale, grads), _BOTH
-        )
-        tot = jax.lax.pmean(tot * scale, _BOTH)
-        tasks = jax.lax.pmean(
-            jax.tree_util.tree_map(lambda t: t * scale, tasks), _BOTH
-        )
-        stats = mutated.get("batch_stats", batch_stats)
-        new_stats = jax.lax.pmean(
-            jax.tree_util.tree_map(lambda s: s * scale, stats), _BOTH
-        )
-        if use_numerics:
-            # activation stats merge across the mesh with the same
-            # semantics the host uses across window steps: max / sums
-            acts = obs_numerics.cross_device_reduce(acts, _BOTH)
-            return grads, tot, tasks, new_stats, acts
-        return grads, tot, tasks, new_stats
-
-    rep = P()
-    grad_map = shard_map(
-        sharded_grads,
-        mesh=mesh,
-        in_specs=(rep, rep, P(_BOTH), rep),
-        out_specs=(rep, rep, rep, rep) + ((rep,) if use_numerics else ()),
-        check_vma=False,
-    )
-
-    from ..train.compile_plane import note_trace
-
-    def step(state: TrainState, batch, rng):
-        # retrace sentinel: one execution per jit trace (compile_plane.py)
-        note_trace("parallel_train_step", (state, batch, rng))
-        acts = None
-        if use_numerics:
-            grads, tot, tasks, new_stats, acts = grad_map(
-                state.params, state.batch_stats, batch, rng
-            )
-        else:
-            grads, tot, tasks, new_stats = grad_map(
-                state.params, state.batch_stats, batch, rng
-            )
-        # chaos-test hook: exact no-op unless a fault is armed (trace-time).
-        # AFTER the pmean, so the poison (like the real failure it models)
-        # is identical on every device and the guard decision agrees.
-        grads = faultinject.poison_grads(
-            grads, state.step, faultinject.lr_of(state.opt_state)
-        )
-        numer = None
-        if use_numerics:
-            # gradient stats on the pmean'd (and possibly poisoned) grads:
-            # replicated values, so the census agrees across the mesh
-            gnames, gstats = obs_numerics.grad_group_stats(grads)
-            meta["grad_names"] = gnames
-            numer = {"ok": step_ok(tot, grads), "act": acts, "grad": gstats}
-
-        # The optimizer update runs OUTSIDE the shard_map, under the outer
-        # jit: with replicated optimizer state this is byte-identical to the
-        # old in-map update, and with ZeRO-1 state (shard_optimizer_state:
-        # moment leaves NamedSharding'd P(data)) XLA partitions the
-        # elementwise update by the moments' sharding — each device updates
-        # only its moment slice, and the params' replicated output sharding
-        # makes XLA all-gather the updates, which IS the ZeRO-1 exchange
-        # (reference: ZeroRedundancyOptimizer / DeepSpeed stage 1,
-        # hydragnn/utils/optimizer/optimizer.py:43-101).
-        def do_update():
-            g = grads
-            if zero2:
-                from .mesh import zero2_grad_constraint
-
-                g = zero2_grad_constraint(g, mesh, min_size=zero2_min_size)
-            updates, opt_state = tx.update(g, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
-            if zero3:
-                # FSDP output contract: updated params leave the step
-                # sharded, so the gathered full copies are transient
-                # step-local buffers
-                from .mesh import zero3_param_constraint
-
-                params = zero3_param_constraint(
-                    params, mesh, min_size=zero2_min_size
-                )
-            elif zero2:
-                # pin the post-update params back to replicated: the sharded
-                # updates make XLA all-gather here (the ZeRO-2 param
-                # exchange) instead of falling back to full-grad replication
-                # upstream
-                params = jax.lax.with_sharding_constraint(
-                    params, NamedSharding(mesh, P())
-                )
-            return params, opt_state
-
-        if use_guard:
-            # ok is computed from the pmean'd loss/grads — replicated
-            # values, so the guard's select agrees across the whole mesh
-            new_state = guarded_update(
-                state,
-                numer["ok"] if numer is not None else step_ok(tot, grads),
-                do_update,
-                new_stats,
-            )
-            # the guard's per-leaf select merges old and new params,
-            # which does not preserve do_update's output constraint —
-            # re-apply the ZeRO output contract on the merged params or
-            # GSPMD is free to leave them sharded
-            if zero3:
-                from .mesh import zero3_param_constraint
-
-                new_state = new_state.replace(
-                    params=zero3_param_constraint(
-                        new_state.params, mesh, min_size=zero2_min_size
-                    )
-                )
-            elif zero2:
-                new_state = new_state.replace(
-                    params=jax.lax.with_sharding_constraint(
-                        new_state.params, NamedSharding(mesh, P())
-                    )
-                )
-        else:
-            params, opt_state = do_update()
-            new_state = state.replace(
-                params=params,
-                opt_state=opt_state,
-                batch_stats=new_stats,
-                step=state.step + 1,
-            )
-        if use_numerics:
-            return new_state, tot, tasks, numer
-        return new_state, tot, tasks
-
-    # donate the incoming state so params/opt-state update in place in HBM
-    jitted = jax.jit(step, donate_argnums=0)
-    if not use_numerics:
-        return jitted
-    # numerics build: keep the jit AOT-reachable and carry the host-side
-    # name tables + NaN drill-down (the diagnostic runs the replicated
-    # single-device objective per shard row — obs/numerics.py)
-    return obs_numerics.numerics_step_wrapper(
-        jitted, meta, model, compute_grad_energy, mixed_precision
+    """Legacy signature -> engine: jitted (state, stacked_batch, rng) ->
+    (state, loss, tasks) over ``mesh``, ZeRO flags as grads/params rules."""
+    _warn("make_parallel_train_step")
+    return make_mesh_train_step(
+        Objective(
+            model=model,
+            tx=tx,
+            compute_grad_energy=compute_grad_energy,
+            mixed_precision=mixed_precision,
+            guard=guard,
+            numerics=numerics,
+        ),
+        _table(zero2, zero3, zero2_min_size),
+        mesh,
     )
 
 
@@ -270,43 +103,13 @@ def make_parallel_eval_step(
     compute_grad_energy: bool = False,
     mixed_precision: bool = False,
 ):
-    cfg = model.cfg
-
-    def sharded_eval(state: TrainState, batch):
-        batch = jax.tree_util.tree_map(lambda x: x[0], batch)
-        variables = state.variables()
-        if mixed_precision:
-            # keep eval numerics identical to the single-host eval step
-            from ..train.loop import mp_cast_eval
-
-            variables, batch = mp_cast_eval(
-                variables, batch, compute_grad_energy
-            )
-        tot, tasks, _, _ = compute_loss(
-            model, variables, batch, cfg, False, None, compute_grad_energy
-        )
-        # weight by real graphs so padded shards don't skew the mean
-        n = jnp.sum(batch.graph_mask.astype(jnp.float32))
-        n_tot = jax.lax.psum(n, _BOTH)
-        scale = n * mesh.size / jnp.maximum(n_tot, 1.0)
-        tot = jax.lax.pmean(tot * scale, _BOTH)
-        tasks = jax.lax.pmean(
-            jax.tree_util.tree_map(lambda t: t * scale, tasks), _BOTH
-        )
-        return tot, tasks
-
-    rep = P()
-    mapped = shard_map(
-        sharded_eval,
-        mesh=mesh,
-        in_specs=(rep, P(_BOTH)),
-        out_specs=(rep, rep),
-        check_vma=False,
+    _warn("make_parallel_eval_step")
+    return make_mesh_eval_step(
+        Objective(
+            model=model,
+            compute_grad_energy=compute_grad_energy,
+            mixed_precision=mixed_precision,
+        ),
+        _table(False, False, 0),
+        mesh,
     )
-    from ..train.compile_plane import note_trace
-
-    def eval_step(state: TrainState, batch):
-        note_trace("parallel_eval_step", (state, batch))
-        return mapped(state, batch)
-
-    return jax.jit(eval_step)

@@ -36,6 +36,7 @@ from ..utils import envflags
 
 DATA_AXIS = "data"
 BRANCH_AXIS = "branch"
+MODEL_AXIS = "model"
 
 
 def compat_shard_map(*args, **kwargs):
@@ -87,10 +88,51 @@ def make_mesh(
     return Mesh(arr, (BRANCH_AXIS, DATA_AXIS))
 
 
+def make_mesh2d(
+    devices: Optional[Sequence[jax.Device]] = None,
+    model_size: int = 1,
+) -> Mesh:
+    """Build the engine's 2D ``(data, model)`` mesh (parallel/engine.py).
+
+    Subsumes ``make_mesh``: ``model_size`` is the model/task-parallel
+    extent (num_branches in the routed presets, 1 for pure DP/ZeRO).
+    Device (d, m) is ``devices[m * data_n + d]`` — the transpose of the
+    legacy ``(branch, data)`` layout — so the *physical* device holding
+    (branch=m, data=d) work is identical between the two constructors and
+    the engine's steps are bit-identical to the retired builders'.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    assert (
+        n % model_size == 0
+    ), f"{n} devices not divisible by model={model_size}"
+    arr = np.asarray(devices).reshape(model_size, n // model_size)
+    return Mesh(arr.transpose(1, 0), (DATA_AXIS, MODEL_AXIS))
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    return int(dict(mesh.shape).get(DATA_AXIS, 1))
+
+
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Mesh axes the GraphBatch leading dim shards over, in shard-row
+    order: legacy meshes stack (branch-major, data-minor); the 2D mesh
+    keeps the same row order as (model, data) so a given shard index
+    lands on the same physical device under both constructors."""
+    names = mesh.axis_names
+    if MODEL_AXIS in names:
+        return (MODEL_AXIS, DATA_AXIS)
+    if BRANCH_AXIS in names:
+        return (BRANCH_AXIS, DATA_AXIS)
+    return (DATA_AXIS,)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for GraphBatch leaves: leading (node/edge/graph) axis over
-    data x branch. Requires padded sizes divisible by the mesh size."""
-    return NamedSharding(mesh, P((BRANCH_AXIS, DATA_AXIS)))
+    the mesh's batch axes (``batch_axes`` — model/branch-major, data-minor,
+    identical shard->device mapping under both mesh constructors).
+    Requires padded sizes divisible by the mesh size."""
+    return NamedSharding(mesh, P(batch_axes(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

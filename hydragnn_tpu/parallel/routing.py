@@ -1,0 +1,272 @@
+"""Branch-routed data feeding for the routed (branch/mp) rule tables.
+
+The routed mesh step (parallel/engine.py, ``RuleTable.routed``) consumes
+stacked batches whose shard rows are grouped by branch block: row ``r``
+carries graphs of branch ``r // data_axis_size`` only, matching the
+model/branch-major row order of ``mesh.batch_axes``. ``BranchRoutedLoader``
+builds exactly that — one ``GraphLoader`` per branch, rows stacked in
+branch-major order. Moved here from the retired parallel/branch.py
+(which re-exports it for compatibility).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import numpy as np
+
+
+class BranchRoutedLoader:
+    """Stacked-batch loader whose shard rows are grouped by branch block.
+
+    Wraps one ``GraphLoader`` per branch (each over that branch's graphs,
+    with ``rows = num_shards / branch_count`` device rows) and stacks their
+    rows in branch-major order — matching the mesh's model/branch-major
+    batch-axis flattening (parallel/mesh.py ``batch_axes``), so shard row
+    ``r`` lands on mesh position ``(r // data_size, r % data_size)`` of
+    the model x data grid.
+
+    ``spec`` may be a single worst-case ``PadSpec`` (every batch padded to
+    it — the pre-r10 behavior) or a ``SpecLadder``: each batch is then
+    padded to the smallest level fitting its LARGEST row, so small-graph
+    steps stop paying worst-case padding. Single-host only — every row of
+    a batch must share one static shape, and on multi-host runs the level
+    choice would have to agree across processes without a collective, so
+    ``host_count > 1`` collapses the ladder to its worst level.
+
+    The analog of the reference's per-branch datasets + uneven process
+    groups (examples/multibranch/train.py:166-213).
+
+    Batches are always full (``drop_last``) so every host steps in lockstep:
+    up to ``batch_size-1`` tail graphs per branch are excluded per epoch —
+    the same trade the reference's DistributedSampler makes. The epoch
+    length is the MAX over branches (globally agreed); rows whose branch is
+    exhausted emit all-padding batches, so uneven branch sizes neither
+    truncate the larger branches' metrics nor desynchronize the collective
+    step (empty rows carry zero loss weight).
+    """
+
+    def __init__(
+        self,
+        graphs: Sequence,
+        batch_size: int,
+        branch_count: int,
+        num_shards: int,
+        seed: int = 0,
+        shuffle: bool = True,
+        sort_edges: bool = False,
+        oversampling: bool = True,
+        host_count: int = 1,
+        host_index: int = 0,
+        spec=None,
+    ):
+        """``num_shards``/``batch_size`` are per-host (local rows / local
+        graphs per step). Globally there are ``host_count * num_shards``
+        rows; row ``g`` serves branch ``g // (global_rows/branch_count)``,
+        so one host may serve several branches (many local rows per branch)
+        or one branch may span several hosts (the sub-loader then shards its
+        branch's graphs across exactly those hosts)."""
+        from ..data.graph import SpecLadder
+        from ..data.pipeline import GraphLoader
+
+        L = num_shards
+        G = host_count * L
+        assert G % branch_count == 0, (
+            f"{G} global rows not divisible by {branch_count} branches"
+        )
+        R = G // branch_count  # global rows per branch
+        # a host's rows must not straddle a branch boundary: either whole
+        # branches fit in a host (L % R == 0) or whole hosts fit in a branch
+        # (R % L == 0) — otherwise per-host shards would overlap and step
+        # counts diverge (deadlock in the collective train step)
+        assert (R >= L and R % L == 0) or (R < L and L % R == 0), (
+            f"branch rows R={R} and host rows L={L} misaligned: "
+            f"host_count*local_devices ({G}) must tile branch_count "
+            f"({branch_count}) without a host straddling a branch boundary"
+        )
+        ids = sorted({g.dataset_id for g in graphs})
+        assert len(ids) == branch_count, (
+            f"dataset ids {ids} != branch_count {branch_count}"
+        )
+        # branch of each of this host's local rows (branch-major global order)
+        row_branch = [(host_index * L + r) // R for r in range(L)]
+        served = sorted(set(row_branch))
+        by_branch = {i: [g for g in graphs if g.dataset_id == i] for i in ids}
+        n_max = max(len(b) for b in by_branch.values())
+        # per-shard graph count is identical for every row by construction.
+        # Callers building train/val/test loaders should pass ONE ``spec``
+        # (ladder) computed over all splits so eval reuses the train step's
+        # compilations.
+        assert batch_size % L == 0
+        per_row_bs = batch_size // L
+        if spec is None:
+            spec = SpecLadder.for_dataset(
+                list(graphs), max(per_row_bs, 1), num_buckets=1
+            )
+        if not isinstance(spec, SpecLadder):
+            spec = SpecLadder((spec,))
+        if host_count > 1 and len(spec.specs) > 1:
+            # per-batch level selection is a per-host decision; across hosts
+            # the collective step needs identical global shapes, and
+            # agreeing on max-over-all-hosts would cost a collective per
+            # batch — multi-host keeps the worst-case single level
+            spec = SpecLadder((spec.specs[-1],))
+        self.ladder = spec
+        spec = spec.specs[-1]  # worst case: sub-loader budget + validator cap
+        self.loaders: List = []
+        for b in served:
+            rows_b = row_branch.count(b)  # local rows serving branch b
+            hosts_b = max(R // rows_b, 1)  # hosts sharing branch b
+            # this host's rank within branch b's host group
+            first_global_row = b * R
+            host_rank_b = (host_index * L - first_global_row) // L if hosts_b > 1 else 0
+            bgraphs = by_branch[ids[b]]
+            over = oversampling and len(bgraphs) < n_max
+            self.loaders.append(
+                GraphLoader(
+                    bgraphs,
+                    per_row_bs * rows_b,
+                    shuffle=shuffle,
+                    seed=seed + 17 * b,
+                    num_shards=rows_b,
+                    spec=spec,
+                    sort_edges=sort_edges,
+                    oversampling=over,
+                    num_samples=n_max if over else None,
+                    drop_last=True,
+                    host_count=hosts_b,
+                    host_index=host_rank_b,
+                )
+            )
+        self.graphs = list(graphs)
+        # per-graph triplet counts, memoized by id (DimeNet ladders budget
+        # the triplet channel; _triplet_count is O(E) interpreted python)
+        self._trip_memo: dict = {}
+        self.batch_size = batch_size
+        self.num_shards = L
+        self.host_count = host_count
+        self.host_index = host_index
+        self.sort_edges = sort_edges
+        self.spec = spec
+        # GLOBALLY agreed step count: every host computes the same MAX over
+        # ALL branches (not just the ones it serves) from the full graph
+        # list — hosts serving different branches would otherwise disagree
+        # on epoch length and deadlock in the collective step. Exhausted
+        # branches fill their rows with all-padding batches (zero weight).
+        steps = []
+        for b in range(branch_count):
+            nb = len(by_branch[ids[b]])
+            rows_srv = min(R, L)
+            hosts_b = max(R // rows_srv, 1)
+            n_eff = n_max if (oversampling and nb < n_max) else nb
+            steps.append((n_eff // hosts_b) // (per_row_bs * rows_srv))
+        self._len = max(steps)
+        self._templates: dict = {}
+
+    def _trip_count_of(self, g) -> int:
+        from ..data.graph import _triplet_count
+
+        got = self._trip_memo.get(id(g))
+        if got is None:
+            got = _triplet_count(g)
+            self._trip_memo[id(g)] = got
+        return got
+
+    def _filler_arrs(self, spec):
+        """One all-padding row's array dict at ``spec``: masks false,
+        edges/nodes parked on the dummy slots (the GraphLoader stacked-path
+        template convention, data/pipeline.py _make_stacked)."""
+        from ..data.graph import batch_graphs_np
+
+        key = spec
+        if key not in self._templates:
+            g = next(
+                (
+                    c
+                    for c in self.graphs
+                    if c.num_nodes <= spec.n_nodes - 1
+                    and c.num_edges <= spec.n_edges
+                ),
+                self.graphs[0],
+            )
+            arrs = batch_graphs_np([g], spec)
+            z = {k: np.zeros_like(v) for k, v in arrs.items()}
+            z["senders"] = np.full_like(arrs["senders"], spec.n_nodes - 1)
+            z["receivers"] = z["senders"].copy()
+            z["node_graph"] = np.full_like(arrs["node_graph"], spec.n_graphs - 1)
+            self._templates[key] = z
+        return self._templates[key]
+
+    def _stack_rows(self, rows, spec):
+        """Stack per-row padded batches (branch-major row order preserved);
+        empty rows become all-padding fillers at the same spec."""
+        from ..data.graph import batch_graphs_np, graph_batch_from_np
+
+        arr_list = [
+            batch_graphs_np(r, spec, sort_edges=self.sort_edges)
+            if r
+            else self._filler_arrs(spec)
+            for r in rows
+        ]
+        stacked = {
+            k: np.stack([a[k] for a in arr_list]) for k in arr_list[0]
+        }
+        return graph_batch_from_np(stacked)
+
+    def spec_template_batches(self):
+        """Compile-plane warm-up templates (train/compile_plane.py): one
+        stacked specialization per ladder level ANY branch can land a row
+        in. Pre-r10 this was the single worst-case spec for all branches —
+        warm-up then missed every smaller level a branch's batches actually
+        select, and the first small-graph step of each level retraced.
+        Filler rows fit any level, so the cover is the UNION of the
+        per-branch selectable sets (data/pipeline.selectable_levels)."""
+        from ..data.pipeline import selectable_levels
+
+        by_level = {}
+        for l in self.loaders:
+            for li, g in selectable_levels(l.graphs, self.ladder):
+                by_level.setdefault(li, g)
+        out = []
+        for li in sorted(by_level):
+            spec = self.ladder.specs[li]
+            rows = [[by_level[li]]] + [[] for _ in range(self.num_shards - 1)]
+            out.append((spec, self._stack_rows(rows, spec)))
+        return out
+
+    def set_epoch(self, epoch: int) -> None:
+        for l in self.loaders:
+            l.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self) -> Iterator:
+        # sub-loaders contribute their deterministic (seed, epoch) index
+        # streams; rows are built HERE so one ladder level can be selected
+        # per stacked batch (the smallest level fitting the largest row)
+        streams = []
+        for l in self.loaders:
+            idx = l._local_indices()
+            streams.append((l, idx, len(idx) // l.batch_size))
+        for step in range(len(self)):
+            rows = []
+            for l, idx, n_full in streams:
+                rows_b = l.num_shards
+                if step < n_full:
+                    sl = idx[step * l.batch_size : (step + 1) * l.batch_size]
+                    graphs = [l.graphs[i] for i in sl]
+                    rows.extend(graphs[s::rows_b] for s in range(rows_b))
+                else:  # branch exhausted: zero-weight filler rows
+                    rows.extend([] for _ in range(rows_b))
+            spec = self.ladder.select(
+                max((sum(g.num_nodes for g in r) for r in rows if r), default=0),
+                max((sum(g.num_edges for g in r) for r in rows if r), default=0),
+                max(
+                    (sum(self._trip_count_of(g) for g in r) for r in rows if r),
+                    default=0,
+                )
+                if self.spec.n_triplets
+                else 0,
+            )
+            yield self._stack_rows(rows, spec)

@@ -63,6 +63,9 @@ BENCH_TUNE=1 BENCH_TUNE_NODES=64 BENCH_TUNE_EDGES=256 BENCH_TUNE_HIDDEN=16 \
 echo "== compile-plane smoke (background precompile + error-mode retrace sentinel; cold -> warm cache) =="
 python run-scripts/compile_smoke.py
 
+echo "== sharding-engine smoke (every rule preset end-to-end on the 2D mesh; comm bytes vs old-builder baseline; zero retraces; zero-3 audit clean) =="
+python run-scripts/sharding_smoke.py
+
 echo "== chaos resume smoke (SIGTERM mid-run -> Training.continue round-trip; warm-cache resume) =="
 python run-scripts/chaos_smoke.py
 

@@ -549,15 +549,53 @@ def pytest_baseline_roundtrip_is_local_only_suppression(tmp_path, capsys):
     capsys.readouterr()
 
 
-def pytest_checker_catalog_lists_all_nine():
+def pytest_checker_catalog_lists_all_ten():
     ids = {c.id for c in analysis.checkers()}
     assert ids == {
         "env_census", "config_keys", "obs_contract", "trace_hazard",
         "threads", "atomic_write", "error_codes", "fault_coverage",
-        "tile_constants",
+        "tile_constants", "sharding_rules",
     }
     for c in analysis.checkers():
         assert c.rationale, c.id  # every checker cites its incident
+
+
+def pytest_sharding_rules_fires_outside_parallel_and_exempts_engine(tmp_path):
+    repo = mini_repo(tmp_path, {
+        "hydragnn_tpu/models/m.py": """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def place(x, mesh):
+                y = jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+                return shard_map(lambda z: z, mesh=mesh)(y)
+        """,
+        "hydragnn_tpu/parallel/engine.py": """
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def place(x, mesh):
+                return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+        """,
+    })
+    got = findings_of(repo, "sharding_rules")
+    assert len(got) == 3, got  # wsc + NamedSharding ctor + shard_map call
+    assert all(f.path == "hydragnn_tpu/models/m.py" for f in got)
+    assert all("outside parallel/" in f.message for f in got)
+    assert any("parallel/rules.py" in f.hint for f in got)
+
+
+def pytest_sharding_rules_waiver_with_reason_waives(tmp_path):
+    repo = mini_repo(tmp_path, {
+        "hydragnn_tpu/models/m.py": """
+            def attn(q, mesh):
+                # graftlint: disable=sharding_rules -- collective lives with the attention math
+                return shard_map(lambda z: z, mesh=mesh)(q)
+        """,
+    })
+    got = findings_of(repo, "sharding_rules")
+    assert len(got) == 1 and got[0].waived, got
+    assert findings_of(repo, "sharding_rules", include_waived=False) == []
 
 
 def pytest_doctor_static_findings_record_is_clean_and_bounded():

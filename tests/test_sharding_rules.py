@@ -1,0 +1,580 @@
+"""Rule-table sharding engine tests (ROADMAP item 1, parallel/rules.py +
+parallel/engine.py) on the virtual 8-device CPU mesh.
+
+Two layers:
+
+1. Table semantics — regex precedence / first-match-wins, the
+   size/shape admission predicates, the unmatched-leaf audit, preset
+   structure, eager validation, config round-trip, and api.py's
+   ``resolve_parallel`` normalization/conflict contract.
+
+2. Bit-identity — the ONE engine step on the 2D ``(data, model)`` mesh
+   must produce BIT-IDENTICAL train losses to the retired builders'
+   call path (the dp.py/branch.py shims over the legacy
+   ``(branch, data)`` mesh) for every preset: dp, zero-2, zero-3,
+   branch-parallel. ``make_mesh2d`` lays devices out so each replica
+   group holds the same devices in the same order as ``make_mesh``, so
+   the psum schedules — and therefore the floats — must not drift.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from hydragnn_tpu.config import update_config
+from hydragnn_tpu.data import (
+    GraphLoader,
+    MinMax,
+    VariablesOfInterest,
+    deterministic_graph_dataset,
+    extract_variables,
+    split_dataset,
+)
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.parallel import (
+    Objective,
+    RuleError,
+    make_mesh,
+    make_mesh2d,
+    make_mesh_eval_step,
+    make_mesh_train_step,
+    place_state,
+    preset,
+    replicate_state,
+    shard_optimizer_state,
+)
+from hydragnn_tpu.parallel import rules as R
+from hydragnn_tpu.train import TrainState, make_optimizer
+
+AXIS_MAP = {R.DATA: "data", R.MODEL: "model"}
+AXIS_SIZES = {R.DATA: 4, R.MODEL: 2}
+
+
+def _paths_specs(tree, table, scope="params"):
+    specs, unmatched = R.spec_tree(tree, table, scope, AXIS_MAP, AXIS_SIZES)
+    flat = {
+        R.path_str(p): s
+        for p, s in jax.tree_util.tree_flatten_with_path(specs)[0]
+    }
+    return flat, unmatched
+
+
+# ---------------------------------------------------------------------------
+# table semantics
+# ---------------------------------------------------------------------------
+
+
+def pytest_first_match_wins():
+    from jax.sharding import PartitionSpec as P
+
+    tree = {
+        "enc": {"kernel": np.zeros((8, 4))},
+        "dec": {"kernel": np.zeros((8, 4))},
+    }
+    table = R.validate_table(R.RuleTable("t", (
+        R.Rule(pattern=r"enc/kernel", axes=(R.DATA,)),
+        R.Rule(pattern=r"kernel", axes=()),
+    )))
+    flat, unmatched = _paths_specs(tree, table)
+    assert flat["enc/kernel"] == P("data")
+    assert flat["dec/kernel"] == P()
+    assert unmatched == []
+    # swap the order: the broad rule now shadows the specific one
+    swapped = R.validate_table(R.RuleTable("t2", (
+        R.Rule(pattern=r"kernel", axes=()),
+        R.Rule(pattern=r"enc/kernel", axes=(R.DATA,)),
+    )))
+    flat, _ = _paths_specs(tree, swapped)
+    assert flat["enc/kernel"] == P()
+
+
+def pytest_admission_predicates():
+    from jax.sharding import PartitionSpec as P
+
+    tree = {
+        "big": np.zeros((8, 64)),     # 512 elems: clears min_size=100
+        "small": np.zeros((8, 4)),    # 32 elems: passed over
+        "odd": np.zeros((6, 64)),     # 6 % data(4) != 0: passed over
+        "bank2": np.zeros((2, 16)),   # leading_eq=2 admits
+        "bank3": np.zeros((3, 16)),   # leading_eq=2 refuses
+        "scalar": np.float32(1.0),    # implicit P(), never audited
+    }
+    table = R.validate_table(R.RuleTable("t", (
+        R.Rule(pattern=r"bank", axes=(R.MODEL,), leading_eq=2),
+        R.Rule(pattern=r".*", axes=(R.DATA,), min_size=100),
+        R.Rule(pattern=r".*", axes=()),
+    )))
+    flat, unmatched = _paths_specs(tree, table)
+    assert flat["big"] == P("data")
+    assert flat["small"] == P()
+    assert flat["odd"] == P()
+    assert flat["bank2"] == P("model")
+    assert flat["bank3"] == P()   # refused the bank rule, fell to min_size
+    assert flat["scalar"] == P()
+    assert unmatched == []
+
+
+def pytest_unmatched_leaf_audited():
+    from jax.sharding import PartitionSpec as P
+
+    tree = {"covered": np.zeros((8, 4)), "forgotten": np.zeros((4, 4))}
+    table = R.validate_table(R.RuleTable("partial", (
+        R.Rule(pattern=r"covered", axes=()),
+    )))
+    flat, unmatched = _paths_specs(tree, table)
+    assert flat["forgotten"] == P()   # replicated by the audited default
+    assert unmatched == ["params/forgotten"]
+    # every shipped preset ends in the explicit catch-all: no audit noise
+    for name in ("dp", "zero1", "zero2", "zero3"):
+        _, miss = _paths_specs(tree, preset(name, min_size=8))
+        assert miss == [], name
+
+
+def pytest_place_state_reports_unmatched_to_obs(monkeypatch):
+    """The engine's placement surfaces forgotten-pattern leaves as
+    sharding_audit events + the rule_audit report entry."""
+    import optax
+
+    from hydragnn_tpu.obs.events import events as event_log
+    from hydragnn_tpu.obs import sharding as obs_sharding
+
+    obs_sharding.reset()
+    mesh = make_mesh2d()
+    params = {"enc": {"kernel": np.zeros((8, 8), np.float32)}}
+    state = TrainState.create({"params": params}, optax.sgd(0.1))
+    table = R.validate_table(
+        R.RuleTable("holes", (R.Rule(pattern=r"nothing_matches", axes=()),))
+    )
+    before = len(event_log().snapshot())
+    place_state(state, table, mesh)
+    snap = obs_sharding.snapshot()
+    assert snap["rule_audit"]["table"] == "holes"
+    assert "params/enc/kernel" in snap["rule_audit"]["unmatched"]
+    audit_events = [
+        e for e in event_log().snapshot()[before:]
+        if e["kind"] == "sharding_audit"
+    ]
+    assert audit_events and audit_events[0]["table"] == "holes"
+    obs_sharding.reset()
+
+
+def pytest_preset_structure():
+    dp = preset("dp")
+    assert not any(dp.shards(s) for s in R.SCOPES)
+    z1, z2, z3 = (preset(f"zero{i}", min_size=8) for i in (1, 2, 3))
+    for t in (z1, z2, z3):
+        assert t.shards("opt_state") and not t.routed
+    assert not z1.shards("grads") and not z1.shards("params")
+    assert z2.shards("grads") and not z2.shards("params")
+    assert z3.shards("grads") and z3.shards("params")
+    br = preset("branch", num_branches=2)
+    mp = preset("mp", num_branches=2)
+    assert br.routed and br.model_size == 2
+    # mp is the reference-facing alias: identical placement semantics
+    assert [r.to_config() for r in mp.rules] == [
+        r.to_config() for r in br.rules
+    ]
+    assert (mp.model_size, mp.routed) == (br.model_size, br.routed)
+
+
+def pytest_validation_rejects_bad_tables():
+    with pytest.raises(RuleError, match="bad regex"):
+        preset_t = R.RuleTable("t", (R.Rule(pattern=r"(unclosed"),))
+        R.validate_table(preset_t)
+    with pytest.raises(RuleError, match="unknown axis"):
+        R.validate_table(R.RuleTable("t", (
+            R.Rule(pattern=r".*", axes=("tensor",)),
+        )))
+    with pytest.raises(RuleError, match="unknown scope"):
+        R.validate_table(R.RuleTable("t", (
+            R.Rule(pattern=r".*", scope=("gradz",)),
+        )))
+    with pytest.raises(RuleError, match="model axis"):
+        R.validate_table(R.RuleTable("t", (
+            R.Rule(pattern=r".*", axes=(R.MODEL,), scope=("grads",)),
+        )))
+    with pytest.raises(RuleError, match="model_size"):
+        R.validate_table(R.RuleTable("t", routed=True))
+    with pytest.raises(RuleError, match="num_branches"):
+        preset("branch", num_branches=1)
+    with pytest.raises(RuleError, match="unknown Parallel.rules preset"):
+        preset("fsdp")
+
+
+def pytest_table_config_roundtrip():
+    z3 = preset("zero3", min_size=64)
+    rec = z3.to_config()
+    back = R.table_from_recorded(rec)
+    assert back.to_config() == rec
+    tree = {"w": np.zeros((8, 64))}
+    a, _ = _paths_specs(tree, z3, scope="params")
+    b, _ = _paths_specs(tree, back, scope="params")
+    assert a == b
+    with pytest.raises(RuleError, match="unknown keys"):
+        R.table_from_config([{"pattern": ".*", "sepc": ["data"]}], {})
+    with pytest.raises(RuleError, match="missing 'pattern'"):
+        R.table_from_config([{"spec": ["data"]}], {})
+
+
+def pytest_resolve_and_normalization():
+    from hydragnn_tpu.api import _wants_zero2_mesh, _zero_stage, resolve_parallel
+
+    # legacy keys alone derive the matching preset
+    assert R.resolve({}).name == "dp"
+    cfg = {"NeuralNetwork": {"Training": {"Optimizer": {"zero_stage": 2}}}}
+    assert R.resolve(cfg).name == "zero2"
+    # an explicit table raises the legacy gate keys so prepare_data's
+    # loader routing and run_training's step selection agree
+    cfg = {"Parallel": {"rules": "zero3", "min_size": 64}}
+    table = resolve_parallel(cfg)
+    assert table.name == "zero3"
+    training = cfg["NeuralNetwork"]["Training"]
+    assert _zero_stage(training) == 3
+    assert cfg["Parallel"]["resolved_rules"]["name"] == "zero3"
+    resolve_parallel(cfg)  # idempotent
+    assert _zero_stage(training) == 3
+    # routed inline table -> branch_parallel normalized on
+    routed = {"Parallel": {
+        "rules": [
+            {"pattern": "heads_NN", "spec": ["model"], "leading_eq": 2},
+            {"pattern": ".*", "spec": []},
+        ],
+        "model_size": 2,
+        "routed": True,
+    }}
+    t = resolve_parallel(routed)
+    assert t.routed
+    assert routed["NeuralNetwork"]["Training"]["branch_parallel"] is True
+    # conflicts refuse rather than guess
+    with pytest.raises(RuleError, match="branch_parallel"):
+        R.resolve({"NeuralNetwork": {"Training": {
+            "branch_parallel": True, "Optimizer": {"zero_stage": 2},
+        }}})
+    with pytest.raises(RuleError, match="branch_parallel"):
+        R.resolve({
+            "Parallel": {"rules": "dp"},
+            "NeuralNetwork": {"Training": {"branch_parallel": True}},
+        })
+    with pytest.raises(RuleError, match="grads"):
+        R.resolve({
+            "Parallel": {"rules": "zero1"},
+            "NeuralNetwork": {"Training": {"Optimizer": {"zero_stage": 2}}},
+        })
+    # the legacy gate helper keeps its exact signature + error contract
+    with pytest.raises(ValueError, match="branch_parallel"):
+        _wants_zero2_mesh(
+            {"branch_parallel": True, "Optimizer": {"zero_stage": 2}}
+        )
+
+
+def pytest_mesh2d_layout_matches_legacy_mesh():
+    """Replica-group device order is the bit-identity precondition: the
+    2D mesh's (data, model) layout must visit the same physical devices
+    as the legacy (branch, data) mesh, coordinate for coordinate."""
+    legacy = make_mesh(branch_size=2)          # (branch=2, data=4)
+    two_d = make_mesh2d(model_size=2)          # (data=4, model=2)
+    assert dict(two_d.shape) == {"data": 4, "model": 2}
+    for b in range(2):
+        for d in range(4):
+            assert legacy.devices[b, d] == two_d.devices[d, b]
+
+
+# ---------------------------------------------------------------------------
+# bit-identity vs the retired builders (dp/zero/branch trio)
+# ---------------------------------------------------------------------------
+
+
+def _setup(num_shards=8, batch_size=16, hidden=8):
+    raw = deterministic_graph_dataset(80, seed=7)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest(
+        [0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1]
+    )
+    ready = [extract_variables(g, voi) for g in raw]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": hidden,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 2,
+                        "dim_sharedlayers": 4,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [10, 10],
+                    }
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": batch_size,
+                "num_epoch": 2,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+    config = update_config(config, tr, va, te)
+    loader = GraphLoader(
+        tr, batch_size, seed=0, num_shards=num_shards, drop_last=True
+    )
+    return config, loader, tr
+
+
+def _loss_history(step, state, loader, epochs=2):
+    rng = jax.random.PRNGKey(0)
+    losses = []
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for batch in loader:
+            rng, sub = jax.random.split(rng)
+            state, tot, _ = step(state, batch, sub)
+            losses.append(float(tot))
+    return state, losses
+
+
+def _fresh(variables, tx):
+    # donated steps delete their inputs; each path gets its own buffers
+    v = jax.tree_util.tree_map(np.array, variables)
+    return TrainState.create(v, tx)
+
+
+def pytest_engine_bit_identical_to_dp_builder():
+    config, loader, _ = _setup()
+    model = create_model(config)
+    one = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0], next(iter(loader))
+    )
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    legacy_mesh = make_mesh()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        from hydragnn_tpu.parallel.dp import (
+            make_parallel_eval_step,
+            make_parallel_train_step,
+        )
+
+        legacy_step = make_parallel_train_step(model, tx, legacy_mesh)
+        legacy_eval = make_parallel_eval_step(model, legacy_mesh)
+    s_legacy = replicate_state(_fresh(variables, tx), legacy_mesh)
+
+    mesh = make_mesh2d()
+    table = preset("dp")
+    obj = Objective(model=model, tx=tx)
+    engine_step = make_mesh_train_step(obj, table, mesh)
+    engine_eval = make_mesh_eval_step(obj, table, mesh)
+    s_engine = place_state(_fresh(variables, tx), table, mesh)
+
+    s_legacy, l_legacy = _loss_history(legacy_step, s_legacy, loader)
+    s_engine, l_engine = _loss_history(engine_step, s_engine, loader)
+    assert l_engine == l_legacy, (
+        f"engine dp losses drifted from the retired builder:\n"
+        f"legacy={l_legacy}\nengine={l_engine}"
+    )
+    batch = next(iter(loader))
+    va_l, _ = legacy_eval(s_legacy, batch)
+    va_e, _ = engine_eval(s_engine, batch)
+    assert float(va_e) == float(va_l)
+
+
+def pytest_engine_bit_identical_to_zero2_builder():
+    config, loader, _ = _setup()
+    model = create_model(config)
+    one = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0], next(iter(loader))
+    )
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    legacy_mesh = make_mesh()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        from hydragnn_tpu.parallel.dp import make_parallel_train_step
+
+        legacy_step = make_parallel_train_step(
+            model, tx, legacy_mesh, zero2=True, zero2_min_size=8
+        )
+    s_legacy = replicate_state(_fresh(variables, tx), legacy_mesh)
+    s_legacy = s_legacy.replace(
+        opt_state=shard_optimizer_state(
+            s_legacy.opt_state, legacy_mesh, min_size=8
+        )
+    )
+
+    mesh = make_mesh2d()
+    table = preset("zero2", min_size=8)
+    engine_step = make_mesh_train_step(Objective(model=model, tx=tx), table, mesh)
+    s_engine = place_state(_fresh(variables, tx), table, mesh)
+
+    s_legacy, l_legacy = _loss_history(legacy_step, s_legacy, loader)
+    s_engine, l_engine = _loss_history(engine_step, s_engine, loader)
+    assert l_engine == l_legacy, (
+        f"engine zero2 losses drifted:\nlegacy={l_legacy}\nengine={l_engine}"
+    )
+    # the preset really sharded the moments
+    assert any(
+        hasattr(l, "sharding") and not l.sharding.is_fully_replicated
+        for l in jax.tree_util.tree_leaves(s_engine.opt_state)
+    )
+
+
+def pytest_engine_bit_identical_to_zero3_builder():
+    config, loader, _ = _setup(hidden=64)
+    model = create_model(config)
+    one = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0], next(iter(loader))
+    )
+    variables = init_model(model, one)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    legacy_mesh = make_mesh()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        from hydragnn_tpu.parallel import shard_params_zero3
+        from hydragnn_tpu.parallel.dp import make_parallel_train_step
+
+        legacy_step = make_parallel_train_step(
+            model, tx, legacy_mesh,
+            zero2=True, zero2_min_size=8, zero3=True,
+        )
+    s_legacy = replicate_state(_fresh(variables, tx), legacy_mesh)
+    s_legacy = s_legacy.replace(
+        opt_state=shard_optimizer_state(
+            s_legacy.opt_state, legacy_mesh, min_size=8
+        ),
+        params=shard_params_zero3(s_legacy.params, legacy_mesh, min_size=8),
+    )
+
+    mesh = make_mesh2d()
+    # the dp.py shim derives its zero3 table at the shim's min_size; match it
+    table = preset("zero3", min_size=8)
+    engine_step = make_mesh_train_step(Objective(model=model, tx=tx), table, mesh)
+    s_engine = place_state(_fresh(variables, tx), table, mesh)
+
+    s_legacy, l_legacy = _loss_history(legacy_step, s_legacy, loader)
+    s_engine, l_engine = _loss_history(engine_step, s_engine, loader)
+    assert l_engine == l_legacy, (
+        f"engine zero3 losses drifted:\nlegacy={l_legacy}\nengine={l_engine}"
+    )
+    # params stay sharded between steps under the preset too
+    sharded = [
+        l for l in jax.tree_util.tree_leaves(s_engine.params)
+        if hasattr(l, "sharding") and not l.sharding.is_fully_replicated
+    ]
+    assert sharded, "no param leaf remained ZeRO-3 sharded under the preset"
+    for leaf in sharded:
+        assert leaf.addressable_shards[0].data.size * 8 == leaf.size
+
+
+def _setup_multibranch(branch_count=2):
+    import dataclasses
+
+    raw = deterministic_graph_dataset(96, seed=11)
+    raw = MinMax.fit(raw).apply(raw)
+    voi = VariablesOfInterest(
+        [0], ["sum_x_x2_x3"], ["graph"], [0], [1, 1, 1], [1]
+    )
+    ready = [
+        dataclasses.replace(extract_variables(g, voi), dataset_id=i % branch_count)
+        for i, g in enumerate(raw)
+    ]
+    tr, va, te = split_dataset(ready, 0.7, seed=0)
+    gh = {
+        "num_sharedlayers": 1,
+        "dim_sharedlayers": 8,
+        "num_headlayers": 2,
+        "dim_headlayers": [10, 10],
+    }
+    config = {
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "output_heads": {
+                    "graph": [
+                        {"type": f"branch-{b}", "architecture": dict(gh)}
+                        for b in range(branch_count)
+                    ]
+                },
+                "task_weights": [1.0],
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["sum_x_x2_x3"],
+                "output_index": [0],
+                "type": ["graph"],
+            },
+            "Training": {
+                "batch_size": 16,
+                "num_epoch": 2,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.02},
+            },
+        },
+        "Dataset": {
+            "node_features": {"dim": [1, 1, 1]},
+            "graph_features": {"dim": [1]},
+        },
+    }
+    return update_config(config, tr, va, te), tr
+
+
+def pytest_engine_bit_identical_to_branch_builder():
+    from hydragnn_tpu.parallel import BranchRoutedLoader
+
+    config, tr = _setup_multibranch()
+    model = create_model(config)
+    assert model.cfg.num_branches == 2
+    loader = BranchRoutedLoader(tr, batch_size=16, branch_count=2, num_shards=8)
+    one = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[0], next(iter(loader))
+    )
+    variables = init_model(model, one, seed=0)
+    tx = make_optimizer(config["NeuralNetwork"]["Training"]["Optimizer"])
+
+    legacy_mesh = make_mesh(branch_size=2)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        from hydragnn_tpu.parallel.branch import (
+            make_branch_parallel_train_step,
+            place_branch_state,
+        )
+
+        legacy_step = make_branch_parallel_train_step(model, tx, legacy_mesh)
+        s_legacy = place_branch_state(
+            _fresh(variables, tx), tx, legacy_mesh
+        )
+
+    mesh = make_mesh2d(model_size=2)
+    table = preset("branch", num_branches=2)
+    engine_step = make_mesh_train_step(Objective(model=model, tx=tx), table, mesh)
+    s_engine = place_state(_fresh(variables, tx), table, mesh)
+
+    # decoder banks sharded over the model axis, encoder replicated
+    for leaf in jax.tree_util.tree_leaves(s_engine.params["heads_NN_0"]):
+        assert not leaf.sharding.is_fully_replicated
+        assert leaf.addressable_shards[0].data.shape[0] * 2 == leaf.shape[0]
+    for leaf in jax.tree_util.tree_leaves(s_engine.params["graph_convs_0"]):
+        assert leaf.sharding.is_fully_replicated
+
+    s_legacy, l_legacy = _loss_history(legacy_step, s_legacy, loader)
+    s_engine, l_engine = _loss_history(engine_step, s_engine, loader)
+    assert l_engine == l_legacy, (
+        f"engine branch losses drifted:\nlegacy={l_legacy}\nengine={l_engine}"
+    )
+    assert l_engine[-1] < l_engine[0], l_engine
