@@ -421,29 +421,16 @@ def prepare_data(
     # (deterministic eval), sharing the same spec ladder so every
     # specialization is reused across train and eval
     if config.get("Mixture"):
-        if bool(training.get("branch_parallel", False)):
-            raise ValueError(
-                "the Mixture section is not supported together with routed "
-                "(branch/mp) parallelism yet: the mixture plane emits "
-                "unstacked dense-multibranch batches (dataset_id routing) "
-                "while routed rule tables need branch-routed shard rows "
-                "(parallel/routing.py). Drop the Mixture section, or pick a "
-                "non-routed rule table — Parallel.rules = 'dp'/'zero1'/"
-                "'zero2'/'zero3' (or drop Training.branch_parallel) all "
-                "compose with Mixture; mixture x branch-parallel is ROADMAP "
-                "item 2 on top of the rule engine"
-            )
         if pack:
             raise ValueError(
                 "the Mixture section is not supported with "
-                "Training.pack_batches (mixture batches are drawn at a "
-                "fixed graph count and ladder-padded); use num_pad_buckets"
-            )
-        if num_shards > 1 or host_count > 1:
-            raise ValueError(
-                "the Mixture plane is single-host/single-shard for now "
-                f"(num_shards={num_shards}, host_count={host_count}); run "
-                "it on one process or drop the Mixture section"
+                "Training.pack_batches: mixture batches are drawn at a "
+                "FIXED graph count and ladder-padded, while pack mode bins "
+                "a variable graph count into one budget — the two batch "
+                "composers are mutually exclusive by construction. Drop "
+                "Training.pack_batches (use Training.num_pad_buckets for "
+                "the few-specializations effect) or drop the Mixture "
+                "section"
             )
         if balance:
             raise ValueError(
@@ -453,6 +440,56 @@ def prepare_data(
             )
         from .mix import MixturePlane, sources_from_graphs
 
+        if (
+            bool(training.get("branch_parallel", False))
+            and num_branches > 1
+            and num_shards > 1
+        ):
+            # routed rule tables need branch-routed shard rows: one
+            # MixturePlane per served branch, rows stacked branch-major
+            # (parallel/routing.py BranchRoutedMixture); per-branch
+            # decoders are then placed by the branch rule preset
+            # (parallel/rules.py -> parallel/engine.py)
+            from .parallel.routing import (
+                BranchRoutedLoader,
+                BranchRoutedMixture,
+            )
+
+            route_kw = dict(
+                branch_count=num_branches,
+                num_shards=num_shards,
+                host_count=host_count,
+                host_index=host_index,
+                sort_edges=shard_kw["sort_edges"],
+                spec=spec,
+            )
+            train_loader = BranchRoutedMixture(
+                sources_from_graphs(trainset),
+                batch_size,
+                settings=config["Mixture"],
+                seed=int(training.get("seed", 0)),
+                validator=validator,
+                **route_kw,
+            )
+            val_loader = BranchRoutedLoader(
+                valset, batch_size, shuffle=False, oversampling=False,
+                **route_kw,
+            )
+            test_loader = BranchRoutedLoader(
+                testset, batch_size, shuffle=False, oversampling=False,
+                **route_kw,
+            )
+            train_loader.validator = validator
+            return config, (train_loader, val_loader, test_loader), mm
+        # flat (data-parallel) mixture: each host owns a disjoint draw
+        # stripe of the SAME absolute draw sequence (mix/plane.py "host
+        # loss"). Stripe identity comes from the fleet plane's view so a
+        # simulated fleet (HYDRAGNN_FLEET_HOST_INDEX/_COUNT, one jax
+        # process per child) stripes exactly like a real pod — on real
+        # multi-host runs host_identity() equals local_host_info()
+        from .obs.fleet import host_identity
+
+        mix_host_index, mix_host_count = host_identity()
         train_loader = MixturePlane(
             sources_from_graphs(trainset),
             batch_size,
@@ -461,6 +498,9 @@ def prepare_data(
             seed=int(training.get("seed", 0)),
             sort_edges=shard_kw["sort_edges"],
             validator=validator,
+            num_shards=num_shards,
+            host_count=mix_host_count,
+            host_index=mix_host_index,
         )
         val_loader = GraphLoader(
             valset, batch_size, shuffle=False, source="val", **shard_kw
@@ -641,10 +681,65 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
                     train_loader.restore_mixture(ls.mixture, mid_epoch=True)
                 # batch-count guard AFTER arming: pack-mode batch counts are
                 # epoch-dependent, so len() is only comparable once the
-                # loader sits at the sidecar's epoch
-                if ls.num_batches and ls.num_batches != len(train_loader):
+                # loader sits at the sidecar's epoch. EXCEPTION: a mixture
+                # sidecar written under a different (host_count, host_index)
+                # stripe layout legitimately changes the per-host batch
+                # count — the elastic re-deal (mix/plane.py restore_mixture)
+                # already re-armed the loader at the mapped position
+                relayout = (
+                    isinstance(ls.mixture, dict)
+                    and (
+                        int(ls.mixture.get("host_count", 1))
+                        != int(getattr(train_loader, "host_count", 1) or 1)
+                        or int(ls.mixture.get("host_index", 0))
+                        != int(getattr(train_loader, "host_index", 0) or 0)
+                    )
+                )
+                if (
+                    ls.num_batches
+                    and ls.num_batches != len(train_loader)
+                    and not relayout
+                ):
                     train_loader.resume(0, 0)  # disarm: fresh epoch 0 start
                     recipe_ok = False
+                if relayout and recipe_ok:
+                    # record the survivor's re-layout as a typed event (the
+                    # doctor's elastic rules read exactly this record); the
+                    # driver that relaunched us may hand over the measured
+                    # progress loss (run-scripts/elastic_smoke.py)
+                    from .train.elastic import note_relayout
+
+                    lost = envflags.env_str("HYDRAGNN_ELASTIC_LOST_STEPS")
+                    note_relayout(
+                        {
+                            "host_count": int(
+                                ls.mixture.get("host_count", 1) or 1
+                            ),
+                            "host_index": int(
+                                ls.mixture.get("host_index", 0) or 0
+                            ),
+                            "epoch": int(ls.epoch),
+                            "next_batch": int(ls.next_batch),
+                        },
+                        {
+                            "host_count": int(
+                                getattr(train_loader, "host_count", 1) or 1
+                            ),
+                            "host_index": int(
+                                getattr(train_loader, "host_index", 0) or 0
+                            ),
+                            "epoch": int(
+                                getattr(train_loader, "epoch", ls.epoch)
+                            ),
+                            "next_batch": int(
+                                getattr(
+                                    train_loader, "start_batch", 0
+                                )
+                            ),
+                        },
+                        trigger="resume",
+                        progress_lost_steps=int(lost) if lost else None,
+                    )
             if recipe_ok:
                 if verbosity > 0:
                     print(
@@ -666,6 +761,40 @@ def _(config: dict, datasets=None, verbosity: Optional[int] = None):
             ms = load_mixture_state(startfrom)
             if ms is not None:
                 train_loader.restore_mixture(ms)
+                if isinstance(ms, dict) and (
+                    int(ms.get("host_count", 1) or 1)
+                    != int(getattr(train_loader, "host_count", 1) or 1)
+                    or int(ms.get("host_index", 0) or 0)
+                    != int(getattr(train_loader, "host_index", 0) or 0)
+                ):
+                    # an epoch-boundary re-layout (elastic shrink survivor
+                    # finishing, or a re-grown host rejoining): the new
+                    # epoch re-deals the stripes from position 0 by purity
+                    # alone, but the typed event must still be recorded —
+                    # it is the doctor's evidence of the topology change
+                    from .train.elastic import note_relayout
+
+                    lost = envflags.env_str("HYDRAGNN_ELASTIC_LOST_STEPS")
+                    note_relayout(
+                        {
+                            "host_count": int(ms.get("host_count", 1) or 1),
+                            "host_index": int(ms.get("host_index", 0) or 0),
+                            "epoch": int(ms.get("epoch", 0) or 0),
+                        },
+                        {
+                            "host_count": int(
+                                getattr(train_loader, "host_count", 1) or 1
+                            ),
+                            "host_index": int(
+                                getattr(train_loader, "host_index", 0) or 0
+                            ),
+                            "epoch": int(
+                                getattr(train_loader, "epoch", 0) or 0
+                            ),
+                        },
+                        trigger="resume",
+                        progress_lost_steps=int(lost) if lost else None,
+                    )
                 if verbosity > 0:
                     print(
                         f"[{log_name}] mixture topology restored: epoch "

@@ -487,6 +487,31 @@ def update_config(
             "Training.double_buffer must be true/false or a queue depth "
             f">= 0, got {db!r}"
         )
+    # ---- elastic fleet operation (docs/GFM.md "Multi-host and elastic
+    # operation", train/elastic.py): ``enabled`` arms the driver-side
+    # coordinator that turns watchdog detections / SIGTERM notices into
+    # shrink-grow plans, ``min_hosts`` is the floor below which a shrink is
+    # refused (fail the run instead of overloading survivors), ``grace_s``
+    # bounds how long a preempted host may checkpoint before it counts as
+    # dead. Checkpoint-restart semantics: progress since the coordinated
+    # checkpoint is lost, never silently recomputed under a stale layout.
+    el = training.setdefault("elastic", {})
+    if not isinstance(el, dict):
+        raise ValueError(
+            f"Training.elastic must be a dict of elastic-fleet keys, got {el!r}"
+        )
+    el.setdefault("enabled", False)
+    el.setdefault("min_hosts", 1)
+    el.setdefault("grace_s", 30.0)
+    if int(el["min_hosts"]) < 1:
+        raise ValueError(
+            f"Training.elastic.min_hosts must be >= 1, got {el['min_hosts']!r}"
+        )
+    if float(el["grace_s"]) < 0:
+        raise ValueError(
+            "Training.elastic.grace_s must be >= 0 (seconds), got "
+            f"{el['grace_s']!r}"
+        )
     if training["non_finite_policy"] == "rollback" and not training["Checkpoint"]:
         # rollback restores the last verified checkpoint — without best-val
         # checkpointing only the preemption/end-of-run saves exist, so the

@@ -30,6 +30,8 @@ _OPAQUE = {
     "NeuralNetwork.Architecture.output_heads",
     "NeuralNetwork.Training.Optimizer",
     "NeuralNetwork.Training.Checkpoint",
+    # elastic-fleet sub-dict (enabled/min_hosts/grace_s; train/elastic.py)
+    "NeuralNetwork.Training.elastic",
     "Dataset.node_features",
     "Dataset.graph_features",
     "Dataset.path",
@@ -153,6 +155,7 @@ _HANDLED = {
     "NeuralNetwork.Training.conv_checkpointing",
     "NeuralNetwork.Training.remat_policy",
     "NeuralNetwork.Training.Optimizer",
+    "NeuralNetwork.Training.elastic",
     "NeuralNetwork.Training.mixed_precision",
     "NeuralNetwork.Training.pack_batches",
     "NeuralNetwork.Training.num_pad_buckets",

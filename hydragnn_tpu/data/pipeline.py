@@ -125,6 +125,35 @@ def spec_template_batches(
     ]
 
 
+def stack_shard_batches(
+    shards: Sequence[Sequence[Graph]],
+    spec: PadSpec,
+    num_shards: int,
+    sort_edges: bool = False,
+) -> GraphBatch:
+    """Stack per-shard padded batches into a leading device axis; missing
+    shards become all-padding rows (padding edges point at the dummy node
+    slot, padding nodes at the dummy graph slot). Shared by the stacked
+    ``GraphLoader``, the mixture plane (mix/plane.py), and the
+    branch-routed loaders (parallel/routing.py)."""
+    arrs = [
+        batch_graphs_np(list(s), spec, sort_edges=sort_edges)
+        for s in shards
+        if s
+    ]
+    template = {k: np.zeros_like(v) for k, v in arrs[0].items()}
+    # padding edges must still point at the dummy node slot
+    template["senders"] = np.full_like(arrs[0]["senders"], spec.n_nodes - 1)
+    template["receivers"] = template["senders"].copy()
+    template["node_graph"] = np.full_like(
+        arrs[0]["node_graph"], spec.n_graphs - 1
+    )
+    while len(arrs) < num_shards:
+        arrs.append(template)
+    stacked = {k: np.stack([a[k] for a in arrs]) for k in arrs[0]}
+    return graph_batch_from_np(stacked)
+
+
 @dataclasses.dataclass
 class VariablesOfInterest:
     """Selection of model inputs and per-head targets from raw feature tables.
@@ -964,19 +993,6 @@ class GraphLoader:
     ) -> GraphBatch:
         """Stack per-shard padded batches into a leading device axis;
         missing shards become all-padding rows."""
-        arrs = [
-            batch_graphs_np(s, spec, sort_edges=self.sort_edges)
-            for s in shards
-            if s
-        ]
-        template = {k: np.zeros_like(v) for k, v in arrs[0].items()}
-        # padding edges must still point at the dummy node slot
-        template["senders"] = np.full_like(arrs[0]["senders"], spec.n_nodes - 1)
-        template["receivers"] = template["senders"].copy()
-        template["node_graph"] = np.full_like(
-            arrs[0]["node_graph"], spec.n_graphs - 1
+        return stack_shard_batches(
+            shards, spec, self.num_shards, sort_edges=self.sort_edges
         )
-        while len(arrs) < self.num_shards:
-            arrs.append(template)
-        stacked = {k: np.stack([a[k] for a in arrs]) for k in arrs[0]}
-        return graph_batch_from_np(stacked)

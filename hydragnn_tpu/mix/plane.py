@@ -24,10 +24,18 @@ Fault model:
   epoch start so the step loop never desynchronizes mid-epoch.
 - **crashes**: all sampling state is pure-in-integers (mix/sampler.py);
   the durable snapshot (``mixture_state_dict``) is the active set +
-  weights + per-source cursors + (epoch, draw), serialized beside every
-  checkpoint (train/checkpoint.py ``save_mixture_state``) and inside the
-  PR 4 loader-state sidecar on a mid-epoch preemption stop — a SIGKILL
-  anywhere resumes the exact remaining draw sequence.
+  weights + per-source cursors + (epoch, draw, position), serialized
+  beside every checkpoint (train/checkpoint.py ``save_mixture_state``)
+  and inside the PR 4 loader-state sidecar on a mid-epoch preemption
+  stop — a SIGKILL anywhere resumes the exact remaining draw sequence.
+- **host loss** (docs/GFM.md "Multi-host and elastic operation"): under
+  ``host_count > 1`` every host advances the IDENTICAL absolute draw
+  sequence (zero collectives — purity is the coordination) and owns the
+  valid samples at global stripe positions ``p % host_count ==
+  host_index`` (the GraphLoader/DistributedSampler stripe, applied at
+  draw granularity). The snapshot's ``pos`` is the global valid-sample
+  position, so a survivor restored at a DIFFERENT host count re-deals
+  the remaining positions contiguously: no draw duplicated, none lost.
 
 Observability (obs/): per-source weight/draw/skip gauges and counters in
 the registry, demotion/churn/drift events in the event log, a per-epoch
@@ -51,7 +59,11 @@ from ..data.graph import (
     _triplet_count,
     batch_graphs,
 )
-from ..data.pipeline import spec_template_batches as _module_templates
+from ..data.pipeline import (
+    selectable_levels,
+    spec_template_batches as _module_templates,
+    stack_shard_batches,
+)
 from .balance import DriftMonitor
 from .sampler import SourceCursor, draw_source, temperature_weights
 from ..utils import envflags
@@ -111,9 +123,6 @@ class MixturePlane:
     """
 
     # loader-compat surface consumed by the loop / api
-    num_shards = 1
-    host_count = 1
-    host_index = 0
     pack = False
 
     def __init__(
@@ -126,10 +135,32 @@ class MixturePlane:
         sort_edges: bool = False,
         validator=None,
         num_buckets: int = 1,
+        host_count: int = 1,
+        host_index: int = 0,
+        num_shards: int = 1,
     ):
         if not sources:
             raise ValueError("MixturePlane needs at least one source")
         self.batch_size = int(batch_size)
+        # local device shards: batches stack to [num_shards, ...] rows like
+        # the stacked GraphLoader (data/pipeline.py)
+        self.num_shards = max(int(num_shards), 1)
+        if self.batch_size % self.num_shards:
+            raise ValueError(
+                f"mixture batch_size {self.batch_size} must divide evenly "
+                f"across {self.num_shards} local device shards"
+            )
+        # multi-host draw stripe (GraphLoader/DistributedSampler semantics
+        # at draw granularity): every host runs the identical absolute draw
+        # sequence and keeps the valid samples at global positions
+        # p % host_count == host_index — disjoint stripes, zero collectives
+        self.host_count = max(int(host_count), 1)
+        self.host_index = int(host_index)
+        if not 0 <= self.host_index < self.host_count:
+            raise ValueError(
+                f"mixture host_index {self.host_index} out of range for "
+                f"host_count {self.host_count}"
+            )
         self.settings = dict(settings)
         self.temperature = float(settings.get("temperature", 1.0))
         self.demote_after = int(settings.get("demote_after", 0) or 0)
@@ -154,6 +185,13 @@ class MixturePlane:
             self.ladder = spec
         else:
             self.ladder = SpecLadder((spec,))
+        if self.host_count > 1 and len(self.ladder.specs) > 1:
+            # multi-host: each host's stripe draws different graphs, so
+            # per-batch ladder level selection would diverge across hosts
+            # while the global array needs identical shapes — collapse to
+            # the worst level instead of paying a per-batch collective
+            # (the BranchRoutedLoader rule, parallel/routing.py)
+            self.ladder = SpecLadder((self.ladder.specs[-1],))
         self.spec = self.ladder.specs[-1]
         # mixture position: epoch is the ABSOLUTE mixture epoch (a resumed
         # process maps its local epoch loop through _epoch_offset so the
@@ -166,6 +204,12 @@ class MixturePlane:
         self._perm_caches: Dict[int, dict] = {}
         self._armed_cursors: Optional[Dict[int, SourceCursor]] = None
         self._armed_draw: Optional[int] = None
+        # global valid-sample position paired with the armed cursors (the
+        # stripe-resume currency); _replay_pos is the cursor-less fallback:
+        # skip-replay to an exact POSITION, not a batch count, so a resume
+        # onto a different host_count never re-consumes a stripe slot
+        self._armed_pos: Optional[int] = None
+        self._replay_pos: Optional[int] = None
         # per-run accounting (per-source; epoch tallies reset by the hook)
         self.epoch_draws: Dict[int, int] = {}
         self.epoch_skips: Dict[int, int] = {}
@@ -358,7 +402,13 @@ class MixturePlane:
         return sum(len(s.graphs) for s in self.sources.values())
 
     def __len__(self) -> int:
-        return max(self._epoch_draw_budget() // self.batch_size, 1)
+        # per-HOST batch count: the global valid-sample budget divides over
+        # host_count equal stripes (equal per-host step counts keep a real
+        # multi-host mesh in lockstep — GraphLoader truncates identically)
+        return max(
+            self._epoch_draw_budget() // (self.batch_size * self.host_count),
+            1,
+        )
 
     def set_epoch(self, epoch: int) -> None:
         """Per-epoch reseed. The first call after ``resume()`` keeps the
@@ -373,6 +423,8 @@ class MixturePlane:
             self.start_batch = 0
             self._armed_cursors = None
             self._armed_draw = None
+            self._armed_pos = None
+            self._replay_pos = None
 
     def resume(self, epoch: int, next_batch: int) -> None:
         """Arm deterministic resume at absolute mixture position
@@ -404,15 +456,23 @@ class MixturePlane:
         exactly that batch — NOT the live cursors, which device_prefetch's
         lookahead may have advanced past the checkpointed step."""
         draw = None
+        pos = None
         cursors = self.cursors
         if next_batch is not None and int(next_batch) in self._journal:
             entry = self._journal[int(next_batch)]
             draw = int(entry["draw"])
+            pos = int(entry["pos"])
             cursors = entry["cursors"]
         return {
             "epoch": int(self.epoch),
             "next_batch": int(next_batch) if next_batch is not None else None,
             "draw": draw,
+            # global valid-sample position + the stripe layout that wrote
+            # it: restore maps pos onto the RESTORING layout, so a shrunk
+            # or regrown fleet re-deals the remaining stripe exactly
+            "pos": pos,
+            "host_count": int(self.host_count),
+            "host_index": int(self.host_index),
             "active": sorted(self.sources),
             "demoted": {str(k): v for k, v in sorted(self.demoted.items())},
             "weights": {str(k): float(v) for k, v in self._explicit_weights.items()},
@@ -472,16 +532,45 @@ class MixturePlane:
             self._fail_seen.add((int(s), int(i)))
         self._refresh_weights()
         if mid_epoch:
+            pos = snap.get("pos")
+            # a stripe re-deal: the snapshot was written under a different
+            # (host_count, host_index) layout. At a coordinated checkpoint
+            # (every old host at local batch k) the UNION of the old
+            # stripes' consumed positions is exactly [0, k * batch_size *
+            # old_host_count) — a host's own trajectory ``pos`` trails
+            # that boundary by up to one stride, and resuming from it
+            # would re-consume positions the OTHER old stripes already
+            # took. So the re-deal advances to the boundary and deals the
+            # remaining positions over THIS layout: no draw duplicated,
+            # none lost.
+            relayout = (
+                int(snap.get("host_count", 1)) != self.host_count
+                or int(snap.get("host_index", 0)) != self.host_index
+            ) and (pos is not None or snap.get("next_batch") is not None)
             if snap.get("draw") is not None:
                 self._armed_cursors = {
                     int(k): SourceCursor.from_list(v)
                     for k, v in (snap.get("cursors") or {}).items()
                 }
                 self._armed_draw = int(snap["draw"])
+                self._armed_pos = int(pos) if pos is not None else None
             # a snapshot without a draw index (journal miss) falls back to
-            # deterministic skip-replay from the epoch start — slower, but
-            # the same sequence by purity
-            if self._resume is None and snap.get("next_batch") is not None:
+            # deterministic skip-replay — by exact position across a
+            # layout change, by batch count otherwise (same sequence by
+            # purity either way)
+            if relayout:
+                stride_old = self.batch_size * max(
+                    int(snap.get("host_count", 1)), 1
+                )
+                if snap.get("next_batch") is not None:
+                    boundary = int(snap["next_batch"]) * stride_old
+                else:
+                    boundary = -(-int(pos) // stride_old) * stride_old
+                self._replay_pos = boundary
+                stride = self.batch_size * self.host_count
+                local = min(boundary // stride, max(len(self) - 1, 0))
+                self.resume(int(snap["epoch"]), local)
+            elif self._resume is None and snap.get("next_batch") is not None:
                 self.resume(int(snap["epoch"]), int(snap["next_batch"]))
         else:
             # epoch-boundary snapshot: continue the absolute epoch sequence
@@ -544,19 +633,23 @@ class MixturePlane:
             self._trip_memo[id(g)] = got
         return got
 
-    def _fill_batch(self, epoch: int, draw: int,
+    def _fill_batch(self, epoch: int, draw: int, pos: int,
                     cursors: Dict[int, SourceCursor], build: bool):
-        """Consume draws until ``batch_size`` valid samples accumulated.
-        Returns (graphs, sids, draw'); ``build=False`` advances position
+        """Consume draws until ``batch_size`` valid samples landed on THIS
+        host's stripe (every valid draw advances the global position
+        ``pos``; position p belongs to host ``p % host_count``). Returns
+        (graphs, sids, draw', pos'); ``build=False`` advances position
         only (the skip-replay path of a cursor-less resume — validation,
         demotion, and tallies still run so the replay reproduces the
-        original run's side effects deterministically)."""
+        original run's side effects deterministically). Single-host is the
+        degenerate stripe: every position is owned, pos == samples
+        consumed."""
         graphs: List[Graph] = []
         sids: List[int] = []
         filled = 0
         # safety valve: with demotion disabled (demote_after=0) a fully
         # rotted fleet would otherwise skip-draw forever
-        budget = self.batch_size + max(
+        budget = self.batch_size * self.host_count + max(
             20 * sum(len(s.graphs) for s in self.sources.values()), 1000
         )
         attempts = 0
@@ -571,38 +664,98 @@ class MixturePlane:
             attempts += 1
             sid, g = self._draw_one(epoch, draw, cursors)
             draw += 1
-            if g is not None:
+            if g is None:
+                continue
+            mine = pos % self.host_count == self.host_index
+            pos += 1
+            if mine:
                 filled += 1
                 if build:
                     graphs.append(g)
                     sids.append(sid)
-        return graphs, sids, draw
+        return graphs, sids, draw, pos
 
-    def __iter__(self) -> Iterator[GraphBatch]:
+    def _advance_to(self, epoch: int, target: int, draw: int, pos: int,
+                    cursors: Dict[int, SourceCursor]) -> Tuple[int, int]:
+        """Skip-replay from (draw, pos) to an exact global valid-sample
+        position — the layout-change resume path (from zero when cursor-
+        less, from the armed trajectory to the old layout's union boundary
+        otherwise). Returns (draw, pos) at the target."""
+        budget = 20 * max(int(target) - int(pos), 1) + max(
+            20 * sum(len(s.graphs) for s in self.sources.values()), 1000
+        )
+        attempts = 0
+        while pos < target:
+            if attempts > budget:
+                raise MixtureExhaustedError(
+                    f"{attempts} replay draws reached only position {pos} "
+                    f"of {target} (skips per source: {self.epoch_skips}); "
+                    "the active sources are effectively all-invalid"
+                )
+            attempts += 1
+            _, g = self._draw_one(epoch, draw, cursors)
+            draw += 1
+            if g is not None:
+                pos += 1
+        return draw, pos
+
+    def _iter_raw(
+        self, n_batches: Optional[int] = None
+    ) -> Iterator[Tuple[int, List[Graph], List[int]]]:
+        """Yield ``(b, graphs, sids)`` raw sample batches of this host's
+        stripe with full resume/journal/fingerprint bookkeeping — the
+        shared core of ``__iter__`` and the branch-routed mixture driver
+        (parallel/routing.py), which stacks rows from several planes itself
+        and passes its own globally-agreed ``n_batches`` (mixture sources
+        cycle, so a plane can serve more batches than its own ``len``)."""
         epoch = self.epoch
-        n_batches = len(self)
+        if n_batches is None:
+            n_batches = len(self)
         start = max(int(self.start_batch), 0)
         self._journal = {}
         if self._armed_cursors is not None:
-            # sidecar resume: cursors + draw restored AT the armed batch
+            # sidecar resume: cursors + draw + position restored AT the
+            # armed batch (a missing position is a pre-stripe snapshot —
+            # single-host, where position == batches * batch_size)
             cursors = {k: SourceCursor(*c.to_list())
                        for k, c in self._armed_cursors.items()}
             draw = int(self._armed_draw or 0)
+            pos = (
+                int(self._armed_pos)
+                if self._armed_pos is not None
+                else start * self.batch_size * self.host_count
+            )
             self._armed_cursors = None
             self._armed_draw = None
+            self._armed_pos = None
         else:
             cursors = {sid: SourceCursor() for sid in self.sources}
             draw = 0
-            for _ in range(start):  # cursor-less resume: replay, don't build
-                _, _, draw = self._fill_batch(epoch, draw, cursors, build=False)
+            pos = 0
+            if self._replay_pos is None:
+                for _ in range(start):  # cursor-less resume: replay only
+                    _, _, draw, pos = self._fill_batch(
+                        epoch, draw, pos, cursors, build=False
+                    )
+        if self._replay_pos is not None and pos < self._replay_pos:
+            # layout-change resume: advance to the old layout's union
+            # boundary by exact global position, not the old batch grid
+            draw, pos = self._advance_to(
+                epoch, self._replay_pos, draw, pos, cursors
+            )
+        self._replay_pos = None
         self.cursors = cursors
         for b in range(start, n_batches):
             self._journal[b] = {
                 "draw": draw,
+                "pos": pos,
                 "cursors": {k: SourceCursor(*c.to_list())
                             for k, c in cursors.items()},
             }
-            graphs, sids, draw = self._fill_batch(epoch, draw, cursors, True)
+            d0, p0 = draw, pos
+            graphs, sids, draw, pos = self._fill_batch(
+                epoch, draw, pos, cursors, True
+            )
             # batch provenance for the guard/numerics planes: which sources
             # this batch drew from, keyed by batch index — prefetch builds
             # ahead of consumption, so "last batch" would lie (batch_sources)
@@ -611,22 +764,60 @@ class MixturePlane:
             # point one past the last batch built (lookahead == 0)
             self._journal[b + 1] = {
                 "draw": draw,
+                "pos": pos,
                 "cursors": {k: SourceCursor(*c.to_list())
                             for k, c in cursors.items()},
             }
-            spec = self.ladder.select(
-                sum(g.num_nodes for g in graphs),
-                sum(g.num_edges for g in graphs),
-                sum(self._trip_count_of(g) for g in graphs)
-                if self.spec.n_triplets
-                else 0,
-            )
             if self._fingerprint:
                 print(
                     f"MIXBATCH e{epoch} b{b} {_fingerprint(graphs, sids)}",
                     flush=True,
                 )
-            yield batch_graphs(graphs, spec, sort_edges=self.sort_edges)
+                # the stripe audit line (run-scripts/elastic_smoke.py):
+                # half-open global position/draw spans this batch consumed.
+                # Every host replays the full sequence, so spans overlap
+                # across hosts — it is the OWNED positions inside them
+                # (p % host_count == host_index) that partition [0, end)
+                print(
+                    f"MIXSTRIPE e{epoch} b{b} "
+                    f"h{self.host_index}/{self.host_count} "
+                    f"p{p0}:{pos} d{d0}:{draw}",
+                    flush=True,
+                )
+            yield b, graphs, sids
+
+    def __iter__(self) -> Iterator[GraphBatch]:
+        with_trip = bool(self.spec.n_triplets)
+        for _, graphs, _sids in self._iter_raw():
+            if self.num_shards == 1:
+                spec = self.ladder.select(
+                    sum(g.num_nodes for g in graphs),
+                    sum(g.num_edges for g in graphs),
+                    sum(self._trip_count_of(g) for g in graphs)
+                    if with_trip
+                    else 0,
+                )
+                yield batch_graphs(graphs, spec, sort_edges=self.sort_edges)
+                continue
+            shards = [
+                graphs[s :: self.num_shards] for s in range(self.num_shards)
+            ]
+            # one spec for the whole stacked batch: the smallest level
+            # fitting the largest shard (all rows share static shapes)
+            spec = self.ladder.select(
+                max(sum(g.num_nodes for g in s) for s in shards if s),
+                max(sum(g.num_edges for g in s) for s in shards if s),
+                max(
+                    (sum(self._trip_count_of(g) for g in s)
+                     for s in shards if s),
+                    default=0,
+                )
+                if with_trip
+                else 0,
+            )
+            yield stack_shard_batches(
+                shards, spec, self.num_shards, sort_edges=self.sort_edges
+            )
 
     def batch_sources(self, b) -> Optional[List[int]]:
         """Source ids batch ``b`` of the CURRENT epoch drew from, or None
@@ -643,10 +834,26 @@ class MixturePlane:
         """Warm-up templates over the ladder levels any mixture batch can
         select — every source contributes its fitting graphs, so a level
         only one small source can reach is still covered (the compile
-        plane's zero-retrace contract)."""
-        return _module_templates(
-            self.graphs, self.ladder, sort_edges=self.sort_edges
-        )
+        plane's zero-retrace contract). Stacked (multi-shard) planes pad
+        the extra shard rows, mirroring the stacked GraphLoader."""
+        if self.num_shards == 1:
+            return _module_templates(
+                self.graphs, self.ladder, sort_edges=self.sort_edges
+            )
+        out: List[Tuple[PadSpec, GraphBatch]] = []
+        for li, g in selectable_levels(
+            self.graphs, self.ladder, self._trip_count_of
+        ):
+            spec = self.ladder.specs[li]
+            shards = [[g]] + [[] for _ in range(self.num_shards - 1)]
+            out.append((
+                spec,
+                stack_shard_batches(
+                    shards, spec, self.num_shards,
+                    sort_edges=self.sort_edges,
+                ),
+            ))
+        return out
 
     # -- epoch boundary hook (train/loop.py) ---------------------------------
 

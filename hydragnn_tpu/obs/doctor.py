@@ -49,6 +49,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from .events import (
     EV_DATA_SKIP,
+    EV_ELASTIC_GROW,
+    EV_ELASTIC_SHRINK,
     EV_FLEET_DESYNC,
     EV_FLEET_HOST_STALE,
     EV_FLEET_STRAGGLER,
@@ -93,13 +95,15 @@ F_WEDGED_STEP = "wedged_step"            # serving device step wedged
 F_COLD_START = "compile_cold_start"      # warm path regressed to recompiles
 F_UNTUNED_KERNEL = "untuned_kernel"      # TPU run rode default tile plans
 F_CRASH = "crash"                        # unexplained crash dump
+F_ELASTIC_SHRINK = "elastic_shrink"      # fleet re-laid-out onto fewer hosts
+F_ELASTIC_GROW = "elastic_grow"          # fleet re-grew to more hosts
 
 FINDING_KINDS = (
     F_INPUT_BOUND, F_RETRACE_STORM, F_PADDING_WASTE, F_NAN_DIVERGENCE,
     F_LR_ROLLBACK_LOOP, F_STRAGGLER, F_DESYNC, F_STALE_HOST,
     F_HBM_PRESSURE, F_COMM_DOMINANT, F_SHED_SPIRAL, F_QUEUE_SATURATION,
     F_QUARANTINE_ROT, F_LOADER_STALL, F_WEDGED_STEP, F_COLD_START,
-    F_UNTUNED_KERNEL, F_CRASH,
+    F_UNTUNED_KERNEL, F_CRASH, F_ELASTIC_SHRINK, F_ELASTIC_GROW,
 )
 
 _EVIDENCE_CAP = 16  # per finding; a shed spiral does not need 300 records
@@ -758,6 +762,69 @@ def r_stale_host(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
         evidence=evs,
         data={"hosts": hosts},
     )]
+
+
+def _elastic_findings(s: "RunStreams", kind: str, fkind: str,
+                      severity: str, what: str, action: str) -> List[Finding]:
+    """Shared body of the elastic shrink/grow rules: one finding per
+    re-layout event, with the event's before/after layouts, the measured
+    progress loss, and the run's recorded sharding tables as evidence."""
+    evs = s.events_of(kind)
+    out: List[Finding] = []
+    stale = s.events_of(EV_FLEET_HOST_STALE)
+    for e in evs:
+        before = e.get("before") or {}
+        after = e.get("after") or {}
+        lost = e.get("progress_lost_steps")
+        evidence: List[Dict[str, Any]] = [e]
+        if stale:
+            evidence.extend(stale)
+        if s.sharding:
+            # the re-layout's placement record: the rule table's sharding
+            # tables as recorded AFTER the survivor re-laid-out
+            evidence.append({"sharding_tables": sorted(s.sharding)})
+        out.append(Finding(
+            fkind, severity,
+            f"{what}: {before.get('host_count', '?')} -> "
+            f"{after.get('host_count', '?')} host(s) "
+            f"(trigger: {e.get('trigger', '?')}, progress lost: "
+            + (f"{lost} step(s)" if lost is not None
+               else "bounded by the checkpoint cadence") + ")",
+            action,
+            evidence=evidence,
+            data={
+                "before": before, "after": after,
+                **({"progress_lost_steps": int(lost)}
+                   if lost is not None else {}),
+            },
+        ))
+    return out
+
+
+@rule
+def r_elastic_shrink(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    return _elastic_findings(
+        s, EV_ELASTIC_SHRINK, F_ELASTIC_SHRINK, "warn",
+        "elastic shrink: the fleet re-laid-out onto fewer hosts after a "
+        "host loss and resumed from the coordinated checkpoint",
+        "the run is healthy but degraded — re-grow when the host returns "
+        "(the mixture re-deals its draw stripes either way); if shrinks "
+        "recur, check the stale-host findings for the failing host and "
+        "Training.elastic.min_hosts for the capacity floor",
+    )
+
+
+@rule
+def r_elastic_grow(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    return _elastic_findings(
+        s, EV_ELASTIC_GROW, F_ELASTIC_GROW, "info",
+        "elastic re-grow: the fleet returned to a larger topology and "
+        "resumed from the coordinated checkpoint",
+        "no action needed — verify steady-state retraces stayed at zero "
+        "after the re-layout (the compile cache makes the re-grown step "
+        "a cache hit); the paired elastic_shrink finding names what was "
+        "lost in between",
+    )
 
 
 @rule

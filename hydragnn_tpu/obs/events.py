@@ -57,6 +57,8 @@ EV_FLEET_DESYNC = "fleet_desync"          # step progress skewed past the bound
 EV_FLEET_HOST_STALE = "fleet_host_stale"  # host heartbeat missing past timeout
 EV_SHARDING_AUDIT = "sharding_audit"      # inspector flagged an over-replicated leaf
 EV_TILE_PLAN = "tile_plan"                # kernel tile-plan choice (tune/runtime.py)
+EV_ELASTIC_SHRINK = "elastic_shrink"      # fleet re-laid-out onto fewer hosts
+EV_ELASTIC_GROW = "elastic_grow"          # fleet re-laid-out back onto more hosts
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
@@ -67,6 +69,7 @@ EVENT_KINDS = (
     EV_NUMERICS_PROVENANCE,
     EV_FLEET_STRAGGLER, EV_FLEET_DESYNC, EV_FLEET_HOST_STALE,
     EV_SHARDING_AUDIT, EV_TILE_PLAN,
+    EV_ELASTIC_SHRINK, EV_ELASTIC_GROW,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")
@@ -104,6 +107,9 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     EV_FLEET_HOST_STALE: "warn",
     EV_SHARDING_AUDIT: "warn",
     EV_TILE_PLAN: "info",
+    # a shrink is progress lost + degraded capacity; a re-grow is recovery
+    EV_ELASTIC_SHRINK: "warn",
+    EV_ELASTIC_GROW: "info",
 }
 
 
@@ -124,6 +130,14 @@ DEFAULT_CAPACITY = 256
 def _json_safe(v: Any) -> Any:
     if isinstance(v, (bool, int, float, str)) or v is None:
         return v
+    if isinstance(v, dict):
+        # structured evidence (elastic before/after layouts, sharding-table
+        # summaries) must survive as objects, not reprs — the doctor
+        # indexes into them
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set, frozenset)):
+        items = sorted(v, key=str) if isinstance(v, (set, frozenset)) else v
+        return [_json_safe(x) for x in items]
     return str(v)
 
 
@@ -145,6 +159,9 @@ class EventLog:
         # surviving inside flight dumps
         self._sink_fh = None
         self._sink_path: Optional[str] = None
+        # records emitted while no sink was attached, written out by the
+        # next attach_jsonl (bounded by the ring capacity)
+        self._unstreamed: List[Dict[str, Any]] = []
         self._counter = registry().counter(
             "hydragnn_events_total",
             "Structured incident events emitted, by kind "
@@ -197,6 +214,14 @@ class EventLog:
                         RuntimeWarning,
                         stacklevel=2,
                     )
+            else:
+                # no sink yet: hold for backfill on the next attach — an
+                # incident emitted before the run dir exists (e.g. the
+                # elastic_shrink record from the resume guard, which runs
+                # before the train loop arms events.jsonl) must still
+                # reach the doctor's on-disk stream
+                self._unstreamed.append(rec)
+                del self._unstreamed[: -self._ring.maxlen]
         try:
             self._counter.inc(kind=rec["kind"])
         except Exception:
@@ -221,6 +246,12 @@ class EventLog:
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
                 self._sink_fh = open(path, "a")
                 self._sink_path = path
+                if self._unstreamed:
+                    # backfill incidents that predate the sink (see emit)
+                    for rec in self._unstreamed:
+                        self._sink_fh.write(json.dumps(rec) + "\n")
+                    self._sink_fh.flush()
+                    self._unstreamed.clear()
             except OSError as e:
                 self._sink_path = None
                 warnings.warn(
@@ -255,6 +286,7 @@ class EventLog:
         """Drop buffered events (tests; the counter keeps its totals)."""
         with self._lock:
             self._ring.clear()
+            self._unstreamed.clear()
 
 
 _EVENTS = EventLog()

@@ -395,9 +395,10 @@ def setup_distributed() -> None:
 
     Must run before anything touches the XLA backend — including
     ``jax.process_count()`` — so the already-initialized guard uses
-    ``jax.distributed.is_initialized()``, which doesn't.
+    ``jax.distributed.is_initialized()``, which doesn't (older jaxlibs
+    lack the helper entirely: treat that as not-initialized).
     """
-    if jax.distributed.is_initialized():
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
         return
     coord = envflags.env_str("HYDRAGNN_COORDINATOR") or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"

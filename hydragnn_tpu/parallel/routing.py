@@ -11,12 +11,70 @@ branch-major order. Moved here from the retired parallel/branch.py
 
 from __future__ import annotations
 
-from typing import Iterator, List, Sequence
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
 
-class BranchRoutedLoader:
+class _RowStacker:
+    """Shared row-stacking machinery of the branch-routed feeders: padded
+    per-row batches stacked into the leading device axis, all-padding
+    filler rows for empty slots, and the memoized triplet counter the
+    DimeNet ladders budget with. Subclasses provide ``graphs``,
+    ``sort_edges``, ``_templates`` and ``_trip_memo``."""
+
+    def _trip_count_of(self, g) -> int:
+        from ..data.graph import _triplet_count
+
+        got = self._trip_memo.get(id(g))
+        if got is None:
+            got = _triplet_count(g)
+            self._trip_memo[id(g)] = got
+        return got
+
+    def _filler_arrs(self, spec):
+        """One all-padding row's array dict at ``spec``: masks false,
+        edges/nodes parked on the dummy slots (the GraphLoader stacked-path
+        template convention, data/pipeline.stack_shard_batches)."""
+        from ..data.graph import batch_graphs_np
+
+        key = spec
+        if key not in self._templates:
+            g = next(
+                (
+                    c
+                    for c in self.graphs
+                    if c.num_nodes <= spec.n_nodes - 1
+                    and c.num_edges <= spec.n_edges
+                ),
+                self.graphs[0],
+            )
+            arrs = batch_graphs_np([g], spec)
+            z = {k: np.zeros_like(v) for k, v in arrs.items()}
+            z["senders"] = np.full_like(arrs["senders"], spec.n_nodes - 1)
+            z["receivers"] = z["senders"].copy()
+            z["node_graph"] = np.full_like(arrs["node_graph"], spec.n_graphs - 1)
+            self._templates[key] = z
+        return self._templates[key]
+
+    def _stack_rows(self, rows, spec):
+        """Stack per-row padded batches (branch-major row order preserved);
+        empty rows become all-padding fillers at the same spec."""
+        from ..data.graph import batch_graphs_np, graph_batch_from_np
+
+        arr_list = [
+            batch_graphs_np(r, spec, sort_edges=self.sort_edges)
+            if r
+            else self._filler_arrs(spec)
+            for r in rows
+        ]
+        stacked = {
+            k: np.stack([a[k] for a in arr_list]) for k in arr_list[0]
+        }
+        return graph_batch_from_np(stacked)
+
+
+class BranchRoutedLoader(_RowStacker):
     """Stacked-batch loader whose shard rows are grouped by branch block.
 
     Wraps one ``GraphLoader`` per branch (each over that branch's graphs,
@@ -163,56 +221,6 @@ class BranchRoutedLoader:
         self._len = max(steps)
         self._templates: dict = {}
 
-    def _trip_count_of(self, g) -> int:
-        from ..data.graph import _triplet_count
-
-        got = self._trip_memo.get(id(g))
-        if got is None:
-            got = _triplet_count(g)
-            self._trip_memo[id(g)] = got
-        return got
-
-    def _filler_arrs(self, spec):
-        """One all-padding row's array dict at ``spec``: masks false,
-        edges/nodes parked on the dummy slots (the GraphLoader stacked-path
-        template convention, data/pipeline.py _make_stacked)."""
-        from ..data.graph import batch_graphs_np
-
-        key = spec
-        if key not in self._templates:
-            g = next(
-                (
-                    c
-                    for c in self.graphs
-                    if c.num_nodes <= spec.n_nodes - 1
-                    and c.num_edges <= spec.n_edges
-                ),
-                self.graphs[0],
-            )
-            arrs = batch_graphs_np([g], spec)
-            z = {k: np.zeros_like(v) for k, v in arrs.items()}
-            z["senders"] = np.full_like(arrs["senders"], spec.n_nodes - 1)
-            z["receivers"] = z["senders"].copy()
-            z["node_graph"] = np.full_like(arrs["node_graph"], spec.n_graphs - 1)
-            self._templates[key] = z
-        return self._templates[key]
-
-    def _stack_rows(self, rows, spec):
-        """Stack per-row padded batches (branch-major row order preserved);
-        empty rows become all-padding fillers at the same spec."""
-        from ..data.graph import batch_graphs_np, graph_batch_from_np
-
-        arr_list = [
-            batch_graphs_np(r, spec, sort_edges=self.sort_edges)
-            if r
-            else self._filler_arrs(spec)
-            for r in rows
-        ]
-        stacked = {
-            k: np.stack([a[k] for a in arr_list]) for k in arr_list[0]
-        }
-        return graph_batch_from_np(stacked)
-
     def spec_template_batches(self):
         """Compile-plane warm-up templates (train/compile_plane.py): one
         stacked specialization per ladder level ANY branch can land a row
@@ -264,6 +272,295 @@ class BranchRoutedLoader:
                 max((sum(g.num_edges for g in r) for r in rows if r), default=0),
                 max(
                     (sum(self._trip_count_of(g) for g in r) for r in rows if r),
+                    default=0,
+                )
+                if self.spec.n_triplets
+                else 0,
+            )
+            yield self._stack_rows(rows, spec)
+
+
+class BranchRoutedMixture(_RowStacker):
+    """Branch-routed mixture feeder: one ``MixturePlane`` per served branch,
+    rows stacked branch-major for the routed mesh step — the mixture
+    counterpart of ``BranchRoutedLoader``.
+
+    Row geometry is identical to the loader (``L = num_shards`` local rows,
+    ``G = host_count * L`` global rows, ``R = G / branch_count`` rows per
+    branch, local row ``r`` serves branch ``(host_index*L + r) // R``). Each
+    served branch gets a ``MixturePlane`` over that branch's sources with
+    the branch's HOST GROUP as its draw stripe (``host_count = hosts_b``,
+    ``host_index = host_rank_b``), so per-branch draw sequences divide
+    deterministically across the hosts sharing the branch with zero
+    collectives — the same purity argument as the flat multi-host mixture
+    (mix/plane.py "host loss").
+
+    Mixture sources cycle (cursors re-permute per pass), so unlike the
+    loader there are no exhausted-branch filler rows: the globally agreed
+    epoch length is the MAX over all branches of their draw-budget step
+    count, computed from the full source list on every host.
+
+    ``Mixture.draws_per_epoch`` is a GLOBAL budget: each branch plane gets
+    an equal ``draws_per_epoch / branch_count`` share.
+    """
+
+    # loader-compat surface consumed by the loop / api
+    pack = False
+
+    def __init__(
+        self,
+        sources: Sequence,
+        batch_size: int,
+        settings: Dict[str, Any],
+        branch_count: int,
+        num_shards: int,
+        spec=None,
+        seed: int = 0,
+        sort_edges: bool = False,
+        validator=None,
+        num_buckets: int = 1,
+        host_count: int = 1,
+        host_index: int = 0,
+    ):
+        from ..data.graph import SpecLadder
+        from ..mix.plane import MixturePlane
+
+        L = num_shards
+        G = host_count * L
+        assert G % branch_count == 0, (
+            f"{G} global rows not divisible by {branch_count} branches"
+        )
+        R = G // branch_count
+        assert (R >= L and R % L == 0) or (R < L and L % R == 0), (
+            f"branch rows R={R} and host rows L={L} misaligned: "
+            f"host_count*local_devices ({G}) must tile branch_count "
+            f"({branch_count}) without a host straddling a branch boundary"
+        )
+        assert batch_size % L == 0
+        per_row_bs = batch_size // L
+        all_graphs = [g for s in sources for g in s.graphs]
+        ids = sorted({g.dataset_id for g in all_graphs})
+        assert len(ids) == branch_count, (
+            f"dataset ids {ids} != branch_count {branch_count}"
+        )
+        # a mixture source feeds exactly one decoder branch (its dataset)
+        by_branch: Dict[int, list] = {i: [] for i in ids}
+        for s in sources:
+            sids = {g.dataset_id for g in s.graphs}
+            if len(sids) != 1:
+                raise ValueError(
+                    f"mixture source {s.name!r} spans dataset ids "
+                    f"{sorted(sids)}; branch-parallel routing needs one "
+                    "dataset id per source (one decoder branch each)"
+                )
+            by_branch[sids.pop()].append(s)
+        row_branch = [(host_index * L + r) // R for r in range(L)]
+        served = sorted(set(row_branch))
+        base_seed = int(
+            settings.get("seed") if settings.get("seed") is not None else seed
+        )
+        dpe = int(settings.get("draws_per_epoch", 0) or 0)
+        if spec is None:
+            spec = SpecLadder.for_dataset(
+                all_graphs, max(per_row_bs, 1), num_buckets=max(num_buckets, 1)
+            )
+        if not isinstance(spec, SpecLadder):
+            spec = SpecLadder((spec,))
+        if host_count > 1 and len(spec.specs) > 1:
+            # same rule as BranchRoutedLoader: level choice cannot agree
+            # across hosts without a collective — keep the worst level
+            spec = SpecLadder((spec.specs[-1],))
+        self.ladder = spec
+        self.spec = spec.specs[-1]
+        self.planes: List[MixturePlane] = []
+        self._plane_rows: List[int] = []
+        self._served_ids: List[int] = []
+        for b in served:
+            rows_b = row_branch.count(b)
+            hosts_b = max(R // rows_b, 1)
+            host_rank_b = (
+                (host_index * L - b * R) // L if hosts_b > 1 else 0
+            )
+            bsources = by_branch[ids[b]]
+            bset = dict(settings)
+            bset["seed"] = base_seed + 17 * b
+            if dpe > 0:
+                bset["draws_per_epoch"] = max(dpe // branch_count, 1)
+            if settings.get("weights"):
+                names = {s.name for s in bsources}
+                bset["weights"] = {
+                    k: v
+                    for k, v in settings["weights"].items()
+                    if k in names
+                }
+            self.planes.append(
+                MixturePlane(
+                    bsources,
+                    per_row_bs * rows_b,
+                    bset,
+                    spec=self.ladder,
+                    sort_edges=sort_edges,
+                    validator=validator,
+                    host_count=hosts_b,
+                    host_index=host_rank_b,
+                )
+            )
+            self._plane_rows.append(rows_b)
+            self._served_ids.append(ids[b])
+        self.graphs = all_graphs
+        self.batch_size = batch_size
+        self.num_shards = L
+        self.host_count = host_count
+        self.host_index = host_index
+        self.sort_edges = sort_edges
+        self.seed = base_seed
+        self._trip_memo: dict = {}
+        self._templates: dict = {}
+        # GLOBALLY agreed step count from the FULL source list: for every
+        # branch the per-step global sample take is per_row_bs * R
+        # (rows_served * hosts_b == R), so hosts serving different branches
+        # still agree without a collective
+        steps = []
+        for b in range(branch_count):
+            bdpe = max(dpe // branch_count, 1) if dpe > 0 else 0
+            budget = bdpe or sum(len(s.graphs) for s in by_branch[ids[b]])
+            steps.append(max(budget // (per_row_bs * R), 1))
+        self._len = max(steps)
+
+    # -- loader surface ------------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        return self.planes[0].epoch
+
+    def set_epoch(self, epoch: int) -> None:
+        for p in self.planes:
+            p.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        return self._len
+
+    def resume(self, epoch: int, next_batch: int) -> None:
+        for p in self.planes:
+            p.resume(epoch, next_batch)
+
+    def state_dict(self, next_batch: int = 0) -> Dict[str, Any]:
+        return {
+            "seed": int(self.seed),
+            "epoch": int(self.epoch),
+            "next_batch": int(next_batch),
+            "num_batches": int(len(self)),
+            "mixture": self.mixture_state_dict(next_batch=int(next_batch)),
+        }
+
+    def mixture_state_dict(
+        self, next_batch: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """Per-branch snapshots keyed by dataset id, wrapped with the row
+        layout that wrote them — each host persists exactly the branches it
+        serves (its own sidecar restores them on the same layout)."""
+        return {
+            "routed": True,
+            "epoch": int(self.epoch),
+            "next_batch": int(next_batch) if next_batch is not None else None,
+            "host_count": int(self.host_count),
+            "host_index": int(self.host_index),
+            "num_shards": int(self.num_shards),
+            "branches": {
+                str(bid): p.mixture_state_dict(next_batch=next_batch)
+                for bid, p in zip(self._served_ids, self.planes)
+            },
+        }
+
+    def restore_mixture(
+        self, snap: Dict[str, Any], mid_epoch: bool = False
+    ) -> None:
+        if not snap:
+            return
+        if not snap.get("routed"):
+            raise ValueError(
+                "mixture snapshot was written by a non-routed (flat) "
+                "mixture run but this run is branch-parallel routed; "
+                "finish the restart on the original layout or delete the "
+                "mixture sidecar to start fresh"
+            )
+        same_layout = (
+            int(snap.get("host_count", 1)) == self.host_count
+            and int(snap.get("host_index", 0)) == self.host_index
+            and int(snap.get("num_shards", self.num_shards))
+            == self.num_shards
+        )
+        if mid_epoch and not same_layout:
+            raise ValueError(
+                "branch-routed mixture cannot resume MID-EPOCH across a "
+                f"row-layout change (snapshot host {snap.get('host_index')}"
+                f"/{snap.get('host_count')} x {snap.get('num_shards')} "
+                f"rows, this run host {self.host_index}/{self.host_count} "
+                f"x {self.num_shards} rows): per-branch host groups would "
+                "need each other's sidecars. Restart on the original "
+                "layout, or drop Parallel.branch_parallel for the elastic "
+                "leg — the flat multi-host mixture re-deals stripes across "
+                "layout changes"
+            )
+        branches = snap.get("branches") or {}
+        for bid, p in zip(self._served_ids, self.planes):
+            sub = branches.get(str(bid))
+            if sub:
+                p.restore_mixture(sub, mid_epoch=mid_epoch)
+
+    def batch_sources(self, b) -> Optional[List[int]]:
+        out: set = set()
+        for p in self.planes:
+            got = p.batch_sources(b)
+            if got:
+                out.update(got)
+        return sorted(out) if out else None
+
+    def mixture_epoch_hook(self, epoch: int, tasks: Dict[str, float],
+                           writer=None, verbosity: int = 0,
+                           log_name: str = "run") -> None:
+        for bid, p in zip(self._served_ids, self.planes):
+            p.mixture_epoch_hook(
+                epoch, tasks, writer=writer, verbosity=verbosity,
+                log_name=f"{log_name}/branch{bid}",
+            )
+
+    def spec_template_batches(self):
+        """Union of the per-branch selectable ladder levels, stacked with
+        filler rows (the BranchRoutedLoader warm-up contract)."""
+        from ..data.pipeline import selectable_levels
+
+        by_level: dict = {}
+        for p in self.planes:
+            for li, g in selectable_levels(
+                p.graphs, self.ladder, p._trip_count_of
+            ):
+                by_level.setdefault(li, g)
+        out = []
+        for li in sorted(by_level):
+            spec = self.ladder.specs[li]
+            rows = [[by_level[li]]] + [
+                [] for _ in range(self.num_shards - 1)
+            ]
+            out.append((spec, self._stack_rows(rows, spec)))
+        return out
+
+    def __iter__(self) -> Iterator:
+        # every plane starts at the same (possibly resumed) batch index, so
+        # zip keeps them in lockstep and ends the epoch together
+        gens = [p._iter_raw(self._len) for p in self.planes]
+        for parts in zip(*gens):
+            rows: List[list] = []
+            for (_, graphs, _sids), rows_b in zip(parts, self._plane_rows):
+                rows.extend(graphs[s::rows_b] for s in range(rows_b))
+            spec = self.ladder.select(
+                max((sum(g.num_nodes for g in r) for r in rows if r),
+                    default=0),
+                max((sum(g.num_edges for g in r) for r in rows if r),
+                    default=0),
+                max(
+                    (sum(self._trip_count_of(g) for g in r)
+                     for r in rows if r),
                     default=0,
                 )
                 if self.spec.n_triplets

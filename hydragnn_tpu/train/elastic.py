@@ -1,0 +1,254 @@
+"""Elastic shrink/grow coordination for pod-scale GFM runs
+(docs/GFM.md "Multi-host and elastic operation").
+
+The fleet plane (obs/fleet.py) *detects* dead and straggling hosts — a
+missing heartbeat past the stale window emits a typed
+``fleet_host_stale`` event — but never *acts*: the run just dies with the
+host. This module closes the loop with a **checkpoint-restart** protocol
+(not live migration — restarts here are cheap by design: persistent
+compile cache, fingerprint-exact mixture resume, mid-epoch cursors):
+
+1. **detect**: the driver (``run-scripts/elastic_smoke.py``, or a real
+   launcher) feeds fleet watchdog events and child-process exits into an
+   ``ElasticCoordinator``;
+2. **plan**: a confirmed host loss yields an ``ElasticPlan`` — the
+   survivor set, each survivor's remapped contiguous rank, and the per-
+   child env overlay (``HYDRAGNN_FLEET_HOST_INDEX``/``_COUNT``) for the
+   relaunch; a rejoin yields the symmetric grow plan;
+3. **re-layout**: survivors restart with ``Training.continue`` on the
+   shrunk topology — the mesh re-resolves through the rule table
+   (parallel/rules.py) on the new ``(data, model)`` shape, and the
+   mixture's draw stripes re-deal over the survivor set by global
+   position (mix/plane.py ``restore_mixture``): no draw duplicated, none
+   lost, bounded progress loss (at most the steps since the last
+   coordinated checkpoint);
+4. **record**: the restarted run detects the layout change during resume
+   (api.run_training) and calls ``note_relayout``, emitting a typed
+   ``elastic_shrink`` / ``elastic_grow`` event whose attrs carry the
+   before/after layouts and the progress lost in steps — the evidence the
+   run doctor's elastic rules surface (obs/doctor.py).
+
+Config surface (``Training.elastic``, config/config.py): ``enabled`` arms
+the driver-side coordinator, ``min_hosts`` refuses to shrink below a
+floor, ``grace_s`` bounds how long a preempted host may checkpoint before
+it counts as dead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..obs.events import EV_ELASTIC_GROW, EV_ELASTIC_SHRINK, emit
+
+
+@dataclasses.dataclass(frozen=True)
+class ElasticPlan:
+    """One planned re-layout: relaunch every entry of ``ranks`` with its
+    env overlay, resuming from the last coordinated checkpoint."""
+
+    kind: str  # "shrink" | "grow"
+    trigger: str  # e.g. "fleet_host_stale", "exit", "preempt", "rejoin"
+    before_hosts: int
+    after_hosts: int
+    # old rank -> new contiguous rank for every survivor (grow plans map
+    # identity for existing hosts and add fresh ranks at the tail)
+    rank_map: Dict[int, int]
+
+    @property
+    def ranks(self) -> List[int]:
+        """New contiguous ranks to (re)launch, ascending."""
+        return sorted(self.rank_map.values())
+
+    def child_env(self, new_rank: int) -> Dict[str, str]:
+        """Env overlay for the relaunched child at ``new_rank`` — the
+        simulated-fleet identity surface (obs/fleet.host_identity) that
+        also feeds the mixture stripe (api.prepare_data)."""
+        return {
+            "HYDRAGNN_FLEET_HOST_INDEX": str(int(new_rank)),
+            "HYDRAGNN_FLEET_HOST_COUNT": str(int(self.after_hosts)),
+        }
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "trigger": self.trigger,
+            "before_hosts": int(self.before_hosts),
+            "after_hosts": int(self.after_hosts),
+            "rank_map": {str(k): int(v) for k, v in self.rank_map.items()},
+        }
+
+
+def plan_shrink(
+    host_count: int,
+    dead_hosts: Sequence[int],
+    min_hosts: int = 1,
+    trigger: str = "fleet_host_stale",
+) -> ElasticPlan:
+    """Shrink plan after losing ``dead_hosts``: survivors keep their
+    relative order and get contiguous new ranks (the mixture stripe and
+    the GraphLoader host shard both need ``0 <= index < count``)."""
+    dead = {int(h) for h in dead_hosts}
+    survivors = [h for h in range(int(host_count)) if h not in dead]
+    if len(survivors) < max(int(min_hosts), 1):
+        raise RuntimeError(
+            f"cannot shrink below Training.elastic.min_hosts="
+            f"{min_hosts}: {len(survivors)} survivor(s) of "
+            f"{host_count} after losing hosts {sorted(dead)}"
+        )
+    return ElasticPlan(
+        kind="shrink",
+        trigger=trigger,
+        before_hosts=int(host_count),
+        after_hosts=len(survivors),
+        rank_map={h: i for i, h in enumerate(survivors)},
+    )
+
+
+def plan_grow(
+    host_count: int, target_hosts: int, trigger: str = "rejoin"
+) -> ElasticPlan:
+    """Grow plan back to ``target_hosts``: current ranks keep their index,
+    rejoined hosts fill the tail ranks."""
+    if int(target_hosts) <= int(host_count):
+        raise ValueError(
+            f"grow target {target_hosts} is not larger than the current "
+            f"{host_count} host(s)"
+        )
+    return ElasticPlan(
+        kind="grow",
+        trigger=trigger,
+        before_hosts=int(host_count),
+        after_hosts=int(target_hosts),
+        rank_map={h: h for h in range(int(target_hosts))},
+    )
+
+
+class ElasticCoordinator:
+    """Driver-side detection -> plan state machine.
+
+    Feed it fleet watchdog events (``observe_event``), child exits
+    (``observe_exit``) and rejoin notices (``observe_rejoin``); it answers
+    with an ``ElasticPlan`` when the fleet must re-lay-out, or None. One
+    coordinator instance tracks one logical fleet; ``host_count`` follows
+    the applied plans."""
+
+    def __init__(
+        self,
+        host_count: int,
+        min_hosts: int = 1,
+        grace_s: float = 30.0,
+    ):
+        self.host_count = int(host_count)
+        self.min_hosts = max(int(min_hosts), 1)
+        self.grace_s = float(grace_s)
+        self._dead: set = set()
+
+    @classmethod
+    def from_config(
+        cls, config: Dict[str, Any], host_count: int
+    ) -> Optional["ElasticCoordinator"]:
+        """Build from the completed config's ``Training.elastic`` block
+        (config/config.py fills the defaults). Returns None when
+        ``enabled`` is false — the driver then treats any host loss as
+        fatal instead of planning a shrink."""
+        el = (
+            config.get("NeuralNetwork", {})
+            .get("Training", {})
+            .get("elastic", {})
+        ) or {}
+        if not el.get("enabled", False):
+            return None
+        return cls(
+            host_count,
+            min_hosts=int(el.get("min_hosts", 1)),
+            grace_s=float(el.get("grace_s", 30.0)),
+        )
+
+    def _shrink(self, host: int, trigger: str) -> Optional[ElasticPlan]:
+        h = int(host)
+        if h in self._dead or not 0 <= h < self.host_count:
+            return None  # already planned around, or not ours
+        self._dead.add(h)
+        plan = plan_shrink(
+            self.host_count, self._dead, self.min_hosts, trigger=trigger
+        )
+        return plan
+
+    def observe_event(
+        self, kind: str, attrs: Optional[Dict[str, Any]] = None
+    ) -> Optional[ElasticPlan]:
+        """A fleet-plane event record: ``fleet_host_stale`` for a host not
+        already planned around yields a shrink plan."""
+        if kind != "fleet_host_stale":
+            return None
+        host = (attrs or {}).get("host")
+        if host is None:
+            return None
+        return self._shrink(int(host), trigger="fleet_host_stale")
+
+    def observe_exit(
+        self, host: int, returncode: Optional[int]
+    ) -> Optional[ElasticPlan]:
+        """A fleet child exited. Exit 0 is a normal end (no plan); anything
+        else — including signal deaths (negative returncodes) — is a host
+        loss. SIGTERM exits had their grace window (the preemption handler
+        checkpoints mid-epoch first), so both paths converge here."""
+        if returncode == 0:
+            return None
+        trigger = "preempt" if returncode in (-15,) else "exit"
+        return self._shrink(int(host), trigger=trigger)
+
+    def observe_rejoin(self, target_hosts: int) -> Optional[ElasticPlan]:
+        """A host (or the original fleet size) is available again."""
+        if int(target_hosts) <= self.host_count - len(self._dead):
+            return None
+        plan = plan_grow(
+            self.host_count - len(self._dead),
+            int(target_hosts),
+            trigger="rejoin",
+        )
+        return plan
+
+    def applied(self, plan: ElasticPlan) -> None:
+        """The driver relaunched per ``plan`` — track the new fleet."""
+        self.host_count = plan.after_hosts
+        self._dead.clear()
+
+
+def note_relayout(
+    old_layout: Dict[str, Any],
+    new_layout: Dict[str, Any],
+    trigger: str = "resume",
+    progress_lost_steps: Optional[int] = None,
+) -> None:
+    """Record a detected re-layout as a typed event — called by the
+    RESTARTED survivor when resume finds the sidecar was written under a
+    different stripe layout (api.run_training), with the before/after
+    layouts and the bounded progress loss as evidence. The run doctor's
+    ``elastic_shrink``/``elastic_grow`` rules read exactly this record
+    (obs/doctor.py), pairing it with the run's recorded sharding tables
+    (obs/sharding.py snapshot -> flightrec sharding.json)."""
+    before = int(old_layout.get("host_count", 1) or 1)
+    after = int(new_layout.get("host_count", 1) or 1)
+    kind = EV_ELASTIC_SHRINK if after < before else EV_ELASTIC_GROW
+    attrs: Dict[str, Any] = {
+        "trigger": str(trigger),
+        "before": {k: old_layout[k] for k in sorted(old_layout)},
+        "after": {k: new_layout[k] for k in sorted(new_layout)},
+    }
+    if progress_lost_steps is not None:
+        attrs["progress_lost_steps"] = int(progress_lost_steps)
+    try:
+        from ..obs import sharding as _sharding
+
+        snap = _sharding.snapshot()
+        if snap:
+            # compact per-table summaries, not the full leaf tables — the
+            # event stream is a journal, not a dump
+            attrs["sharding_tables"] = {
+                name: rec.get("summary", {}) for name, rec in snap.items()
+            }
+    except Exception:
+        pass
+    emit(kind, **attrs)

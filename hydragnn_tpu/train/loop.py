@@ -423,6 +423,10 @@ def train_epoch(loader, step_fn, state, rng, start_batch: int = 0,
         # heartbeat, or the drill would not model what the watchdog
         # measures
         faultinject.maybe_straggle(i)
+        # host-loss drills (elastic_smoke): SIGKILL (dead host) or SIGTERM
+        # (preemption with grace) this process before dispatching a step —
+        # armed on the cumulative cross-epoch step count, not i
+        faultinject.maybe_host_fault()
         out = step_fn(state, batch, sub)
         # a numerics-enabled step rides its stat bundle as a 4th output
         # (obs/numerics.py); the historical 3-tuple is unchanged otherwise

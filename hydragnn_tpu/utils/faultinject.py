@@ -72,6 +72,21 @@ Fleet-plane points (docs/OBSERVABILITY.md "Fleet"):
   (obs/fleet.py) must flag as a typed ``fleet_straggler`` event with a
   coordinated flight dump, exercised by ``run-scripts/fleet_smoke.py``
   with the env set on exactly one simulated host.
+- ``HYDRAGNN_FAULT_HOST_KILL`` (``"k"``, ``"k+"``, comma lists; the index
+  counts cumulative train steps across ALL epochs of this process, so a
+  drill can fire after the epoch-0 checkpoint committed):
+  ``maybe_host_fault`` SIGKILLs this process before dispatching the listed
+  training-step indices — the dead-host model (hardware loss, OOM-killer):
+  no grace, no signal handler, nothing runs after it. The fleet watchdog
+  sees the heartbeat go stale and the elastic coordinator
+  (train/elastic.py) drives the survivors' re-layout; exercised by
+  ``run-scripts/elastic_smoke.py`` with the env set on one simulated host.
+- ``HYDRAGNN_FAULT_HOST_PREEMPT`` (same grammar): ``maybe_host_fault``
+  SIGTERMs this process at the listed step instead — the scheduler-
+  preemption model WITH grace: the run's SIGTERM handler
+  (train/preempt.py) performs the coordinated mid-epoch checkpoint before
+  exit, so recovery resumes from the exact step rather than the last
+  epoch boundary.
 
 ``flip_bit`` is the host-side corruption tool for the torn/rotted-checkpoint
 tests: flip one bit of a saved file and assert restore falls back to the
@@ -114,6 +129,8 @@ def configure(**kwargs: Optional[str]) -> None:
         "serve_wedge": "HYDRAGNN_FAULT_SERVE_WEDGE",
         "serve_slow_client": "HYDRAGNN_FAULT_SERVE_SLOW_CLIENT",
         "straggle": "HYDRAGNN_FAULT_STRAGGLE",
+        "host_kill": "HYDRAGNN_FAULT_HOST_KILL",
+        "host_preempt": "HYDRAGNN_FAULT_HOST_PREEMPT",
     }
     for k, v in kwargs.items():
         if k not in keymap:
@@ -126,9 +143,11 @@ def configure(**kwargs: Optional[str]) -> None:
 
 def reset() -> None:
     """Clear configure() state and the per-point counters."""
+    global _host_fault_steps
     _config.clear()
     _io_error_counts.clear()
     _socket_call_counts.clear()
+    _host_fault_steps = 0
 
 
 def _get(key: str) -> Optional[str]:
@@ -359,6 +378,39 @@ def maybe_straggle(step_index: int) -> None:
     slow-host model of a fleet straggler. Called from the epoch loop
     before each step dispatch; an unarmed call is one dict lookup."""
     _indexed_sleep(_get("HYDRAGNN_FAULT_STRAGGLE"), step_index, 0.05)
+
+
+_host_fault_steps = 0
+
+
+def maybe_host_fault(step_index: Optional[int] = None) -> None:
+    """Host-loss drill hook, called from the epoch loop before each step
+    dispatch (beside ``maybe_straggle``). Unlike the other indexed points,
+    the armed index counts CUMULATIVE train steps dispatched by this
+    process across epochs — a dead-host drill must fire *after* the
+    epoch-0 checkpoint committed, which a per-epoch index cannot express
+    (the epoch loop restarts its counter every epoch). When the step is
+    armed:
+
+    - HYDRAGNN_FAULT_HOST_KILL → SIGKILL this process (dead-host model:
+      nothing runs after it — the fleet watchdog must detect the stale
+      heartbeat and the elastic coordinator re-lay-out the survivors);
+    - HYDRAGNN_FAULT_HOST_PREEMPT → SIGTERM this process (preemption-with-
+      grace model: the run's SIGTERM handler checkpoints mid-epoch first).
+
+    Both use the shared ``_index_armed`` grammar (``"k"``, ``"k+"``, comma
+    lists). ``step_index`` overrides the process counter (tests). An
+    unarmed call is two dict lookups."""
+    global _host_fault_steps
+    if step_index is None:
+        step_index = _host_fault_steps
+    _host_fault_steps += 1
+    kill = _get("HYDRAGNN_FAULT_HOST_KILL")
+    if kill is not None and _index_armed(kill, step_index):
+        os.kill(os.getpid(), signal.SIGKILL)
+    preempt = _get("HYDRAGNN_FAULT_HOST_PREEMPT")
+    if preempt is not None and _index_armed(preempt, step_index):
+        os.kill(os.getpid(), signal.SIGTERM)
 
 
 def flip_bit(path: str, byte_offset: Optional[int] = None, bit: int = 0) -> int:

@@ -90,6 +90,9 @@ python run-scripts/fleet_smoke.py
 echo "== run-doctor smoke (fault drills: planted NaN/stall/corrupt/wedge/straggler each named exactly, clean run zero findings, dump-only forensics, watch mode, doctor diff consistent with gate_verdict.json) =="
 python run-scripts/doctor_smoke.py
 
+echo "== elastic smoke (2-host striped 26-family mixture: mid-epoch host SIGKILL -> coordinated survivor checkpoint + re-layout + draw-sequence audit + doctor elastic_shrink; re-grow to original topology, zero steady-state retraces) =="
+python run-scripts/elastic_smoke.py
+
 echo "== BENCH_MIX cells (mixture stream + balanced-train goodput, per-source graphs/sec, loss drift) =="
 BENCH_MIX=1 BENCH_MIX_EPOCHS=2 BENCH_MIX_CONFIGS=120 python bench.py
 

@@ -181,28 +181,11 @@ print("CHILD_SERVE_OK", flush=True)
 """
 
 
-def _env(extra=None):
-    env = {
-        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
-    }
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = ":".join(
-        p
-        for p in [_REPO] + env.get("PYTHONPATH", "").split(":")
-        if p and ".axon_site" not in p
-    )
-    # KNOWN ISSUE (this image's jaxlib, found BY the clean leg's
-    # zero-findings gate): the persistent compilation cache intermittently
-    # hands back a corrupted deserialized executable — ~30% of toy runs
-    # train 1-2 garbage steps at epoch 1 (guard-skipped, val corrupted),
-    # bit-deterministic otherwise; 0/8 with the cache off, reproduced on
-    # the unmodified tree with telemetry fully off. Same jaxlib
-    # cache-path defect class fleet_smoke works around via the analysis
-    # mode. The drills run cache-less so the gate measures the doctor,
-    # not this jaxlib.
-    env["HYDRAGNN_COMPILE_CACHE"] = "0"
-    env.update(extra or {})
-    return env
+# cache-less scrubbed children: the jaxlib persistent-cache defect this
+# works around (found BY the clean leg's zero-findings gate) is
+# documented in smoke_env.py
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from smoke_env import child_env as _env  # noqa: E402
 
 
 def _fail(tag, out, rc=None):

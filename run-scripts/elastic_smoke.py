@@ -1,0 +1,445 @@
+#!/usr/bin/env python
+"""CI elastic-fleet smoke (docs/GFM.md "Multi-host and elastic
+operation"; wired into ci.sh). A 2-process **simulated fleet** (the
+fleet_smoke recipe: independent subprocess hosts with
+``HYDRAGNN_FLEET_HOST_INDEX``/``_COUNT`` identities) on the 26-family
+GFM mixture, driven through a full host-loss incident by the elastic
+coordinator (train/elastic.py):
+
+1. **reference leg**: both hosts train the striped mixture to
+   completion, no faults. Gate: the MIXSTRIPE audit lines show both
+   hosts scanning IDENTICAL global position/draw spans per batch (the
+   zero-collective coordination contract — purity in (seed, epoch,
+   draw)); host 0's loss history is the unkilled reference trend.
+2. **headline shrink leg**: host 1 is SIGKILLed mid-epoch-1 by the
+   ``HYDRAGNN_FAULT_HOST_KILL`` drill (dead-host model, after the
+   epoch-0 checkpoint committed); host 0 takes the coordinated-stop
+   SIGTERM from ``HYDRAGNN_FAULT_HOST_PREEMPT`` two steps later and
+   checkpoints mid-epoch. The driver feeds the exits into an
+   ``ElasticCoordinator``, relaunches the survivor with the plan's env
+   overlay (1-host layout) and the measured progress loss. Gates: the
+   survivor detects the re-layout on resume and emits a typed
+   ``elastic_shrink`` event carrying before/after layouts and the lost
+   steps; the draw sequence is fully accounted for (the committed
+   2-host spans end exactly where the re-dealt 1-host spans begin — no
+   draw duplicated, none lost); the survivor completes with the loss
+   trend intact vs the reference; the run doctor names exactly
+   ``elastic_shrink`` over the survivor's run dir.
+3. **re-grow leg**: the coordinator plans the symmetric grow back to 2
+   hosts; the rejoined host restores from the survivor's coordinated
+   checkpoint. Gates: both hosts emit ``elastic_grow``, the epoch's
+   stripe spans agree across hosts again (original topology restored),
+   and both complete under ``retrace_policy: error`` + blocking
+   precompile — zero retraces in steady state.
+
+Exit 0 = elastic plane healthy; nonzero with a diagnostic otherwise.
+"""
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from smoke_env import child_env  # noqa: E402
+
+# shared 26-family mixture child recipe (builder + config + the
+# fingerprint/mid-epoch-checkpoint line formats asserted below)
+from mix_chaos_smoke import _DATA, _FP_RE, _MIDKILL_RE, _PRELUDE  # noqa: E402
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from hydragnn_tpu.train.elastic import ElasticCoordinator  # noqa: E402
+
+_FAM = 26
+_NCONF = 180  # -> 126 train samples: 7 batches/epoch @ bs 8 x 2 hosts
+
+_TRAIN_CHILD = _PRELUDE + _DATA + """
+import json
+import numpy as np
+import hydragnn_tpu
+from hydragnn_tpu.obs.events import events
+
+tr, va, te = build(__FAM__, __NCONF__)
+cfg = config(__FAM__, __NUM_EPOCH__, extra=__EXTRA__)
+# events.jsonl must arm (the doctor's evidence stream for the elastic legs)
+cfg["Telemetry"] = {"enabled": True, "interval_steps": 4}
+print("CHILD_READY", flush=True)
+model, state, hist, *_ = hydragnn_tpu.run_training(cfg, datasets=(tr, va, te))
+for e in events().snapshot():
+    if e["kind"].startswith("elastic_"):
+        print("ELASTIC_EVENT " + json.dumps(e), flush=True)
+print("LOSSES " + json.dumps([float(v) for v in hist["train"]]), flush=True)
+print("CLEAN_EXIT epochs=%d" % len(hist["train"]), flush=True)
+"""
+
+# MIXSTRIPE e{epoch} b{b} h{host}/{hosts} p{p0}:{p1} d{d0}:{d1}
+# (mix/plane.py): the half-open global position/draw spans each batch
+# consumed — identical across hosts by purity; ownership (p % hosts ==
+# host) partitions them
+_STRIPE_RE = re.compile(
+    r"^MIXSTRIPE e(\d+) b(\d+) h(\d+)/(\d+) p(\d+):(\d+) d(\d+):(\d+)$",
+    re.M,
+)
+
+_NAME = "GIN-r-2.0-ncl-2-hd-8-ne-%d-lr-0.01-bs-8"
+
+
+def _child_code(num_epoch, extra="None"):
+    return (
+        _TRAIN_CHILD.replace("__REPO__", repr(_REPO))
+        .replace("__FAM__", str(_FAM))
+        .replace("__NCONF__", str(_NCONF))
+        .replace("__NUM_EPOCH__", str(num_epoch))
+        .replace("__EXTRA__", extra)
+    )
+
+
+def _env(host=None, hosts=None, **extra):
+    e = {"HYDRAGNN_VALTEST": "0", "HYDRAGNN_MIX_FINGERPRINT": "1"}
+    if host is not None:
+        e["HYDRAGNN_FLEET_HOST_INDEX"] = str(host)
+        e["HYDRAGNN_FLEET_HOST_COUNT"] = str(hosts)
+    e.update(extra)
+    return child_env(e)
+
+
+def _spawn(workdir, name, code, env):
+    script = os.path.join(workdir, f"{name}.py")
+    with open(script, "w") as f:
+        f.write(code)
+    return subprocess.Popen(
+        [sys.executable, script], cwd=workdir, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait(proc, timeout=1200):
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out = (proc.communicate()[0] or "") + "\n<timeout>"
+    return proc.returncode, out or ""
+
+
+def _stripes(text):
+    """{(epoch, batch): (host, hosts, p0, p1, d0, d1)} from MIXSTRIPE."""
+    return {
+        (int(m.group(1)), int(m.group(2))): tuple(
+            int(m.group(i)) for i in range(3, 9)
+        )
+        for m in _STRIPE_RE.finditer(text)
+    }
+
+
+def _losses(text):
+    m = re.search(r"^LOSSES (\[.*\])$", text, re.M)
+    return json.loads(m.group(1)) if m else None
+
+
+def _elastic_events(text):
+    return [
+        json.loads(line[len("ELASTIC_EVENT "):])
+        for line in text.splitlines()
+        if line.startswith("ELASTIC_EVENT ")
+    ]
+
+
+def _fail(tag, out, rc=None):
+    print(f"elastic_smoke FAIL [{tag}]"
+          + (f" (rc={rc})" if rc is not None else "") + f":\n{out[-4000:]}")
+    return 1
+
+
+def _assert_contiguous(tag, spans, epoch):
+    """Per-epoch stripe spans must chain: p0 of batch b+1 == p1 of b."""
+    keys = sorted(k for k in spans if k[0] == epoch)
+    for prev, cur in zip(keys, keys[1:]):
+        if spans[prev][3] != spans[cur][2]:
+            raise AssertionError(
+                f"[{tag}] position span broke at e{epoch} "
+                f"b{cur[1]}: {spans[prev]} -> {spans[cur]}"
+            )
+    return keys
+
+
+def _owned_partition(tag, stripes_by_host, epoch, batch_size=8):
+    """The draw-sequence accounting contract: every host scans the SAME
+    global sequence, stops each batch after ``batch_size`` OWNED samples
+    (p % hosts == host), so span endpoints differ across hosts by up to
+    hosts-1 — but the owned position sets must partition [0, N) with
+    exactly ``batch_size`` owned per batch: no draw duplicated, none
+    lost. Returns the partition's upper bound N."""
+    all_owned = []
+    for stripes in stripes_by_host:
+        keys = _assert_contiguous(tag, stripes, epoch)
+        if not keys:
+            raise AssertionError(f"[{tag}] no epoch-{epoch} stripes")
+        if stripes[keys[0]][2] != 0:
+            raise AssertionError(
+                f"[{tag}] first span starts at p{stripes[keys[0]][2]}, "
+                "wanted p0"
+            )
+        owned = set()
+        for k in keys:
+            h, hc, p0, p1, _d0, _d1 = stripes[k]
+            batch_owned = {p for p in range(p0, p1) if p % hc == h}
+            if len(batch_owned) != batch_size:
+                raise AssertionError(
+                    f"[{tag}] batch {k} owns {len(batch_owned)} samples "
+                    f"of span p{p0}:{p1}, wanted {batch_size}"
+                )
+            owned |= batch_owned
+        all_owned.append(owned)
+    union = set().union(*all_owned)
+    if sum(len(o) for o in all_owned) != len(union):
+        raise AssertionError(f"[{tag}] hosts' owned positions overlap")
+    n = max(union) + 1
+    if union != set(range(n)):
+        raise AssertionError(
+            f"[{tag}] owned positions leave holes below {n}: "
+            f"{sorted(set(range(n)) - union)[:10]}"
+        )
+    return n
+
+
+def main() -> int:  # noqa: C901 — one linear drill script
+    # ---- leg 1: unkilled 2-host reference + cross-host purity audit -------
+    wds = [tempfile.mkdtemp(prefix=f"elastic_ref{h}_") for h in (0, 1)]
+    procs = [
+        _spawn(wds[h], "ref", _child_code(3), _env(host=h, hosts=2))
+        for h in (0, 1)
+    ]
+    outs = [_wait(p) for p in procs]
+    for h, (rc, out) in enumerate(outs):
+        if rc != 0 or "CLEAN_EXIT" not in out:
+            return _fail(f"ref/host{h}", out, rc)
+    stripes = [_stripes(out) for _, out in outs]
+    if not stripes[0] or set(stripes[0]) != set(stripes[1]):
+        return _fail("ref/stripe-keys",
+                     f"h0={sorted(stripes[0])}\nh1={sorted(stripes[1])}")
+    for key in stripes[0]:
+        h0, h1 = stripes[0][key], stripes[1][key]
+        if (h0[0], h0[1]) != (0, 2) or (h1[0], h1[1]) != (1, 2):
+            return _fail("ref/identity", f"{key}: {h0} vs {h1}")
+    try:
+        for epoch in sorted({e for e, _ in stripes[0]}):
+            _owned_partition("ref/purity", stripes, epoch)
+    except AssertionError as e:
+        return _fail("ref/purity", str(e))
+    n_batches = sum(1 for e, _ in stripes[0] if e == 0)
+    ref_losses = _losses(outs[0][1])
+    if not ref_losses or not all(map(lambda v: v == v, ref_losses)):
+        return _fail("ref/losses", outs[0][1])
+    print(f"LEG1_REF_OK batches/epoch={n_batches} "
+          f"losses={[round(v, 4) for v in ref_losses]}", flush=True)
+
+    # ---- leg 2: headline shrink ------------------------------------------
+    coord = ElasticCoordinator(host_count=2, min_hosts=1)
+    wd0, wd1 = (tempfile.mkdtemp(prefix=f"elastic_h{h}_") for h in (0, 1))
+    # host 1: dead-host drill two steps into epoch 1 (after the epoch-0
+    # checkpoint committed); host 0: the coordinated-stop preemption two
+    # steps later — both armed on the cumulative cross-epoch step count
+    p1 = _spawn(wd1, "h1", _child_code(10000), _env(
+        host=1, hosts=2,
+        HYDRAGNN_FAULT_HOST_KILL=str(n_batches + 2),
+    ))
+    p0 = _spawn(wd0, "h0", _child_code(10000), _env(
+        host=0, hosts=2,
+        HYDRAGNN_FAULT_HOST_PREEMPT=str(n_batches + 4),
+    ))
+    rc1, out1 = _wait(p1)
+    rc0, out0 = _wait(p0)
+    if rc1 != -9:
+        return _fail("shrink/kill", f"host 1 rc={rc1}, wanted SIGKILL "
+                     f"(-9):\n{out1[-2000:]}", rc1)
+    m = _MIDKILL_RE.search(out0)
+    if rc0 != 0 or m is None:
+        return _fail("shrink/survivor-stop",
+                     f"host 0 did not checkpoint mid-epoch:\n{out0}", rc0)
+    ckpt_epoch, ckpt_batch = int(m.group(1)), int(m.group(2))
+    # the dead host's uncommitted work: its steps past the epoch-0
+    # checkpoint boundary — the bounded progress the shrink loses
+    lost = sum(1 for (e, _b) in _fingerprint_keys(out1) if e >= 1)
+    if lost < 1:
+        return _fail("shrink/lost", f"dead host shows no epoch-1 work:\n"
+                     f"{out1[-2000:]}")
+    plan = coord.observe_exit(1, rc1)
+    if plan is None or plan.kind != "shrink" or plan.after_hosts != 1:
+        return _fail("shrink/plan", repr(plan))
+    if coord.observe_exit(0, rc0) is not None:  # clean exit: no new plan
+        return _fail("shrink/clean-exit-planned", out0[-500:])
+
+    # relaunch the survivor on the shrunk layout from its own checkpoint
+    env = _env(
+        HYDRAGNN_ELASTIC_LOST_STEPS=str(lost), **plan.child_env(0)
+    )
+    rc, out = _wait(_spawn(
+        wd0, "survivor",
+        _child_code(3, extra='{"continue": 1, "startfrom": "%s"}'
+                    % (_NAME % 10000)),
+        env,
+    ))
+    if rc != 0 or "CLEAN_EXIT" not in out:
+        return _fail("shrink/survivor", out, rc)
+    evs = [e for e in _elastic_events(out) if e["kind"] == "elastic_shrink"]
+    if not evs:
+        return _fail("shrink/event", out)
+    ev = evs[0]
+    if (
+        ev["before"]["host_count"] != 2
+        or ev["after"]["host_count"] != 1
+        or ev.get("progress_lost_steps") != lost
+        or ev["severity"] != "warn"
+    ):
+        return _fail("shrink/event-attrs", json.dumps(ev, indent=1))
+
+    # draw-sequence audit: the survivor's committed spans of the
+    # checkpointed epoch reach the coordinated union boundary
+    # (next_batch * bs * H_old), and the re-dealt 1-host spans begin
+    # exactly there. A host's span ends at its last OWNED sample + 1, so
+    # the committed end sits within H_old - 1 of the boundary.
+    boundary = ckpt_batch * 8 * 2
+    committed = {
+        k: v for k, v in _stripes(out0).items()
+        if k[0] == ckpt_epoch and k[1] < ckpt_batch
+    }
+    try:
+        ckeys = _assert_contiguous("shrink/committed", committed, ckpt_epoch)
+    except AssertionError as e:
+        return _fail("shrink/committed", str(e))
+    last = committed[ckeys[-1]][3] if ckeys else 0
+    if ckeys and (committed[ckeys[0]][2] != 0
+                  or not boundary - 2 < last <= boundary):
+        return _fail(
+            "shrink/committed-range",
+            f"committed spans cover p{committed[ckeys[0]][2]}:{last}, "
+            f"wanted p0 up to the union boundary p{boundary}",
+        )
+    resumed = {
+        k: v for k, v in _stripes(out).items() if k[0] == ckpt_epoch
+    }
+    try:
+        rkeys = _assert_contiguous("shrink/resumed", resumed, ckpt_epoch)
+    except AssertionError as e:
+        return _fail("shrink/resumed", str(e))
+    if not rkeys:
+        return _fail("shrink/resumed-empty", out[-2000:])
+    first = resumed[rkeys[0]]
+    if (first[0], first[1]) != (0, 1) or first[2] != boundary:
+        return _fail(
+            "shrink/boundary",
+            f"first re-dealt span {first} at {rkeys[0]} does not start at "
+            f"the committed union boundary p{boundary}",
+        )
+    # loss trend intact vs the unkilled reference
+    losses = _losses(out)
+    final = losses[-1] if losses else float("nan")
+    if not (final == final and final < ref_losses[0]):
+        return _fail(
+            "shrink/loss-trend",
+            f"survivor final loss {final} vs reference trend {ref_losses}",
+        )
+    # the run doctor names the incident from the run dir alone
+    run_dir = os.path.join(wd0, "logs", _NAME % 3)
+    rc, dout, doc = _doctor(wd0, os.path.relpath(run_dir, wd0),
+                            "elastic_doctor.json")
+    kinds = [f["kind"] for f in (doc or {"findings": []})["findings"]]
+    if rc != 1 or kinds != ["elastic_shrink"]:
+        return _fail("shrink/doctor", f"findings={kinds}\n{dout}", rc)
+    print(
+        f"LEG2_SHRINK_OK killed@e1b2 survivor-ckpt@e{ckpt_epoch}"
+        f"b{ckpt_batch} lost={lost} boundary=p{boundary} "
+        f"final={final:.4f} (ref {ref_losses[0]:.4f}->"
+        f"{ref_losses[-1]:.4f})",
+        flush=True,
+    )
+
+    # ---- leg 3: re-grow back to the original topology ---------------------
+    coord.applied(plan)
+    grow = coord.observe_rejoin(2)
+    if grow is None or grow.kind != "grow" or grow.after_hosts != 2:
+        return _fail("grow/plan", repr(grow))
+    # the rejoined host restores from the survivor's coordinated
+    # checkpoint (shared-filesystem model: copy the run tree over)
+    wd1b = tempfile.mkdtemp(prefix="elastic_h1b_")
+    shutil.copytree(os.path.join(wd0, "logs"), os.path.join(wd1b, "logs"))
+    grow_extra = '{"continue": 1, "startfrom": "%s"}' % (_NAME % 3)
+    gprocs = [
+        _spawn(wd, "grow", _child_code(4, extra=grow_extra),
+               _env(**grow.child_env(h)))
+        for h, wd in ((0, wd0), (1, wd1b))
+    ]
+    gouts = [_wait(p) for p in gprocs]
+    coord.applied(grow)
+    gstripes = []
+    for h, (rc, out) in enumerate(gouts):
+        # retrace_policy "error" + blocking precompile: a clean exit IS
+        # the zero-steady-state-retrace gate
+        if rc != 0 or "CLEAN_EXIT" not in out:
+            return _fail(f"grow/host{h}", out, rc)
+        gevs = [e for e in _elastic_events(out)
+                if e["kind"] == "elastic_grow"]
+        if not gevs or gevs[0]["before"]["host_count"] != 1 \
+                or gevs[0]["after"]["host_count"] != 2:
+            return _fail(f"grow/event-h{h}", out[-2000:])
+        gstripes.append(_stripes(out))
+    if not gstripes[0] or set(gstripes[0]) != set(gstripes[1]):
+        return _fail("grow/stripe-keys",
+                     f"h0={sorted(gstripes[0])}\nh1={sorted(gstripes[1])}")
+    for key in gstripes[0]:
+        h0, h1 = gstripes[0][key], gstripes[1][key]
+        if (h0[0], h0[1]) != (0, 2) or (h1[0], h1[1]) != (1, 2):
+            return _fail("grow/identity", f"{key}: {h0} vs {h1}")
+    try:
+        for epoch in sorted({e for e, _ in gstripes[0]}):
+            _owned_partition("grow/purity", gstripes, epoch)
+    except AssertionError as e:
+        return _fail("grow/purity", str(e))
+    # doctor over the re-grown run dir names the grow
+    run_dir = os.path.join(wd0, "logs", _NAME % 4)
+    rc, dout, doc = _doctor(wd0, os.path.relpath(run_dir, wd0),
+                            "grow_doctor.json")
+    kinds = [f["kind"] for f in (doc or {"findings": []})["findings"]]
+    if rc != 1 or kinds != ["elastic_grow"]:
+        return _fail("grow/doctor", f"findings={kinds}\n{dout}", rc)
+    print(f"LEG3_GROW_OK epochs={sorted(set(e for e, _ in gstripes[0]))} "
+          f"spans-agree-across-hosts", flush=True)
+
+    print(
+        "elastic_smoke OK: striped 26-family mixture survived a "
+        f"mid-epoch host SIGKILL (lost {lost} step(s), re-dealt at "
+        f"p{boundary}) and re-grew to the original 2-host topology with "
+        "zero steady-state retraces"
+    )
+    return 0
+
+
+def _fingerprint_keys(text):
+    return [(int(m.group(1)), int(m.group(2)))
+            for m in _FP_RE.finditer(text)]
+
+
+def _doctor(workdir, target, json_name):
+    proc = subprocess.run(
+        [sys.executable, "-m", "hydragnn_tpu.obs.doctor", target,
+         "--json", json_name],
+        cwd=workdir, env=child_env(), capture_output=True, text=True,
+        timeout=300,
+    )
+    doc = None
+    path = os.path.join(workdir, json_name)
+    if os.path.exists(path):
+        with open(path) as fh:
+            doc = json.load(fh)
+    return proc.returncode, proc.stdout + proc.stderr, doc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -406,29 +406,20 @@ def _free_port() -> int:
     return port
 
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from smoke_env import child_env  # noqa: E402
+
+
 def _env(extra=None):
-    env = {
-        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
-    }
-    env["JAX_PLATFORMS"] = "cpu"
     # 2 virtual devices: the zero-2 mesh step with real collectives,
-    # independent of ci.sh's 8-device flag
-    env["XLA_FLAGS"] = " ".join(
-        [
-            f
-            for f in env.get("XLA_FLAGS", "").split()
-            if "xla_force_host_platform_device_count" not in f
-        ]
-        + ["--xla_force_host_platform_device_count=2"]
+    # independent of ci.sh's 8-device flag. Cache-less children: this
+    # image's jaxlib segfaults in the persistent-cache key serializer on
+    # the zero-2 mesh program (smoke_env.py documents the defect class);
+    # precompile "analysis" keeps the harvests.
+    return child_env(
+        {"HYDRAGNN_COMPILE_CACHE_MIN_SECS": "0", **(extra or {})},
+        device_count=2,
     )
-    env["PYTHONPATH"] = ":".join(
-        p
-        for p in [_REPO] + env.get("PYTHONPATH", "").split(":")
-        if p and ".axon_site" not in p
-    )
-    env["HYDRAGNN_COMPILE_CACHE_MIN_SECS"] = "0"
-    env.update(extra or {})
-    return env
 
 
 def main() -> int:
@@ -449,11 +440,6 @@ def main() -> int:
                         "HYDRAGNN_FLEET_HOST_INDEX": str(host),
                         "HYDRAGNN_FLEET_HOST_COUNT": "2",
                         "HYDRAGNN_FLEET_COLLECTOR": f"127.0.0.1:{port}",
-                        # cache-less children: this image's jaxlib
-                        # segfaults in the persistent-cache key serializer
-                        # on the zero-2 mesh program (pre-existing jax
-                        # bug); precompile "analysis" keeps the harvests
-                        "HYDRAGNN_COMPILE_CACHE": "off",
                     }
                 ),
                 stdout=subprocess.PIPE,

@@ -172,18 +172,16 @@ _FP_RE = re.compile(r"^MIXBATCH e(\d+) b(\d+) ([0-9a-f]+)$", re.M)
 _MIDKILL_RE = re.compile(r"SIGTERM: checkpointed mid-epoch (\d+) at batch (\d+)")
 
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from smoke_env import child_env  # noqa: E402
+
+
 def _env(**extra):
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HYDRAGNN_VALTEST"] = "0"
-    env["HYDRAGNN_MIX_FINGERPRINT"] = "1"
-    env["PYTHONPATH"] = ":".join(
-        p
-        for p in [_REPO] + env.get("PYTHONPATH", "").split(":")
-        if p and ".axon_site" not in p
-    )
-    env.update(extra)
-    return env
+    return child_env({
+        "HYDRAGNN_VALTEST": "0",
+        "HYDRAGNN_MIX_FINGERPRINT": "1",
+        **extra,
+    })
 
 
 def _run(workdir, name, code, env, timeout=900):

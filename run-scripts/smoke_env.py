@@ -1,0 +1,66 @@
+"""Shared subprocess environment for the CI smokes (doctor_smoke,
+fleet_smoke, mix_chaos_smoke, elastic_smoke, ...).
+
+Every smoke spawns fresh CPU-JAX children in temp workdirs; the env
+recipe they need is identical and used to be copy-pasted per script:
+
+- scrub ``PALLAS_AXON_POOL_IPS`` (a pool-IP list would make CPU children
+  try to rendezvous with accelerator hosts);
+- force ``JAX_PLATFORMS=cpu``;
+- put the repo first on ``PYTHONPATH`` and drop any ``.axon_site``
+  entries (the site dir shadows the checked-out tree);
+- run **cache-less** (``HYDRAGNN_COMPILE_CACHE=0``). KNOWN ISSUE, found
+  by doctor_smoke's zero-findings gate: this image's jaxlib
+  intermittently hands back a corrupted deserialized executable from the
+  persistent compilation cache — ~30% of toy runs train 1-2 garbage
+  steps at epoch 1 (guard-skipped, val corrupted), bit-deterministic
+  otherwise; 0/8 with the cache off, reproduced on the unmodified tree
+  with telemetry fully off. The same jaxlib cache-path defect class
+  makes the cache-key serializer segfault on zero-2 mesh programs
+  (fleet_smoke's ``precompile: analysis`` workaround). The smokes run
+  cache-less so the gates measure the repo, not this jaxlib; pass
+  ``compile_cache=True`` for a leg that deliberately exercises the
+  cache.
+
+Import from a sibling run-script as::
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from smoke_env import child_env
+"""
+
+import os
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def child_env(extra=None, *, repo=_REPO, compile_cache=False,
+              device_count=None):
+    """The scrubbed env dict for one smoke child process.
+
+    ``extra`` overlays last (so a leg can still override anything);
+    ``device_count`` rewrites ``xla_force_host_platform_device_count``
+    in ``XLA_FLAGS`` for legs that need a specific virtual-device mesh
+    independent of the parent's flags.
+    """
+    env = {
+        k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ":".join(
+        p
+        for p in [repo] + env.get("PYTHONPATH", "").split(":")
+        if p and ".axon_site" not in p
+    )
+    if not compile_cache:
+        env["HYDRAGNN_COMPILE_CACHE"] = "0"
+    if device_count is not None:
+        env["XLA_FLAGS"] = " ".join(
+            [
+                f
+                for f in env.get("XLA_FLAGS", "").split()
+                if "xla_force_host_platform_device_count" not in f
+            ]
+            + ["--xla_force_host_platform_device_count=%d" % device_count]
+        )
+    env.update(extra or {})
+    return env

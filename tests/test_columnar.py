@@ -132,6 +132,7 @@ def pytest_columnar_string_count_mismatch(tmp_path):
         w.save()
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_columnar_through_training(tmp_path, monkeypatch):
     """Full train/predict through the columnar format via the public API."""
     monkeypatch.chdir(tmp_path)

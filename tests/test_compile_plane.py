@@ -165,6 +165,10 @@ def pytest_lint_handles_compile_plane_keys():
 
 def pytest_setup_compile_cache_resolution(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
+    # conftest pins HYDRAGNN_COMPILE_CACHE=0 suite-wide (jaxlib serializer
+    # defect); this test exercises the resolution order itself, so start
+    # from a clean env
+    monkeypatch.delenv("HYDRAGNN_COMPILE_CACHE", raising=False)
     # default: under the run's log dir
     got = cp.setup_compile_cache({}, "runA")
     assert got == os.path.abspath(os.path.join("logs", "runA", "xla_cache"))

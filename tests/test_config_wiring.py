@@ -111,6 +111,7 @@ def pytest_freeze_conv_layers_zeroes_conv_updates():
     assert changed, "head params did not train"
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_continue_startfrom_resumes_training(tmp_path, monkeypatch):
     """(reference: load_existing_model_config, model.py:118-125)"""
     monkeypatch.chdir(tmp_path)
@@ -135,6 +136,7 @@ def pytest_continue_startfrom_resumes_training(tmp_path, monkeypatch):
     assert int(state3.step) == len(loaders3[0])
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_zero_redundancy_shards_optimizer_state(tmp_path, monkeypatch):
     """(reference: ZeroRedundancyOptimizer wrap, optimizer.py:43-113)"""
     if len(jax.devices()) < 8:

@@ -1,0 +1,179 @@
+"""Elastic coordinator + host-fault drill unit coverage (train/elastic.py,
+utils/faultinject.maybe_host_fault). The end-to-end drill — a 2-process
+simulated fleet surviving a mid-epoch SIGKILL — lives in
+run-scripts/elastic_smoke.py; these tests pin the planner math and the
+typed-event wiring it relies on."""
+
+import signal
+
+import pytest
+
+from hydragnn_tpu.obs.events import (
+    EV_ELASTIC_GROW,
+    EV_ELASTIC_SHRINK,
+    events,
+)
+from hydragnn_tpu.train.elastic import (
+    ElasticCoordinator,
+    note_relayout,
+    plan_grow,
+    plan_shrink,
+)
+from hydragnn_tpu.utils import faultinject
+
+
+def pytest_plan_shrink_remaps_survivors_contiguously():
+    plan = plan_shrink(4, [1, 3])
+    assert plan.kind == "shrink"
+    assert plan.before_hosts == 4 and plan.after_hosts == 2
+    # survivors 0 and 2 keep their order, ranks become contiguous
+    assert plan.rank_map == {0: 0, 2: 1}
+    assert plan.ranks == [0, 1]
+    env = plan.child_env(1)
+    assert env == {
+        "HYDRAGNN_FLEET_HOST_INDEX": "1",
+        "HYDRAGNN_FLEET_HOST_COUNT": "2",
+    }
+
+
+def pytest_plan_shrink_refuses_below_floor():
+    with pytest.raises(RuntimeError, match="min_hosts"):
+        plan_shrink(2, [0, 1], min_hosts=1)
+    with pytest.raises(RuntimeError, match="min_hosts"):
+        plan_shrink(2, [1], min_hosts=2)
+
+
+def pytest_plan_grow_fills_tail_ranks():
+    plan = plan_grow(1, 2)
+    assert plan.kind == "grow"
+    assert plan.rank_map == {0: 0, 1: 1}
+    with pytest.raises(ValueError):
+        plan_grow(2, 2)
+
+
+def pytest_coordinator_state_machine_dedups_detections():
+    c = ElasticCoordinator(host_count=2)
+    # a stale-heartbeat detection for host 1 plans the shrink once
+    plan = c.observe_event("fleet_host_stale", {"host": 1})
+    assert plan is not None and plan.after_hosts == 1
+    assert c.observe_event("fleet_host_stale", {"host": 1}) is None
+    # the same host's process exit is the same incident, not a second plan
+    assert c.observe_exit(1, -9) is None
+    c.applied(plan)
+    assert c.host_count == 1
+    # rejoin grows back
+    grow = c.observe_rejoin(2)
+    assert grow is not None and grow.kind == "grow"
+    c.applied(grow)
+    assert c.host_count == 2
+    # unrelated events and clean exits plan nothing
+    assert c.observe_event("fleet_straggler", {"host": 0}) is None
+    assert c.observe_exit(0, 0) is None
+
+
+def pytest_note_relayout_emits_typed_event():
+    before = len(events().snapshot())
+    note_relayout(
+        {"host_count": 2, "host_index": 0, "epoch": 0, "next_batch": 3},
+        {"host_count": 1, "host_index": 0, "epoch": 0, "next_batch": 6},
+        trigger="resume",
+        progress_lost_steps=2,
+    )
+    note_relayout(
+        {"host_count": 1, "host_index": 0},
+        {"host_count": 2, "host_index": 0},
+        trigger="rejoin",
+    )
+    recs = events().snapshot()[before:]
+    kinds = [r["kind"] for r in recs]
+    assert kinds == [EV_ELASTIC_SHRINK, EV_ELASTIC_GROW]
+    shrink = recs[0]
+    assert shrink["severity"] == "warn"
+    assert shrink["before"]["host_count"] == 2
+    assert shrink["after"]["host_count"] == 1
+    assert shrink["progress_lost_steps"] == 2
+    assert recs[1]["severity"] == "info"
+
+
+def pytest_pre_attach_event_backfills_sink(tmp_path):
+    # the elastic_shrink record is emitted by the resume guard BEFORE the
+    # train loop arms events.jsonl — attach must backfill it, or the run
+    # doctor never sees the re-layout
+    import json
+
+    from hydragnn_tpu.obs.events import attach_stream, detach_stream
+
+    note_relayout(
+        {"host_count": 3, "host_index": 0},
+        {"host_count": 2, "host_index": 0},
+        trigger="resume",
+    )
+    try:
+        path = attach_stream(str(tmp_path))
+        assert path is not None
+        recs = [json.loads(l) for l in open(path)]
+    finally:
+        detach_stream()
+    shrinks = [
+        r
+        for r in recs
+        if r["kind"] == EV_ELASTIC_SHRINK
+        and r["before"].get("host_count") == 3
+    ]
+    assert shrinks, [r["kind"] for r in recs]
+
+
+def pytest_coordinator_from_config_reads_training_elastic():
+    # config/config.py completes Training.elastic (enabled/min_hosts/
+    # grace_s); from_config arms the coordinator only when enabled
+    cfg = {"NeuralNetwork": {"Training": {}}}
+    assert ElasticCoordinator.from_config(cfg, host_count=2) is None
+    cfg["NeuralNetwork"]["Training"]["elastic"] = {
+        "enabled": True,
+        "min_hosts": 2,
+        "grace_s": 5.0,
+    }
+    c = ElasticCoordinator.from_config(cfg, host_count=4)
+    assert c is not None
+    assert (c.host_count, c.min_hosts, c.grace_s) == (4, 2, 5.0)
+
+
+def pytest_maybe_host_fault_signals_armed_steps(monkeypatch):
+    sent = []
+    monkeypatch.setattr(
+        "hydragnn_tpu.utils.faultinject.os.kill",
+        lambda pid, sig: sent.append(sig),
+    )
+    faultinject.reset()
+    try:
+        faultinject.configure(host_kill="3")
+        for i in range(3):
+            faultinject.maybe_host_fault(i)
+        assert sent == []
+        faultinject.maybe_host_fault(3)
+        assert sent == [signal.SIGKILL]
+        faultinject.configure(host_kill=None, host_preempt="5+")
+        faultinject.maybe_host_fault(4)
+        faultinject.maybe_host_fault(6)
+        assert sent == [signal.SIGKILL, signal.SIGTERM]
+    finally:
+        faultinject.reset()
+
+
+def pytest_maybe_host_fault_counts_steps_across_epochs(monkeypatch):
+    # no explicit index: the armed index is the process-lifetime step
+    # count, so a drill can target "epoch 1, batch 2" as n_batches + 2
+    sent = []
+    monkeypatch.setattr(
+        "hydragnn_tpu.utils.faultinject.os.kill",
+        lambda pid, sig: sent.append(sig),
+    )
+    faultinject.reset()
+    try:
+        faultinject.configure(host_kill="5")
+        for _epoch in range(2):
+            for _b in range(3):  # epoch-local loop restarts at 0
+                faultinject.maybe_host_fault()
+        assert sent == [signal.SIGKILL]
+    finally:
+        faultinject.reset()

@@ -66,6 +66,7 @@ def lj_config(mpnn_type, num_epoch=80, **arch_over):
     "mpnn_type,corr_floor,seed",
     [("SchNet", 0.8, 0), ("EGNN", 0.65, 0), ("PAINN", 0.5, 3)],
 )
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_energy_forces(mpnn_type, corr_floor, seed):
     # PAINN on the tiny LJ fixture is high-variance across init seeds;
     # pin a seed that trains, like the reference's own fixed-seed CI
@@ -122,6 +123,7 @@ def pytest_forces_rotation_equivariant(mpnn_type):
 
 
 @pytest.mark.parametrize("mpnn_type", ["MACE", "DimeNet", "PNAPlus"])
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_energy_force_smoke(mpnn_type):
     """Remaining force-capable models run the energy+force objective without
     error and reduce the loss (reference bar: the example exits 0,

@@ -71,6 +71,7 @@ def _fleet(n_per=10):
     return split_dataset(merged, 0.8, seed=0)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_sc25_composed_features(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     with open(

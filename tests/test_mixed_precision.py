@@ -8,6 +8,8 @@ still converges, and every persistent array remains f32.
 """
 
 import jax
+
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -139,6 +141,7 @@ def pytest_cast_helpers():
     assert hi["a"].dtype == jnp.float32
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_mixed_precision_checkpoint_resume(tmp_path, monkeypatch):
     """bf16-trained state checkpoints and resumes (Training.continue) with
     f32 master weights intact."""

@@ -9,6 +9,8 @@ import sys
 import textwrap
 import time
 
+import pytest
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 _CHILD = textwrap.dedent(
@@ -53,6 +55,7 @@ _CHILD = textwrap.dedent(
 )
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_sigterm_checkpoints_and_stops(tmp_path):
     script = tmp_path / "child.py"
     script.write_text(_CHILD.replace("__REPO__", repr(_REPO)))

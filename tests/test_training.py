@@ -194,11 +194,13 @@ def _check_thresholds(config, tmp_path, monkeypatch, thresholds=None,
     ["GIN", "SAGE", "PNA", "MFC", "GAT", "CGCNN",
      "SchNet", "PNAPlus", "EGNN", "PAINN", "PNAEq", "DimeNet", "MACE"],
 )
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_singlehead(mpnn_type, tmp_path, monkeypatch):
     _check_thresholds(make_config(mpnn_type), tmp_path, monkeypatch)
 
 
 @pytest.mark.parametrize("mpnn_type", ["SchNet", "EGNN", "PAINN"])
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_equivariant(mpnn_type, tmp_path, monkeypatch):
     """Equivariant-mode variants (reference: tests/test_graphs.py:262-266).
 
@@ -209,12 +211,14 @@ def pytest_train_equivariant(mpnn_type, tmp_path, monkeypatch):
 
 
 @pytest.mark.parametrize("mpnn_type", ["SAGE", "PNA"])
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_multihead(mpnn_type, tmp_path, monkeypatch):
     _check_thresholds(make_config(mpnn_type, heads="multi"), tmp_path, monkeypatch)
 
 
 @pytest.mark.parametrize("mpnn_type", ["PNA", "GIN"])
 @pytest.mark.parametrize("attn_type", ["multihead", "performer"])
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_gps_attention(mpnn_type, attn_type, tmp_path, monkeypatch):
     """GPS global attention wrapping local MPNNs (reference:
     tests/test_graphs.py:235-249 runs GPS across edge models)."""
@@ -244,6 +248,7 @@ def _with_edge_attrs(cfg):
 
 
 @pytest.mark.parametrize("mpnn_type", _EDGE_MODELS + ["MACE"])
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_edge_attributes(mpnn_type, tmp_path, monkeypatch):
     """Edge-attribute variants across every edge model, MACE included
     (reference: tests/test_graphs.py:224-231 + :252-258)."""
@@ -253,6 +258,7 @@ def pytest_train_edge_attributes(mpnn_type, tmp_path, monkeypatch):
 
 
 @pytest.mark.parametrize("mpnn_type", _EDGE_MODELS)
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_gps_edge_models(mpnn_type, tmp_path, monkeypatch):
     """GPS multihead attention over every edge model with edge attributes
     (reference: tests/test_graphs.py:234-249)."""
@@ -272,6 +278,7 @@ def pytest_train_gps_edge_models(mpnn_type, tmp_path, monkeypatch):
     ["SAGE", "GIN", "GAT", "MFC", "PNA", "PNAPlus",
      "SchNet", "DimeNet", "EGNN", "PNAEq", "PAINN"],
 )
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_conv_node_head(mpnn_type, tmp_path, monkeypatch):
     """Conv-chain node heads across eleven models (reference:
     tests/test_graphs.py:288-307, ci_conv_head.json: node head type 'conv',
@@ -333,6 +340,7 @@ def pytest_train_conv_node_head(mpnn_type, tmp_path, monkeypatch):
         assert mae < thr_mae, f"{mpnn_type}/x: MAE {mae} > {thr_mae}"
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_mlp_per_node_head(tmp_path, monkeypatch):
     """mlp_per_node head (one MLP per node position; fixed-size graphs).
     The BCC fixture has variable cells, so pin the cell ranges to one size
@@ -360,6 +368,7 @@ def pytest_train_mlp_per_node_head(tmp_path, monkeypatch):
 @pytest.mark.parametrize(
     "mpnn_type", ["GAT", "PNA", "PNAPlus", "SchNet", "DimeNet", "EGNN", "PNAEq"]
 )
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_vector_output(mpnn_type, tmp_path, monkeypatch):
     """Vector (multi-dim) node outputs with edge attributes across the
     reference's seven vector-capable models (tests/test_graphs.py:268-285,
@@ -418,6 +427,7 @@ def pytest_lappe_deterministic_and_shapes():
     assert np.all(g1.rel_pe >= 0)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_checkpoint_roundtrip(tmp_path, monkeypatch):
     """Save -> load -> identical predictions (reference:
     tests/test_model_loadpred.py:19-65)."""
@@ -431,6 +441,7 @@ def pytest_checkpoint_roundtrip(tmp_path, monkeypatch):
         np.testing.assert_allclose(preds1[name], preds2[name], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_gaussian_nll(tmp_path, monkeypatch):
     """GaussianNLLLoss trains through the variance heads (reference:
     var_output plumbing Base.py:92-96; loss test
@@ -443,6 +454,7 @@ def pytest_train_gaussian_nll(tmp_path, monkeypatch):
     assert hist["train"][-1] < hist["train"][0]
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_gps_over_gat(tmp_path, monkeypatch):
     """GPS wrapping a width-expanding conv (GAT concat) must keep channel
     widths consistent with the GPS residual."""
@@ -471,6 +483,7 @@ def pytest_plateau_scheduler_reduces_lr(tmp_path, monkeypatch):
     assert lr == pytest.approx(0.05)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_training_is_deterministic(tmp_path, monkeypatch):
     """Two identical runs produce bitwise-identical loss histories —
     the determinism guarantee SURVEY §5.2 asks this framework to pin
@@ -517,6 +530,7 @@ def pytest_training_is_deterministic(tmp_path, monkeypatch):
     assert hist1["val"] == hist2["val"]
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_pack_batches(tmp_path, monkeypatch):
     """Training.pack_batches end to end: single-spec packed loaders train to
     the same threshold as the fixed-count path (PNA, single head)."""
@@ -525,6 +539,7 @@ def pytest_train_pack_batches(tmp_path, monkeypatch):
     _check_thresholds(config, tmp_path, monkeypatch)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_pack_gps_sorted_composition(tmp_path, monkeypatch):
     """Feature interplay: packed batching x GPS global attention x Pallas
     sorted aggregation (interpret mode on CPU) in ONE training run — the
@@ -542,6 +557,7 @@ def pytest_train_pack_gps_sorted_composition(tmp_path, monkeypatch):
     _check_thresholds(config, tmp_path, monkeypatch)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_train_pack_batches_dimenet(tmp_path, monkeypatch):
     """Packed batching with DimeNet: the triplet channel is budgeted in the
     single pack spec (bins respect node/edge/triplet caps); short run, loss

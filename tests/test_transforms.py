@@ -260,6 +260,7 @@ def pytest_normalize_rotation_rotates_forces():
         np.testing.assert_array_equal(g2.graph_targets[k], v)
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_end_to_end_descriptors_through_training():
     """Descriptors flow from Dataset config through update_config edge_dim
     into an edge-aware model and a real training run."""

@@ -5,6 +5,8 @@ import json
 import os
 import time
 
+import pytest
+
 import numpy as np
 
 from hydragnn_tpu.utils import (
@@ -250,6 +252,7 @@ def pytest_dump_testdata_env(tmp_path, monkeypatch):
     assert blob["preds"]["sum_x_x2_x3"].shape == blob["trues"]["sum_x_x2_x3"].shape
 
 
+@pytest.mark.slow  # full train-loop drive: exceeds the capped fast tier; runs in the ci.sh suite
 def pytest_orbax_checkpoint_roundtrip(tmp_path, monkeypatch):
     """Training.checkpoint_backend: orbax — save via CheckpointManager,
     resume ("continue") and predict restore through the same latest
