@@ -1418,9 +1418,153 @@ def main_serve():
         "device_kind": _device_kind(),
         "ok": True,
     }))
+    # ---- fleet cells (ISSUE 19 tentpole; docs/SERVING.md "Fleet"): the
+    # failover router fronting {1, 2, 4} replicas — aggregate closed-loop
+    # graphs/sec and client-side p99 vs replica count, plus the
+    # prediction-cache hit-rate cell. Replicas are in-process GraphServers
+    # behind LocalReplicaClients so the cells measure the ROUTER's scaling
+    # (balancing + dispatch overhead), not subprocess spawn/warm-up cost —
+    # run-scripts/serve_fleet_smoke.py covers the subprocess path.
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from hydragnn_tpu.serve import (
+        FleetRouter, LocalReplicaClient, PredictionCache,
+    )
+
+    def _fleet_cell(n_replicas, cache=None, closed_passes=None):
+        """One fleet measurement: ``closed_passes`` (when set) drives that
+        many sequential passes over the graph set through one worker (the
+        deterministic cache cell); otherwise 2x``n_replicas`` workers run
+        closed-loop for ``duration`` seconds."""
+        servers = [
+            GraphServer(
+                model, state, ladder,
+                ServeConfig(
+                    micro_batch_graphs=int(
+                        os.getenv("BENCH_SERVE_BATCH", "8")
+                    ),
+                    batch_window_s=0.002, retrace_policy="error",
+                    max_queue_requests=1024,
+                ),
+                template_graphs=graphs,
+            ).start()
+            for _ in range(n_replicas)
+        ]
+        try:
+            for s in servers:
+                assert s.wait_ready(600), s.failed
+            router = FleetRouter(
+                {
+                    f"replica{k + 1}": LocalReplicaClient(
+                        s, name=f"replica{k + 1}"
+                    )
+                    for k, s in enumerate(servers)
+                },
+                cfg=ServeConfig(router_timeout_s=120.0),
+                cache=cache,
+            )
+            lats, lock = [], threading.Lock()
+            t_start = time.perf_counter()
+            if closed_passes:
+                for _ in range(closed_passes):
+                    for g in graphs:
+                        t0 = time.perf_counter()
+                        router.predict(g, timeout_s=120.0)
+                        lats.append(time.perf_counter() - t0)
+            else:
+                n_workers = max(2 * n_replicas, 2)
+                stop_at = t_start + duration
+
+                def pump(wid):
+                    j, mine = wid, []
+                    while time.perf_counter() < stop_at:
+                        t0 = time.perf_counter()
+                        router.predict(
+                            graphs[j % len(graphs)], timeout_s=120.0
+                        )
+                        mine.append(time.perf_counter() - t0)
+                        j += n_workers
+                    with lock:
+                        lats.extend(mine)
+
+                workers = [
+                    threading.Thread(target=pump, args=(w,))
+                    for w in range(n_workers)
+                ]
+                for w in workers:
+                    w.start()
+                for w in workers:
+                    w.join()
+            elapsed = time.perf_counter() - t_start
+            stats = router.stats()
+            router.close()
+        finally:
+            for s in servers:
+                s.close(drain=False)
+        assert stats["failed"] == 0, (n_replicas, stats)
+        arr = np.array(lats)
+        return {
+            "replicas": n_replicas,
+            "aggregate_gps": round(len(lats) / elapsed, 1),
+            "requests": len(lats),
+            "p50_ms": round(float(np.percentile(arr, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(arr, 99)) * 1e3, 3),
+            "cache_hits": stats["cache_hits"],
+            "cache_hit_rate": round(
+                stats["cache_hits"] / max(stats["requests"], 1), 4
+            ),
+        }
+
+    fleet_counts = [
+        int(r) for r in os.getenv("BENCH_SERVE_FLEET", "1,2,4").split(",")
+        if r.strip()
+    ]
+    fleet_cells = {}
+    for r in fleet_counts:
+        cell = _fleet_cell(r)
+        fleet_cells[r] = cell
+        cell.update(
+            variant=f"fleet_r{r}",
+            metric="serve fleet cell (FleetRouter over in-process "
+                   "replicas, closed-loop aggregate)",
+            unit="graphs/sec",
+            value=cell["aggregate_gps"],
+            device_kind=_device_kind(),
+        )
+        _bank(json.dumps(cell))
+    # deterministic cache cell: two passes over the same graph set — the
+    # second is served entirely from the content-addressed cache
+    cache_cell = _fleet_cell(
+        1, cache=PredictionCache(tempfile.mkdtemp(prefix="bench_pcache_")),
+        closed_passes=2,
+    )
+    assert cache_cell["cache_hit_rate"] >= 0.45, cache_cell
+    cache_cell.update(
+        variant="fleet_cache",
+        metric="serve fleet prediction-cache cell (two passes, second "
+               "pass fully cached)",
+        unit="hit_rate",
+        device_kind=_device_kind(),
+    )
+    _bank(json.dumps(cache_cell))
+    # round-over-round gate record (bench_gate.py --mix-cells on
+    # logs/serve_cells.jsonl): *_graphs_per_sec keys must not collapse
+    _bank(json.dumps({
+        "metric": "serve fleet scaling (router aggregate, gate record)",
+        **{
+            f"fleet_r{r}_graphs_per_sec": c["aggregate_gps"]
+            for r, c in fleet_cells.items()
+        },
+        "fleet_cache_hit_rate": cache_cell["cache_hit_rate"],
+        "ok": True,
+    }))
     _bank(json.dumps({
         "metric": "serve_cells_done",
         "cells": len(results),
+        "fleet_cells": len(fleet_cells) + 1,
         "capacity_gps": round(capacity, 1),
         "slo_p99_s": round(slo_p99_s, 6),
         "throughput_at_slo_gps": results["at_slo"]["achieved_gps"],

@@ -12,7 +12,8 @@ __version__ = "0.1.0"
 
 def __getattr__(name):
     # Lazy imports keep `import hydragnn_tpu` light (no jax init on import).
-    if name in ("run_training", "run_prediction", "run_server"):
+    if name in ("run_training", "run_prediction", "run_server",
+                "run_server_fleet"):
         from . import api
 
         return getattr(api, name)

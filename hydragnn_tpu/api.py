@@ -1342,3 +1342,43 @@ def _(config: dict, datasets=None, install_sigterm: bool = False):
         ).start()
         server.attach_watcher(watcher)
     return server
+
+
+def run_server_fleet(
+    config,
+    replicas: int = None,
+    path: str = "./logs",
+    per_replica_env=None,
+    wait_ready_s: float = None,
+):
+    """Config-driven serving FLEET (docs/SERVING.md "Fleet"): spawn
+    ``Serving.fleet_replicas`` (or ``replicas=``) worker processes, each a
+    full ``run_server`` deployment on its own ephemeral port and device
+    set, supervised by a ``ReplicaManager`` — crash restart with backoff,
+    flap benching, wedge detection, rolling hot-reload with rollback —
+    and fronted by its ``router()`` (retries, hedging, circuit breakers,
+    optional prediction cache).
+
+    ``config`` is a config dict or JSON path. ``per_replica_env`` maps a
+    1-based replica index to extra environment for that worker (the hook
+    for pinning device sets). ``wait_ready_s`` blocks until every replica
+    passes /readyz (warm-up included) or raises; None returns immediately
+    with replicas still warming. Returns the STARTED ``ReplicaManager``
+    — call ``.router().predict(graph)`` to serve and ``.close()`` (or use
+    it as a context manager) to drain the fleet.
+    """
+    from .serve.fleet import ReplicaManager
+
+    manager = ReplicaManager(
+        config, path=path, per_replica_env=per_replica_env,
+        replicas=replicas,
+    ).start()
+    if wait_ready_s is not None:
+        if not manager.wait_ready(timeout=float(wait_ready_s)):
+            state = manager.replica_state()
+            manager.close()
+            raise RuntimeError(
+                f"serving fleet failed to become ready within "
+                f"{wait_ready_s}s: {state}"
+            )
+    return manager

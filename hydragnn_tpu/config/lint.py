@@ -184,6 +184,23 @@ _HANDLED = {
     "Serving.http_port",
     "Serving.http_host",
     "Serving.weights_dtype",
+    "Serving.drain_grace_s",
+    "Serving.fleet_replicas",
+    "Serving.fleet_restart_backoff_s",
+    "Serving.fleet_restart_backoff_max_s",
+    "Serving.fleet_flap_window_s",
+    "Serving.fleet_flap_max_restarts",
+    "Serving.fleet_ready_floor",
+    "Serving.router_timeout_s",
+    "Serving.router_retries",
+    "Serving.router_backoff_s",
+    "Serving.router_hedge_factor",
+    "Serving.router_hedge_min_s",
+    "Serving.breaker_failures",
+    "Serving.breaker_cooldown_s",
+    "Serving.prediction_cache",
+    "Serving.reload_error_spike",
+    "Serving.reload_probe_requests",
     "Telemetry.enabled",
     "Telemetry.interval_steps",
     "Telemetry.http_port",

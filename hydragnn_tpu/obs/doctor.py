@@ -59,7 +59,13 @@ from .events import (
     EV_LOADER_STALL,
     EV_MIX_DEMOTE,
     EV_NUMERICS_PROVENANCE,
+    EV_BREAKER_CLOSE,
+    EV_BREAKER_OPEN,
     EV_QUEUE_FULL,
+    EV_RELOAD_ROLLBACK,
+    EV_REPLICA_BENCHED,
+    EV_REPLICA_EXIT,
+    EV_REPLICA_RESTART,
     EV_RETRACE_VIOLATION,
     EV_SHED,
     EV_TILE_PLAN,
@@ -97,6 +103,9 @@ F_UNTUNED_KERNEL = "untuned_kernel"      # TPU run rode default tile plans
 F_CRASH = "crash"                        # unexplained crash dump
 F_ELASTIC_SHRINK = "elastic_shrink"      # fleet re-laid-out onto fewer hosts
 F_ELASTIC_GROW = "elastic_grow"          # fleet re-grew to more hosts
+F_REPLICA_FLAP = "replica_flap"          # serving replica crash-looped
+F_BREAKER_OPEN = "breaker_open"          # router circuit breaker tripped
+F_RELOAD_ROLLBACK = "reload_rollback"    # rolling reload auto-rolled back
 
 FINDING_KINDS = (
     F_INPUT_BOUND, F_RETRACE_STORM, F_PADDING_WASTE, F_NAN_DIVERGENCE,
@@ -104,6 +113,7 @@ FINDING_KINDS = (
     F_HBM_PRESSURE, F_COMM_DOMINANT, F_SHED_SPIRAL, F_QUEUE_SATURATION,
     F_QUARANTINE_ROT, F_LOADER_STALL, F_WEDGED_STEP, F_COLD_START,
     F_UNTUNED_KERNEL, F_CRASH, F_ELASTIC_SHRINK, F_ELASTIC_GROW,
+    F_REPLICA_FLAP, F_BREAKER_OPEN, F_RELOAD_ROLLBACK,
 )
 
 _EVIDENCE_CAP = 16  # per finding; a shed spiral does not need 300 records
@@ -135,6 +145,9 @@ class DoctorConfig:
     shed_spiral_min: int = 5
     queue_full_min: int = 5
     queue_wait_fraction: float = 0.5
+    # fleet: one supervisor restart is recovery, this many is instability
+    # (benching fires the finding regardless of this threshold)
+    replica_flap_min_restarts: int = 3
     # rollbacks: 1 recovers, this many is a loop
     rollback_loop_min: int = 2
     # diff mode: time_to_first_step growth beyond this factor with fresh
@@ -902,8 +915,46 @@ def r_comm_dominant(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
     )]
 
 
+def _fleet_serve_latest(s: RunStreams) -> Optional[Dict[str, Any]]:
+    """Last fleet-aggregated serving window (serve/fleet.py writes them
+    ~1/s; counters in them are cumulative, so the last record carries the
+    fleet totals). None for single-server runs."""
+    recs = s.records_of("fleet_serve")
+    return recs[-1] if recs else None
+
+
+def _per_replica_breakdown(rec: Dict[str, Any], key: str) -> Dict[str, float]:
+    return {
+        f"replica{h}": float(v.get(key, 0.0))
+        for h, v in (rec.get("per_replica") or {}).items()
+        if isinstance(v, dict)
+    }
+
+
 @rule
 def r_shed_spiral(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    # fleet deployments: judge the AGGREGATED shed total from the
+    # manager's fleet_serve records so fleet-wide overload is ONE finding
+    # with a per-replica breakdown, not one finding per replica stream
+    fleet = _fleet_serve_latest(s)
+    if fleet is not None:
+        sheds = int(fleet.get("shed_total", 0))
+        if sheds < cfg.shed_spiral_min:
+            return []
+        breakdown = _per_replica_breakdown(fleet, "shed")
+        return [Finding(
+            F_SHED_SPIRAL, "warn",
+            f"fleet-wide shed spiral: {sheds} SLO load sheds across "
+            f"{fleet.get('replicas')} replicas ({fleet.get('ready')} "
+            "ready) — offered load is persistently above what the FLEET "
+            "can finish inside Serving.slo_p99_s",
+            "scale out (raise Serving.fleet_replicas) or raise "
+            "Serving.micro_batch_graphs for better per-replica device "
+            "utilization; if sheds concentrate on one replica (see "
+            "breakdown) its device set is the straggler",
+            evidence=[fleet],
+            data={"sheds": sheds, "per_replica": breakdown},
+        )]
     evs = s.events_of(EV_SHED)
     if len(evs) < cfg.shed_spiral_min:
         return []
@@ -924,6 +975,27 @@ def r_shed_spiral(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
 
 @rule
 def r_queue_saturation(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    fleet = _fleet_serve_latest(s)
+    if fleet is not None:
+        # same aggregation argument as r_shed_spiral: one fleet verdict
+        qfull = int(fleet.get("queue_full_total", 0))
+        if qfull < cfg.queue_full_min:
+            return []
+        breakdown = _per_replica_breakdown(fleet, "queue_full")
+        return [Finding(
+            F_QUEUE_SATURATION, "warn",
+            f"fleet-wide queue saturation: {qfull} queue-full rejections "
+            f"across {fleet.get('replicas')} replicas (mean depth "
+            f"{fleet.get('queue_depth_mean')}, max "
+            f"{fleet.get('queue_depth_max')})",
+            "the device step is the bottleneck, not admission: add "
+            "capacity (Serving.fleet_replicas / bigger "
+            "Serving.micro_batch_graphs) rather than raising "
+            "Serving.max_queue_requests — a deeper queue only adds "
+            "latency to the same throughput",
+            evidence=[fleet],
+            data={"queue_full": qfull, "per_replica": breakdown},
+        )]
     evs = s.events_of(EV_QUEUE_FULL)
     decomp = span_decomposition(s.spans)
     qw = decomp.get("serve/queue_wait")
@@ -954,6 +1026,90 @@ def r_queue_saturation(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
         evidence=evs[:_EVIDENCE_CAP] or [{"span_stats": {
             "serve/queue_wait": qw, "serve/request": req}}],
         data={"queue_full": len(evs), "queue_wait_fraction": wait_frac},
+    )]
+
+
+@rule
+def r_replica_flap(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    """A benched replica is a finding by itself (the supervisor only
+    benches after fleet_flap_max_restarts deaths inside the window —
+    restarts cannot fix it), and restarts short of the bench threshold
+    still get surfaced once they repeat."""
+    benched = s.events_of(EV_REPLICA_BENCHED)
+    restarts = s.events_of(EV_REPLICA_RESTART)
+    if benched:
+        idxs = sorted({e.get("replica") for e in benched})
+        return [Finding(
+            F_REPLICA_FLAP, "error",
+            f"replica(s) {idxs} BENCHED by the flap breaker: each died "
+            "fleet_flap_max_restarts times inside fleet_flap_window_s — "
+            "a crash loop restarts cannot fix (bad device set, corrupt "
+            "checkpoint, OOM on warm-up)",
+            "read logs/<run>/replica_<i>.log for the crash cause; the "
+            "fleet keeps serving on the remaining replicas but at reduced "
+            "capacity until the fleet is restarted",
+            evidence=(benched + s.events_of(EV_REPLICA_EXIT))[:_EVIDENCE_CAP],
+            data={"benched": idxs, "restarts": len(restarts)},
+        )]
+    if len(restarts) < cfg.replica_flap_min_restarts:
+        return []
+    per = {}
+    for e in restarts:
+        per[e.get("replica")] = per.get(e.get("replica"), 0) + 1
+    return [Finding(
+        F_REPLICA_FLAP, "warn",
+        f"{len(restarts)} replica restart(s) this run "
+        f"(per replica: {per}) — the supervisor recovered each time, but "
+        "repeated deaths mean the workers are unstable",
+        "check replica_<i>.log for the exit cause; if deaths cluster on "
+        "one replica its device set or host is suspect",
+        evidence=restarts[:_EVIDENCE_CAP],
+        data={"restarts": len(restarts), "per_replica": per},
+    )]
+
+
+@rule
+def r_breaker_open(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    opens = s.events_of(EV_BREAKER_OPEN)
+    if not opens:
+        return []
+    closes = s.events_of(EV_BREAKER_CLOSE)
+    still_open = len(opens) > len(closes)
+    return [Finding(
+        F_BREAKER_OPEN, "error" if still_open else "warn",
+        f"router circuit breaker tripped {len(opens)} time(s)"
+        + ("" if not still_open else
+           f" and {len(opens) - len(closes)} breaker(s) never re-closed")
+        + " — a replica kept failing typed-retryable requests and the "
+        "router stopped sending it traffic",
+        "breakers that re-closed mean the half-open probe found the "
+        "replica healthy again (transient); a breaker still open at run "
+        "end means the replica stayed broken — cross-check replica_flap "
+        "and the replica's log",
+        evidence=(opens + closes)[:_EVIDENCE_CAP],
+        data={"opens": len(opens), "closes": len(closes),
+              "still_open": still_open},
+    )]
+
+
+@rule
+def r_reload_rollback(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    evs = s.events_of(EV_RELOAD_ROLLBACK)
+    if not evs:
+        return []
+    last = evs[-1]
+    return [Finding(
+        F_RELOAD_ROLLBACK, "error",
+        f"rolling reload rolled back: first reloaded replica's probe "
+        f"error rate {last.get('error_rate')} crossed "
+        "Serving.reload_error_spike, so the fleet was restored to "
+        f"checkpoint {last.get('rolled_back_to')!r} and the rollout "
+        "aborted (the regressed checkpoint reached at most one replica)",
+        "the candidate checkpoint is the problem, not the fleet: inspect "
+        f"the regressed entry {last.get('regressed')!r} (training-side "
+        "divergence, wrong export) before re-publishing the pointer",
+        evidence=evs,
+        data={"rollbacks": len(evs), "last": last},
     )]
 
 

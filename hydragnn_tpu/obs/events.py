@@ -59,6 +59,12 @@ EV_SHARDING_AUDIT = "sharding_audit"      # inspector flagged an over-replicated
 EV_TILE_PLAN = "tile_plan"                # kernel tile-plan choice (tune/runtime.py)
 EV_ELASTIC_SHRINK = "elastic_shrink"      # fleet re-laid-out onto fewer hosts
 EV_ELASTIC_GROW = "elastic_grow"          # fleet re-laid-out back onto more hosts
+EV_REPLICA_EXIT = "replica_exit"          # serving replica process died
+EV_REPLICA_RESTART = "replica_restart"    # supervisor restarted a replica
+EV_REPLICA_BENCHED = "replica_benched"    # flap breaker benched a replica
+EV_BREAKER_OPEN = "breaker_open"          # router circuit breaker opened
+EV_BREAKER_CLOSE = "breaker_close"        # half-open probe reclosed a breaker
+EV_RELOAD_ROLLBACK = "reload_rollback"    # rolling reload rolled back a regression
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
@@ -70,6 +76,8 @@ EVENT_KINDS = (
     EV_FLEET_STRAGGLER, EV_FLEET_DESYNC, EV_FLEET_HOST_STALE,
     EV_SHARDING_AUDIT, EV_TILE_PLAN,
     EV_ELASTIC_SHRINK, EV_ELASTIC_GROW,
+    EV_REPLICA_EXIT, EV_REPLICA_RESTART, EV_REPLICA_BENCHED,
+    EV_BREAKER_OPEN, EV_BREAKER_CLOSE, EV_RELOAD_ROLLBACK,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")
@@ -110,6 +118,15 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     # a shrink is progress lost + degraded capacity; a re-grow is recovery
     EV_ELASTIC_SHRINK: "warn",
     EV_ELASTIC_GROW: "info",
+    # one replica death is absorbed by the fleet (warn); a bench means the
+    # fleet permanently lost capacity until an operator intervenes (error),
+    # and a reload rollback means a bad checkpoint reached serving (error)
+    EV_REPLICA_EXIT: "warn",
+    EV_REPLICA_RESTART: "warn",
+    EV_REPLICA_BENCHED: "error",
+    EV_BREAKER_OPEN: "warn",
+    EV_BREAKER_CLOSE: "info",
+    EV_RELOAD_ROLLBACK: "error",
 }
 
 

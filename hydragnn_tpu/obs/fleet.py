@@ -493,6 +493,20 @@ class FleetCollector:
                 for h in self._hosts.values()
             }
 
+    def host_series(self, host: int) -> Dict[str, float]:
+        """One host's absorbed scalar series (gauges last-write, counters
+        max-merged), keyed by canonical ``series_key`` — the serving fleet
+        manager's per-replica load/health view (live queue depth, shed
+        totals) without re-scraping each replica's /metrics. Empty dict
+        for a host that never pushed."""
+        with self._lock:
+            st = self._hosts.get(int(host))
+            if st is None:
+                return {}
+            out = dict(st.counters)
+            out.update(st.gauges)
+            return out
+
     def pending_commands(self) -> List[Dict[str, Any]]:
         with self._lock:
             return [dict(c) for c in self._commands]

@@ -182,6 +182,16 @@ class TelemetryHTTPServer:
         )
         self._thread.start()
 
+    def add_post_route(
+        self, path: str, handler: Callable[[bytes], Tuple[int, dict]]
+    ) -> None:
+        """Mount (or replace) a POST handler after construction — the
+        serving replica mounts /predict and /reload on the endpoint
+        ``GraphServer.start`` already opened, instead of a second server
+        stack. Dict assignment is atomic under the GIL, so mounting while
+        handler threads are serving is safe."""
+        self._post_routes[str(path)] = handler
+
     @property
     def port(self) -> int:
         return int(self._httpd.server_address[1])

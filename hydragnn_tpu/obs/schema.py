@@ -108,6 +108,22 @@ METRICS_KINDS: Dict[str, FieldSpec] = {
         "comm_bytes_peak": (_INT, True, True),
         "device_bytes_limit": (_NUM, True, True),
     },
+    # serving-fleet aggregate window (serve/fleet.py ReplicaManager writes
+    # it to the run dir's metrics.jsonl ~1/s while the fleet is up): the
+    # doctor's fleet-wide saturation source — ONE record spans every
+    # replica, so queue_saturation/shed_spiral can fire once for the fleet
+    # instead of once per replica stream
+    "fleet_serve": {
+        "replicas": (_INT, True, False),
+        "ready": (_INT, True, False),
+        "benched": (_INT, True, False),
+        "queue_depth_mean": (_NUM, True, False),
+        "queue_depth_max": (_NUM, True, False),
+        "shed_total": (_NUM, True, False),
+        "queue_full_total": (_NUM, True, False),
+        "completed_total": (_NUM, True, False),
+        "per_replica": (_DICT, True, False),
+    },
 }
 
 # ---------------------------------------------------------------------------

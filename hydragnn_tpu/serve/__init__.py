@@ -1,34 +1,76 @@
 """Serving plane: fault-tolerant micro-batched graph inference
 (docs/SERVING.md). ``api.run_server`` is the config-driven entry point;
-``GraphServer`` the direct constructor."""
+``GraphServer`` the direct constructor. ``api.run_server_fleet`` starts the
+multi-process fleet (``ReplicaManager`` supervising replica workers behind
+a ``FleetRouter`` with retries, hedging, circuit breakers, and an optional
+content-addressed ``PredictionCache``)."""
 
+from .cache import PredictionCache, graph_key
 from .config import ServeConfig
 from .errors import (
+    ERROR_CODES,
+    RETRYABLE_CODES,
+    BreakerOpenError,
     DeadlineExceededError,
     InvalidRequestError,
+    NoReplicasError,
     QueueFullError,
+    ReplicaUnavailableError,
     RequestError,
     ServeError,
     ServerClosedError,
     ServerDrainingError,
     SheddedError,
     WedgedStepError,
+    error_from_code,
 )
 from .reload import CheckpointWatcher
+from .router import (
+    CircuitBreaker,
+    FleetRouter,
+    HTTPReplicaClient,
+    LocalReplicaClient,
+    ReplicaClient,
+)
 from .server import GraphServer, PredictionHandle
 
+
+def __getattr__(name):
+    # ReplicaManager imports api machinery transitively; keep it lazy so
+    # `from hydragnn_tpu.serve import ServeConfig` stays light.
+    if name == "ReplicaManager":
+        from .fleet import ReplicaManager
+
+        return ReplicaManager
+    raise AttributeError(name)
+
+
 __all__ = [
+    "BreakerOpenError",
     "CheckpointWatcher",
+    "CircuitBreaker",
     "DeadlineExceededError",
+    "ERROR_CODES",
+    "FleetRouter",
     "GraphServer",
+    "HTTPReplicaClient",
     "InvalidRequestError",
+    "LocalReplicaClient",
+    "NoReplicasError",
+    "PredictionCache",
     "PredictionHandle",
     "QueueFullError",
+    "ReplicaClient",
+    "ReplicaManager",
+    "ReplicaUnavailableError",
     "RequestError",
+    "RETRYABLE_CODES",
     "ServeConfig",
     "ServeError",
     "ServerClosedError",
     "ServerDrainingError",
     "SheddedError",
     "WedgedStepError",
+    "error_from_code",
+    "graph_key",
 ]

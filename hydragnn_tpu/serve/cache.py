@@ -1,0 +1,156 @@
+"""Content-addressed prediction cache (docs/SERVING.md "Fleet").
+
+Same storage discipline as the LapPE eigenvector cache (data/lappe.py), the
+repo's proven on-disk memoization scheme, applied to inference results:
+
+- the key is a sha256 over the graph's *input content* — every inference
+  input array's name, dtype, shape, and raw bytes, plus ``dataset_id`` —
+  so two bit-identical graphs share an entry and any single-bit input
+  difference misses;
+- entries are ``.npz`` files sharded by the first two hex digits
+  (``cache_dir/ab/abcdef....npz``) to keep directory fan-out flat;
+- stores are atomic: write to ``<path>.tmp.<pid>`` then ``os.replace`` —
+  concurrent replicas racing on the same key both win, torn writes are
+  impossible, and a reader never sees a partial file;
+- loads are digest-verified: the entry records a sha256 over the stored
+  prediction arrays, recomputed at load; any mismatch (corrupt file,
+  truncation that survived the zip CRC) is treated as a miss and the
+  prediction recomputed — a broken cache can cost latency, never
+  correctness.
+
+Bit-identity of hits is by construction, not best-effort: ``.npz`` is a
+lossless container, so the arrays handed back on a hit are byte-for-byte
+the arrays that were stored on the miss. tests/test_serve_fleet.py asserts
+it with ``np.array_equal`` on exact dtypes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import threading
+import zipfile
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..data.graph import Graph
+
+# Graph fields that are inference *inputs* — targets deliberately excluded
+# (they do not influence the prediction, and keying on them would split
+# entries for identical inputs). Mirrors Graph.float_channels plus the
+# integer topology/identity fields.
+_KEY_FIELDS = (
+    "x", "pos", "senders", "receivers", "edge_attr", "edge_shifts",
+    "pe", "rel_pe", "z", "graph_y", "cell",
+)
+
+
+def graph_key(graph: Graph) -> str:
+    """sha256 hex key over the graph's inference-input content."""
+    h = hashlib.sha256()
+    for name in _KEY_FIELDS:
+        v = getattr(graph, name, None)
+        if v is None:
+            continue
+        a = np.ascontiguousarray(np.asarray(v))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    h.update(f"dataset_id={int(graph.dataset_id)}".encode())
+    return h.hexdigest()
+
+
+def _result_digest(result: Dict[str, np.ndarray]) -> str:
+    """sha256 over the prediction arrays, order-independent."""
+    h = hashlib.sha256()
+    for name in sorted(result):
+        a = np.ascontiguousarray(np.asarray(result[name]))
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class PredictionCache:
+    """Sharded on-disk prediction cache; safe for concurrent processes.
+
+    ``get`` returns the cached head->array dict on a verified hit and
+    ``None`` on any miss (absent, unreadable, digest mismatch); ``put``
+    stores atomically and never raises on I/O failure — the cache is an
+    accelerator, not a dependency. ``stats()`` exposes hit/miss/store/
+    corrupt counters for the fleet gauges and bench cells.
+    """
+
+    def __init__(self, cache_dir: str):
+        self.cache_dir = cache_dir
+        os.makedirs(cache_dir, exist_ok=True)
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.stores = 0
+        self.corrupt = 0
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key[:2], key + ".npz")
+
+    def get(self, graph: Graph, key: Optional[str] = None
+            ) -> Optional[Dict[str, np.ndarray]]:
+        key = key or graph_key(graph)
+        path = self._path(key)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                stored_digest = str(z["__digest__"])
+                result = {
+                    n: np.asarray(z[n]) for n in z.files if n != "__digest__"
+                }
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            with self._lock:
+                self.misses += 1
+            return None
+        if _result_digest(result) != stored_digest:
+            # Corrupt entry that survived the zip CRC: drop it and recompute.
+            with self._lock:
+                self.corrupt += 1
+                self.misses += 1
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None
+        with self._lock:
+            self.hits += 1
+        return result
+
+    def put(self, graph: Graph, result: Dict[str, np.ndarray],
+            key: Optional[str] = None) -> Optional[str]:
+        key = key or graph_key(graph)
+        path = self._path(key)
+        arrays = {n: np.asarray(v) for n, v in result.items()}
+        payload = dict(arrays)
+        payload["__digest__"] = np.asarray(_result_digest(arrays))
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            buf = io.BytesIO()
+            np.savez(buf, **payload)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(buf.getvalue())
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        with self._lock:
+            self.stores += 1
+        return key
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "stores": self.stores,
+                "corrupt": self.corrupt,
+            }

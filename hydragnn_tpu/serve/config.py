@@ -64,6 +64,50 @@ class ServeConfig:
     # weight HBM + bf16 MXU streams); batch stats stay f32. Applied to hot
     # reloads too. Default keeps the checkpoint's own precision.
     weights_dtype: str = "float32"
+    # drain ordering (docs/SERVING.md "Drain"): on SIGTERM /readyz flips
+    # not-ready immediately, but admissions stay open for drain_grace_s so
+    # a load balancer observes the flip and stops routing *before* clients
+    # start eating ServerDrainingError. 0 (the default) rejects immediately
+    # — the pre-fleet behavior.
+    drain_grace_s: float = 0.0
+    # fleet supervision (serve/fleet.py; docs/SERVING.md "Fleet"):
+    # fleet_replicas > 0 is the ReplicaManager's worker count; crashed
+    # replicas restart with exponential backoff (base doubling up to the
+    # cap) and a replica dying fleet_flap_max_restarts times inside
+    # fleet_flap_window_s is benched (typed replica_benched event), not
+    # restarted forever. fleet_ready_floor is the fraction of replicas that
+    # must stay ready during a rolling reload.
+    fleet_replicas: int = 0
+    fleet_restart_backoff_s: float = 0.5
+    fleet_restart_backoff_max_s: float = 10.0
+    fleet_flap_window_s: float = 60.0
+    fleet_flap_max_restarts: int = 5
+    fleet_ready_floor: float = 0.5
+    # front router (serve/router.py): per-request end-to-end timeout,
+    # bounded retries of retryable failures on a different replica
+    # (router_backoff_s base, doubling), tail hedging past
+    # max(router_hedge_min_s, router_hedge_factor x EMA latency) for
+    # interactive traffic, and a per-replica circuit breaker that opens
+    # after breaker_failures consecutive typed failures and half-open
+    # probes after breaker_cooldown_s.
+    router_timeout_s: float = 30.0
+    router_retries: int = 2
+    router_backoff_s: float = 0.05
+    router_hedge_factor: float = 3.0
+    router_hedge_min_s: float = 0.05
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 5.0
+    # content-addressed prediction cache (serve/cache.py): False disables,
+    # True uses <run dir>/pred_cache, a string is an explicit directory.
+    # Hits are bit-identical to misses by construction (lossless .npz +
+    # digest-verified load).
+    prediction_cache: Any = False
+    # rolling-reload regression guard: after the first replica swaps, the
+    # manager probes it with reload_probe_requests requests; an error rate
+    # >= reload_error_spike rolls that replica back to the prior checkpoint
+    # (typed reload_rollback event) and aborts the rollout.
+    reload_error_spike: float = 0.5
+    reload_probe_requests: int = 8
 
     _KNOWN = (
         "max_queue_requests",
@@ -80,6 +124,23 @@ class ServeConfig:
         "http_port",
         "http_host",
         "weights_dtype",
+        "drain_grace_s",
+        "fleet_replicas",
+        "fleet_restart_backoff_s",
+        "fleet_restart_backoff_max_s",
+        "fleet_flap_window_s",
+        "fleet_flap_max_restarts",
+        "fleet_ready_floor",
+        "router_timeout_s",
+        "router_retries",
+        "router_backoff_s",
+        "router_hedge_factor",
+        "router_hedge_min_s",
+        "breaker_failures",
+        "breaker_cooldown_s",
+        "prediction_cache",
+        "reload_error_spike",
+        "reload_probe_requests",
     )
 
     WEIGHTS_DTYPES = ("float32", "bfloat16")
@@ -99,12 +160,47 @@ class ServeConfig:
             )
         for key in ("batch_window_s", "default_deadline_s", "slo_p99_s",
                     "expected_latency_per_graph_s", "step_timeout_s",
-                    "reload_poll_s", "drain_timeout_s"):
+                    "reload_poll_s", "drain_timeout_s", "drain_grace_s",
+                    "fleet_restart_backoff_s", "fleet_restart_backoff_max_s",
+                    "fleet_flap_window_s", "router_timeout_s",
+                    "router_backoff_s", "router_hedge_min_s",
+                    "breaker_cooldown_s"):
             if float(getattr(self, key)) < 0:
                 raise ValueError(
                     f"Serving.{key} must be >= 0 (seconds; 0 disables), got "
                     f"{getattr(self, key)!r}"
                 )
+        for key in ("fleet_replicas", "fleet_flap_max_restarts",
+                    "router_retries", "breaker_failures",
+                    "reload_probe_requests"):
+            if int(getattr(self, key)) < 0:
+                raise ValueError(
+                    f"Serving.{key} must be >= 0, got {getattr(self, key)!r}"
+                )
+        if not (0.0 <= float(self.fleet_ready_floor) <= 1.0):
+            raise ValueError(
+                f"Serving.fleet_ready_floor must be a fraction in [0, 1], "
+                f"got {self.fleet_ready_floor!r}"
+            )
+        if not (0.0 <= float(self.reload_error_spike) <= 1.0):
+            raise ValueError(
+                f"Serving.reload_error_spike must be a fraction in [0, 1], "
+                f"got {self.reload_error_spike!r}"
+            )
+        if float(self.router_hedge_factor) < 1.0:
+            raise ValueError(
+                f"Serving.router_hedge_factor must be >= 1 (multiple of the "
+                f"EMA latency), got {self.router_hedge_factor!r}"
+            )
+        if not isinstance(self.prediction_cache, (bool, str)) or (
+            isinstance(self.prediction_cache, str)
+            and not self.prediction_cache
+        ):
+            raise ValueError(
+                f"Serving.prediction_cache must be False, True, or a "
+                f"non-empty cache directory path, got "
+                f"{self.prediction_cache!r}"
+            )
         if int(self.http_port) > 65535:
             raise ValueError(
                 f"Serving.http_port must be <= 65535 (0 = ephemeral, "

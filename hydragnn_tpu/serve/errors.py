@@ -101,3 +101,89 @@ class ServerClosedError(RequestError):
     served by this process."""
 
     code = "closed"
+
+
+class ReplicaUnavailableError(RequestError):
+    """A fleet replica could not take the request at the transport level:
+    connection refused/reset, the replica process died mid-request, or its
+    /predict endpoint returned a non-protocol failure. Retryable on a
+    different replica — the request never entered a device batch."""
+
+    code = "replica_unavailable"
+
+
+class BreakerOpenError(RequestError):
+    """The target replica's circuit breaker is open (too many consecutive
+    typed failures); the router refuses to send it traffic until the
+    half-open probe recloses it. Raised to callers only when *every*
+    candidate replica is broken or benched."""
+
+    code = "breaker_open"
+
+
+class NoReplicasError(RequestError):
+    """The router exhausted its retry budget without finding a replica that
+    could serve the request: all replicas dead, benched, breaker-open, or
+    failing. Carries the per-attempt failure codes for forensics."""
+
+    code = "no_replicas"
+
+    def __init__(self, message: str, request_id: Optional[int] = None,
+                 attempts: Optional[list] = None):
+        super().__init__(message, request_id)
+        self.attempts = list(attempts or [])
+
+
+#: Stable error-code table (docs/SERVING.md "Fleet" cross-links here): the
+#: wire codec (serve/wire.py) serializes failures as these codes and the
+#: client side reconstructs the *typed* exception from the code, so a
+#: router retrying against a remote replica branches on the same vocabulary
+#: as an in-process caller. Codes are append-only: renaming or removing one
+#: breaks deployed clients.
+ERROR_CODES = {
+    cls.code: cls
+    for cls in (
+        ServeError,
+        RequestError,
+        InvalidRequestError,
+        QueueFullError,
+        SheddedError,
+        DeadlineExceededError,
+        WedgedStepError,
+        ServerDrainingError,
+        ServerClosedError,
+        ReplicaUnavailableError,
+        BreakerOpenError,
+        NoReplicasError,
+    )
+}
+
+#: Codes safe to retry on a *different* replica: the request provably never
+#: produced (partial) effects on the failing one — it was rejected at
+#: admission or failed at the transport/lifecycle layer. ``shed`` and
+#: ``queue_full`` are deliberately absent: those are backpressure signals,
+#: and retrying them elsewhere amplifies an overload instead of routing
+#: around a fault. ``invalid_request`` is absent because it fails the same
+#: way everywhere.
+RETRYABLE_CODES = frozenset(
+    (
+        ReplicaUnavailableError.code,
+        ServerDrainingError.code,
+        ServerClosedError.code,
+        WedgedStepError.code,
+        BreakerOpenError.code,
+    )
+)
+
+
+def error_from_code(code: str, message: str) -> ServeError:
+    """Reconstruct a typed serving error from its stable wire code.
+
+    Unknown codes (a newer server than client) degrade to the base
+    ``ServeError`` — the message still carries the detail."""
+    cls = ERROR_CODES.get(code, ServeError)
+    try:
+        err = cls(message)
+    except TypeError:  # pragma: no cover - all current ctors take (message)
+        err = ServeError(message)
+    return err

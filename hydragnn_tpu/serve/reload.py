@@ -100,7 +100,16 @@ class CheckpointWatcher:
                 stacklevel=2,
             )
             return "rejected"
-        self.server._install_state(state, entry)
+        if not self.server._install_state(state, entry):
+            # the server refused the stage: it is draining/closing and the
+            # serve loop will never take another swap. Count a rejection
+            # (not an install — nothing was staged) and let the standby
+            # state drop here instead of leaking it past close().
+            self.rejected += 1
+            self._emit_event(
+                "reject", entry, detail="server draining/closed at install"
+            )
+            return "rejected"
         self.installed += 1
         self._emit_event("swap", entry)
         return "installed"

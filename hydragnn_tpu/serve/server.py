@@ -300,6 +300,12 @@ class GraphServer:
         self._pending_state: Optional[Tuple[Any, Optional[str]]] = None
         self._ready = threading.Event()
         self._draining = threading.Event()
+        # admissions stay open until this monotonic stamp once _draining is
+        # set (Serving.drain_grace_s): /readyz flips not-ready immediately,
+        # so a load balancer stops routing BEFORE clients start eating
+        # ServerDrainingError. 0.0 default = reject the instant drain
+        # starts (grace 0 keeps pre-fleet behavior exactly).
+        self._drain_admit_deadline = 0.0
         self._drained = threading.Event()
         self._stop = threading.Event()
         self._closed = False
@@ -552,7 +558,18 @@ class GraphServer:
         must fall out of its load balancer; the gauge write is a plain
         dict store, still async-signal-safe. Only the instance that
         reported ready may zero the shared gauge — draining a never-ready
-        standby must not clobber a live server's readiness.)"""
+        standby must not clobber a live server's readiness.)
+
+        Drain ordering (docs/SERVING.md "Fleet"): /readyz keys off
+        ``_draining`` and flips 503 the moment it is set, but ``submit``
+        keeps admitting for ``Serving.drain_grace_s`` more — the window in
+        which a load balancer observes the not-ready flip and stops
+        routing here, so well-behaved clients never see a
+        ServerDrainingError. The stamp is arithmetic + a float store,
+        still async-signal-safe."""
+        self._drain_admit_deadline = time.monotonic() + float(
+            self.cfg.drain_grace_s
+        )
         self._draining.set()
         if self._ready.is_set():
             self._m_ready.set(0)
@@ -578,6 +595,11 @@ class GraphServer:
             self.drain(timeout)
         self._closed = True
         self._stop.set()
+        # drop any staged reload the serve loop will never swap in — a
+        # watcher poll that staged between drain and here must not leak
+        # the standby state past the server's lifetime
+        with self._swap_lock:
+            self._pending_state = None
         if self._ready.is_set():
             # same standby guard as initiate_drain: only a server that
             # reported ready un-reports on close
@@ -656,7 +678,12 @@ class GraphServer:
                 else f"server failed at warm-up: {self.failed}",
                 request_id=idx,
             )
-        if self._draining.is_set():
+        # grace window (initiate_drain): /readyz is already 503, but
+        # admissions stay open until the stamped deadline so the LB can
+        # stop routing before clients see the typed rejection
+        if self._draining.is_set() and (
+            time.monotonic() >= self._drain_admit_deadline
+        ):
             self._bump("rejected")
             raise ServerDrainingError(
                 "server is draining (SIGTERM or drain()); request not admitted",
@@ -885,9 +912,12 @@ class GraphServer:
                     self._pending_state = None
                     self._bump("reloads")
             if reqs is None:
+                # exit only once the admission grace window has also passed
+                # — a request legitimately admitted during drain_grace_s
+                # must not race a loop that already quit
                 if self._draining.is_set() and self._queue.qsize() == 0 and (
                     self._holdover is None
-                ):
+                ) and time.monotonic() >= self._drain_admit_deadline:
                     break
                 continue
             self._inflight_graphs = len(reqs)
@@ -1129,12 +1159,18 @@ class GraphServer:
 
         return cast_inference_weights(state, self.cfg.weights_dtype)
 
-    def _install_state(self, state, label: Optional[str]) -> None:
+    def _install_state(self, state, label: Optional[str]) -> bool:
         """Stage a reloaded state; the serve loop swaps it in at the next
         batch boundary (in-flight batches keep the weights they started
-        with)."""
+        with). Refused (returns False) on a draining/stopping/closed
+        server: a CheckpointWatcher poll racing close() must neither swap
+        a new state into a server that is winding down nor leak the
+        standby state past close()'s pending-state clear."""
         with self._swap_lock:
+            if self._closed or self._stop.is_set() or self._draining.is_set():
+                return False
             self._pending_state = (self._cast_weights(state), label)
+            return True
 
     def _bump(self, key: str, by: int = 1) -> None:
         with self._stats_lock:

@@ -551,6 +551,50 @@ def load_inference_state(
     _raise_no_checkpoint(log_name, d, tried)
 
 
+def load_inference_entry(
+    template, log_name: str, entry: str, path: str = "./logs"
+) -> "InferenceState":
+    """Restore one SPECIFIC digest-verified msgpack entry — no walk-back.
+
+    The rolling-reload rollback (serve/fleet.py) needs "exactly the prior
+    checkpoint or fail loudly", never "whatever older file the chain
+    finds": silently restoring a third version during a rollback would
+    leave the fleet serving a mix no one chose. Raises FileNotFoundError
+    when the entry is missing and ValueError when it fails verification
+    or deserialization."""
+    tried: List[str] = []
+    d = _run_dir(log_name, path)
+    full = os.path.join(d, entry)
+    if not os.path.exists(full):
+        raise FileNotFoundError(
+            f"checkpoint entry {entry!r} of run {log_name!r} does not exist "
+            f"at {full!r}"
+        )
+    blob = _verified_read(full, tried)
+    if blob is None:
+        raise ValueError(
+            f"checkpoint entry {entry!r} failed verification: {tried}"
+        )
+    try:
+        raw = serialization.msgpack_restore(blob)
+        return template.replace(
+            params=serialization.from_state_dict(
+                template.params, raw["params"]
+            ),
+            batch_stats=serialization.from_state_dict(
+                template.batch_stats, raw.get("batch_stats", {})
+            ),
+            step=int(np.asarray(raw.get("step", 0))),
+        )
+    except (ValueError, FileNotFoundError):
+        raise
+    except Exception as e:  # noqa: BLE001 — structure drift / truncation
+        raise ValueError(
+            f"checkpoint entry {entry!r} failed to deserialize: "
+            f"{type(e).__name__}: {e}"
+        )
+
+
 def load_existing_model(
     template_state: TrainState,
     log_name: str,

@@ -63,6 +63,26 @@ Serve-plane points (docs/SERVING.md "Failure model"):
   the slow-client model (admission must not be wedged by one caller; other
   threads keep being served).
 
+Serving-fleet points (docs/SERVING.md "Fleet"): all three take a
+``replica:...`` spec so ONE env set on the whole fleet arms exactly one
+replica (the manager passes its environment through to every worker);
+``replica`` is the worker's fleet index (HYDRAGNN_FLEET_HOST_INDEX).
+
+- ``HYDRAGNN_FAULT_REPLICA_KILL`` (``"r:k"``, k in the ``_index_armed``
+  grammar): ``maybe_replica_kill`` SIGKILLs replica r before serving its
+  k-th /predict request — the dead-replica model: the router's retry must
+  absorb the in-flight loss on a different replica and the ReplicaManager
+  must restart the worker within its backoff bound.
+- ``HYDRAGNN_FAULT_REPLICA_WEDGE`` (``"r:k[:secs]"``, default 30s):
+  ``maybe_replica_wedge`` sleeps replica r's armed /predict requests
+  before processing — the wedged-replica model that must open the
+  router's circuit breaker, then reclose it via the half-open probe once
+  the armed window passes.
+- ``HYDRAGNN_FAULT_REPLICA_SLOW`` (``"r[:secs]"``, default 0.2s):
+  ``maybe_replica_slow`` sleeps EVERY /predict on replica r — the
+  slow-replica model the router's tail hedging must beat (duplicate to a
+  fast replica past the hedge deadline, first answer wins).
+
 Fleet-plane points (docs/OBSERVABILITY.md "Fleet"):
 
 - ``HYDRAGNN_FAULT_STRAGGLE`` (``"k:secs"``, ``"k+:secs"``, or bare
@@ -128,6 +148,9 @@ def configure(**kwargs: Optional[str]) -> None:
         "serve_req_nan": "HYDRAGNN_FAULT_SERVE_REQ_NAN",
         "serve_wedge": "HYDRAGNN_FAULT_SERVE_WEDGE",
         "serve_slow_client": "HYDRAGNN_FAULT_SERVE_SLOW_CLIENT",
+        "replica_kill": "HYDRAGNN_FAULT_REPLICA_KILL",
+        "replica_wedge": "HYDRAGNN_FAULT_REPLICA_WEDGE",
+        "replica_slow": "HYDRAGNN_FAULT_REPLICA_SLOW",
         "straggle": "HYDRAGNN_FAULT_STRAGGLE",
         "host_kill": "HYDRAGNN_FAULT_HOST_KILL",
         "host_preempt": "HYDRAGNN_FAULT_HOST_PREEMPT",
@@ -369,6 +392,58 @@ def maybe_slow_client(request_index: int) -> None:
     1s) — the slow-client model: one dawdling caller must only delay
     itself, never the serve loop or other submitters."""
     _indexed_sleep(_get("HYDRAGNN_FAULT_SERVE_SLOW_CLIENT"), request_index, 1.0)
+
+
+def _replica_spec(key: str, replica_index: int) -> Optional[str]:
+    """Resolve a ``"r:..."`` replica-scoped spec: returns the ``...`` part
+    when the leading replica index matches this worker, else None."""
+    spec = _get(key)
+    if spec is None:
+        return None
+    r, sep, rest = spec.partition(":")
+    try:
+        if int(r) != replica_index:
+            return None
+    except ValueError:
+        return None
+    return rest if sep else ""
+
+
+def maybe_replica_kill(replica_index: int, request_index: int) -> None:
+    """SIGKILL this replica before serving request ``request_index`` when
+    armed (HYDRAGNN_FAULT_REPLICA_KILL = ``"r:k"``; k defaults to 0, the
+    first request) — the dead-replica model: no grace, nothing runs after
+    it; the in-flight request is the router's retry problem and the
+    restart is the ReplicaManager's."""
+    kspec = _replica_spec("HYDRAGNN_FAULT_REPLICA_KILL", replica_index)
+    if kspec is None:
+        return
+    if _index_armed(kspec or "0", request_index):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_replica_wedge(replica_index: int, request_index: int) -> None:
+    """Sleep this replica's armed /predict requests before processing
+    (HYDRAGNN_FAULT_REPLICA_WEDGE = ``"r:k[:secs]"``, default 30s — longer
+    than any sane router timeout) — the wedged-replica model that must
+    open the circuit breaker; requests past the armed window succeed, so
+    the half-open probe recloses it."""
+    rest = _replica_spec("HYDRAGNN_FAULT_REPLICA_WEDGE", replica_index)
+    if rest is None:
+        return
+    _indexed_sleep(rest or "0", request_index, 30.0)
+
+
+def maybe_replica_slow(replica_index: int) -> None:
+    """Sleep EVERY /predict on this replica when armed
+    (HYDRAGNN_FAULT_REPLICA_SLOW = ``"r[:secs]"``, default 0.2s) — the
+    slow-replica model the router's tail hedging must beat."""
+    rest = _replica_spec("HYDRAGNN_FAULT_REPLICA_SLOW", replica_index)
+    if rest is None:
+        return
+    import time
+
+    time.sleep(float(rest) if rest else 0.2)
 
 
 def maybe_straggle(step_index: int) -> None:

@@ -78,6 +78,9 @@ python run-scripts/mix_chaos_smoke.py
 echo "== serve-plane chaos smoke (zero-retrace load, corrupt-request isolation, wedged step, hot reload, SIGTERM drain) =="
 python run-scripts/serve_chaos_smoke.py
 
+echo "== serve fleet smoke (2-replica supervised fleet: wedge -> breaker open/reclose + hedge wins, bit-identical prediction-cache hit, mid-load SIGKILL retried to zero client failures + supervisor restart, rolling reload under load holding the ready floor) =="
+python run-scripts/serve_fleet_smoke.py
+
 echo "== telemetry smoke (metrics.jsonl + /metrics//healthz//readyz on train + serve legs; <=2% overhead A/B) =="
 python run-scripts/telemetry_smoke.py
 
@@ -102,8 +105,11 @@ echo "== bench regression gate (newest committed round vs prior; + mixture cells
 # same knob because the drift cells are seed-deterministic
 python run-scripts/bench_gate.py --mix-cells logs/mix_cells.jsonl --mix-threshold 0.5
 
-echo "== BENCH_SERVE cells (p50/p99 latency vs offered load, throughput at SLO, shed rate) =="
+echo "== BENCH_SERVE cells (p50/p99 latency vs offered load, throughput at SLO, shed rate; fleet cells: router aggregate throughput at 1/2/4 replicas + cache hit rate) =="
 BENCH_SERVE=1 BENCH_SERVE_SECS=2 python bench.py
+
+echo "== serve fleet bench gate (fleet_r{1,2,4} aggregate graphs/sec round-over-round; same noise rationale as the mixture gate) =="
+python run-scripts/bench_gate.py --mix-cells logs/serve_cells.jsonl --mix-threshold 0.5
 
 echo "== multichip dryrun (8 virtual devices) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -35,6 +35,9 @@ import tempfile
 import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_REPO, "run-scripts"))
+
+from smoke_env import child_env  # noqa: E402 — shared child-spawn recipe
 
 _CHILD = """
 import sys
@@ -199,18 +202,6 @@ print("SERVE_CHAOS_CLEAN_EXIT", flush=True)
 """
 
 
-def _env():
-    env = {k: v for k, v in os.environ.items() if k != "PALLAS_AXON_POOL_IPS"}
-    env["JAX_PLATFORMS"] = "cpu"
-    env["HYDRAGNN_VALTEST"] = "0"
-    env["PYTHONPATH"] = ":".join(
-        p
-        for p in [_REPO] + env.get("PYTHONPATH", "").split(":")
-        if p and ".axon_site" not in p
-    )
-    return env
-
-
 _MARKERS = (
     "LOAD_OK",
     "ISOLATION_OK",
@@ -228,7 +219,8 @@ def main() -> int:
     with open(script, "w") as f:
         f.write("import re, time\n" + _CHILD.format(repo=_REPO))
     proc = subprocess.Popen(
-        [sys.executable, script], cwd=workdir, env=_env(),
+        [sys.executable, script], cwd=workdir,
+        env=child_env({"HYDRAGNN_VALTEST": "0"}),
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     lines = []

@@ -151,6 +151,25 @@ def pytest_schema_drift_step_telemetry_records(tmp_path):
                     "time_to_first_step": None},
     })
     telem.close()
+    # the fleet_serve kind's real producer is the ReplicaManager's
+    # aggregate-window writer — drive it into the same stream without
+    # spawning a fleet
+    from hydragnn_tpu.serve.fleet import ReplicaManager
+
+    class _Slot:
+        benched = False
+
+    mgr = ReplicaManager.__new__(ReplicaManager)
+    mgr.n = 2
+    mgr.run_dir = str(tmp_path / "doctor_drift")
+    mgr._replicas = {1: _Slot(), 2: _Slot()}
+    mgr._metrics_fh = None
+    mgr._write_metrics_record(
+        2, 3.0, 2, 1, 0, 42,
+        {"1": {"queue_depth": 1, "shed": 1, "queue_full": 0, "ready": True},
+         "2": {"queue_depth": 2, "shed": 0, "queue_full": 0, "ready": True}},
+    )
+    mgr._metrics_fh.close()
     records = [
         json.loads(l)
         for l in open(tmp_path / "doctor_drift" / "metrics.jsonl")

@@ -623,3 +623,95 @@ def pytest_hpo_worker_failure_surfaces_log_tail(tmp_path):
     # the parent error carries the worker's log tail, not just the rc
     assert "MARKER_jax_distributed_not_initialized" in msg
     assert "worker0.log" in msg or "worker 0" in msg
+
+
+# ---------------------------------------------------------------------------
+# drain-grace ordering + watcher/close race (fleet satellites)
+# ---------------------------------------------------------------------------
+
+
+def pytest_sigterm_flips_readiness_before_rejecting(serve_world):
+    """LB-safe drain ordering: on SIGTERM, /readyz must go not-ready
+    FIRST (so the balancer stops routing here) while admissions stay open
+    for Serving.drain_grace_s — requests already in flight from the LB's
+    point of view land safely — and only after the grace expires does
+    submit() reject."""
+    server = _server(
+        serve_world,
+        serve_config=ServeConfig(
+            micro_batch_graphs=8, batch_window_s=0.005, step_timeout_s=20.0,
+            drain_grace_s=0.6,
+        ),
+    ).start(install_sigterm=True)
+    try:
+        assert server.wait_ready(120), server.failed
+        _, _, _, _, ready = serve_world
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not server.draining and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # readiness (what /readyz serves) is already false...
+        assert server.draining
+        # ...but the admission gate honors the grace window: a request the
+        # balancer routed just before it saw not-ready still gets in
+        h = server.submit(ready[0])
+        assert isinstance(h.result(30), dict)
+        # after the grace expires the gate closes
+        drain_deadline = time.monotonic() + 10
+        while time.monotonic() < drain_deadline:
+            try:
+                server.submit(ready[0]).result(30)
+                time.sleep(0.02)
+            except ServerDrainingError:
+                break
+        with pytest.raises(ServerDrainingError):
+            server.submit(ready[0])
+        assert server.drain(60)
+    finally:
+        server.close(drain=False)
+
+
+def pytest_reload_install_refused_on_draining_server(serve_world, tmp_path):
+    """CheckpointWatcher swap/drain race: a reload candidate that finishes
+    verifying while the server is draining must NOT swap in (the drain
+    contract is 'answer the admitted requests with the weights they were
+    admitted under') and must not leak staged standby state."""
+    run_dir = str(tmp_path)
+    log_name = "serve_race"
+    _save_scaled(serve_world, run_dir, log_name, 1.0, epoch=1)
+    server = _server(serve_world).start()
+    try:
+        assert server.wait_ready(120), server.failed
+        watcher = CheckpointWatcher(
+            server, log_name, path=run_dir, initial_entry=None
+        )
+        server.initiate_drain()
+        # the poll's verified candidate arrives mid-drain: refused
+        assert watcher.poll_once() == "rejected"
+        assert watcher.rejected == 1
+        assert server._pending_state is None  # nothing staged to leak
+        assert server.stats()["reloads"] == 0
+        assert server.drain(60)
+    finally:
+        server.close(drain=False)
+
+
+def pytest_close_drops_staged_reload_state(serve_world, tmp_path):
+    """close() must clear a staged-but-not-yet-swapped reload instead of
+    leaking the standby InferenceState (and must refuse installs that race
+    close)."""
+    run_dir = str(tmp_path)
+    log_name = "serve_close_race"
+    _save_scaled(serve_world, run_dir, log_name, 2.0, epoch=1)
+    server = _server(serve_world)  # constructed, never started: no swap
+    watcher = CheckpointWatcher(
+        server, log_name, path=run_dir, initial_entry=None
+    )
+    assert watcher.poll_once() == "installed"  # staged, loop not running
+    assert server._pending_state is not None
+    server.close(drain=False)
+    assert server._pending_state is None  # staged standby state dropped
+    # and a watcher firing after close is refused, not silently staged
+    _save_scaled(serve_world, run_dir, log_name, 3.0, epoch=2)
+    assert watcher.poll_once() == "rejected"
+    assert server._pending_state is None

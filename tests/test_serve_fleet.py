@@ -1,0 +1,570 @@
+"""Serving-fleet request plane (docs/SERVING.md "Fleet"), tested without
+any JAX warm-up: the router (balancing, retry-on-a-different-replica,
+hedging, circuit breakers, batch-priority shedding) over stub replica
+clients, the content-addressed prediction cache (bit-identity, corrupt
+entry demotion, atomic writes), the wire codec (exact dtype round-trips,
+typed error reconstruction), the new ServeConfig fleet keys, the stable
+error-code table, replica-scoped fault specs, and the doctor's
+fleet-aggregated saturation rules."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hydragnn_tpu.data import deterministic_graph_dataset
+from hydragnn_tpu.serve import (
+    BreakerOpenError,
+    CircuitBreaker,
+    ERROR_CODES,
+    FleetRouter,
+    InvalidRequestError,
+    NoReplicasError,
+    PredictionCache,
+    ReplicaClient,
+    ReplicaUnavailableError,
+    RETRYABLE_CODES,
+    ServeConfig,
+    ServeError,
+    SheddedError,
+    error_from_code,
+    graph_key,
+)
+from hydragnn_tpu.serve import wire
+from hydragnn_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+@pytest.fixture(scope="module")
+def graphs():
+    return deterministic_graph_dataset(4, seed=11)
+
+
+def _result(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "graph_s": rng.standard_normal((1, 1)).astype(np.float32),
+        "node_e": rng.standard_normal((5, 1)).astype(np.float64),
+    }
+
+
+class StubReplica(ReplicaClient):
+    """Scriptable in-memory replica: ``fail_with`` raises per call until
+    exhausted, then predictions succeed; ``delay_s`` models a slow
+    replica."""
+
+    def __init__(self, name, result=None, fail_with=(), delay_s=0.0,
+                 depth=0.0):
+        self.name = name
+        self._result = result if result is not None else _result()
+        self._failures = list(fail_with)
+        self.delay_s = delay_s
+        self.depth = depth
+        self.calls = 0
+        self._lock = threading.Lock()
+
+    def predict(self, graph, timeout_s=None):
+        with self._lock:
+            self.calls += 1
+            exc = self._failures.pop(0) if self._failures else None
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        if exc is not None:
+            raise exc
+        return dict(self._result)
+
+    def ready(self):
+        return True
+
+    def queue_depth(self):
+        return self.depth
+
+
+def _cfg(**kw):
+    kw.setdefault("router_backoff_s", 0.001)
+    kw.setdefault("router_timeout_s", 5.0)
+    return ServeConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# router: balancing / retries / hedging / priorities
+# ---------------------------------------------------------------------------
+
+
+def pytest_router_balances_on_queue_depth(graphs):
+    a = StubReplica("a", depth=5.0)
+    b = StubReplica("b", depth=0.0)
+    r = FleetRouter({"a": a, "b": b}, cfg=_cfg())
+    for _ in range(4):
+        r.predict(graphs[0])
+    # every request should land on the idle replica
+    assert b.calls == 4 and a.calls == 0
+
+
+def pytest_router_depth_fn_overrides_client_depth(graphs):
+    a = StubReplica("a", depth=0.0)
+    b = StubReplica("b", depth=0.0)
+    # the collector-substrate hook says a is drowning even though the
+    # client-side depth does not
+    r = FleetRouter({"a": a, "b": b}, cfg=_cfg(),
+                    depth_fn=lambda n: 50.0 if n == "a" else 0.0)
+    r.predict(graphs[0])
+    assert b.calls == 1 and a.calls == 0
+
+
+def pytest_router_retries_on_a_different_replica(graphs):
+    a = StubReplica("a", fail_with=[ReplicaUnavailableError("conn reset")],
+                    depth=0.0)
+    b = StubReplica("b", depth=1.0)  # scored worse: a gets picked first
+    r = FleetRouter({"a": a, "b": b}, cfg=_cfg(router_retries=2))
+    out = r.predict(graphs[0])
+    assert set(out) == {"graph_s", "node_e"}
+    assert a.calls == 1 and b.calls == 1
+    st = r.stats()
+    assert st["retries"] >= 1 and st["succeeded"] == 1
+
+
+def pytest_router_does_not_retry_invalid_request(graphs):
+    a = StubReplica("a", fail_with=[InvalidRequestError("bad graph")])
+    b = StubReplica("b", depth=1.0)
+    r = FleetRouter({"a": a, "b": b}, cfg=_cfg(router_retries=3))
+    with pytest.raises(InvalidRequestError):
+        r.predict(graphs[0])
+    # a client bug fails identically everywhere: exactly one attempt
+    assert a.calls + b.calls == 1
+
+
+def pytest_router_exhausted_retries_raise_no_replicas(graphs):
+    a = StubReplica("a", fail_with=[ReplicaUnavailableError("down")] * 10)
+    r = FleetRouter({"a": a}, cfg=_cfg(router_retries=2,
+                                       breaker_failures=50))
+    with pytest.raises(NoReplicasError) as ei:
+        r.predict(graphs[0])
+    assert len(ei.value.attempts) == 3  # initial + 2 retries
+    assert all("replica_unavailable" in att for att in ei.value.attempts)
+
+
+def pytest_router_hedges_slow_replica(graphs):
+    a = StubReplica("a", delay_s=0.5, depth=0.0)
+    b = StubReplica("b", depth=1.0)
+    r = FleetRouter({"a": a, "b": b},
+                    cfg=_cfg(router_hedge_min_s=0.03,
+                             router_hedge_factor=1.0))
+    t0 = time.perf_counter()
+    out = r.predict(graphs[0], priority="interactive")
+    dt = time.perf_counter() - t0
+    assert set(out) == {"graph_s", "node_e"}
+    assert dt < 0.4  # the hedge answered; we did not wait out the 0.5s
+    st = r.stats()
+    assert st["hedges"] == 1 and st["hedge_wins"] == 1
+
+
+def pytest_router_batch_priority_is_shed_not_hedged(graphs):
+    slow = StubReplica("a", depth=30.0)
+    r = FleetRouter({"a": slow}, cfg=_cfg(slo_p99_s=0.01))
+    # seed the latency EMA so projected wait = depth * ema blows the SLO
+    r._lat_ema["a"] = 0.1
+    with pytest.raises(SheddedError):
+        r.predict(graphs[0], priority="batch")
+    assert slow.calls == 0  # shed at the router, never dispatched
+    assert r.stats()["router_shed"] == 1
+    # interactive traffic still goes through
+    out = r.predict(graphs[0], priority="interactive")
+    assert set(out) == {"graph_s", "node_e"}
+
+
+def pytest_router_rejects_unknown_priority(graphs):
+    r = FleetRouter({"a": StubReplica("a")}, cfg=_cfg())
+    with pytest.raises(ValueError):
+        r.predict(graphs[0], priority="best_effort")
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+
+def pytest_breaker_open_halfopen_close_lifecycle():
+    clock = [0.0]
+    br = CircuitBreaker("a", failures=3, cooldown_s=5.0,
+                        now_fn=lambda: clock[0])
+    for _ in range(2):
+        br.record_failure("replica_unavailable")
+    assert br.state == "closed" and br.allow()
+    br.record_failure("replica_unavailable")
+    assert br.state == "open" and not br.allow()
+    clock[0] = 4.9
+    assert not br.allow()
+    clock[0] = 5.1
+    assert br.allow()  # the single half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()  # second concurrent probe is refused
+    br.record_success()
+    assert br.state == "closed" and br.closes == 1
+    assert br.allow()
+
+
+def pytest_breaker_failed_probe_reopens():
+    clock = [0.0]
+    br = CircuitBreaker("a", failures=1, cooldown_s=2.0,
+                        now_fn=lambda: clock[0])
+    br.record_failure("wedged_step")
+    assert br.state == "open"
+    clock[0] = 2.5
+    assert br.allow()
+    br.record_failure("wedged_step")
+    assert br.state == "open" and br.opens == 2
+    clock[0] = 3.0
+    assert not br.allow()  # fresh cooldown from the failed probe
+
+
+def pytest_router_breaker_opens_and_recloses(graphs):
+    a = StubReplica("a", fail_with=[ReplicaUnavailableError("down")] * 2,
+                    depth=0.0)
+    b = StubReplica("b", depth=1.0)
+    r = FleetRouter({"a": a, "b": b},
+                    cfg=_cfg(breaker_failures=2, breaker_cooldown_s=0.05,
+                             router_retries=2))
+    r.predict(graphs[0])  # a fails, retry lands on b
+    r.predict(graphs[0])  # a fails again -> breaker opens, b serves
+    assert r.breaker("a").state == "open"
+    calls_b = b.calls
+    r.predict(graphs[0])  # hard-open: a is not even a candidate
+    assert a.calls == 2 and b.calls == calls_b + 1
+    time.sleep(0.06)
+    r.predict(graphs[0])  # half-open probe succeeds (failures exhausted)
+    assert r.breaker("a").state in ("closed", "half_open")
+    # drive to certainty: a serves again
+    r.predict(graphs[0])
+    assert r.breaker("a").state == "closed"
+
+
+def pytest_router_all_breakers_open_raises_typed(graphs):
+    a = StubReplica("a", fail_with=[ReplicaUnavailableError("down")] * 10)
+    r = FleetRouter({"a": a},
+                    cfg=_cfg(breaker_failures=1, breaker_cooldown_s=60.0,
+                             router_retries=1))
+    with pytest.raises((NoReplicasError, ReplicaUnavailableError,
+                        BreakerOpenError)):
+        r.predict(graphs[0])
+    with pytest.raises(BreakerOpenError):
+        r.predict(graphs[0])  # breaker now hard-open, no candidates at all
+
+
+def pytest_router_set_clients_preserves_breaker_state(graphs):
+    a = StubReplica("a", fail_with=[ReplicaUnavailableError("down")] * 10)
+    r = FleetRouter({"a": a}, cfg=_cfg(breaker_failures=1,
+                                       breaker_cooldown_s=60.0,
+                                       router_retries=0))
+    with pytest.raises((NoReplicasError, ReplicaUnavailableError)):
+        r.predict(graphs[0])
+    assert r.breaker("a").state == "open"
+    # the manager restarts replica "a": same name, fresh client — the
+    # breaker (and its cooldown) survives, so the restart is half-trusted
+    r.set_clients({"a": StubReplica("a")})
+    assert r.breaker("a").state == "open"
+    assert r.replicas() == ["a"]
+
+
+# ---------------------------------------------------------------------------
+# prediction cache
+# ---------------------------------------------------------------------------
+
+
+def pytest_cache_hit_is_bit_identical(tmp_path, graphs):
+    cache = PredictionCache(str(tmp_path / "pc"))
+    result = _result(seed=3)
+    assert cache.get(graphs[0]) is None
+    cache.put(graphs[0], result)
+    hit = cache.get(graphs[0])
+    assert hit is not None
+    assert set(hit) == set(result)
+    for k in result:
+        assert hit[k].dtype == result[k].dtype
+        assert hit[k].shape == result[k].shape
+        # bit identity, not closeness
+        assert hit[k].tobytes() == result[k].tobytes()
+    st = cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1 and st["stores"] == 1
+
+
+def pytest_cache_key_tracks_graph_content(graphs):
+    k0, k1 = graph_key(graphs[0]), graph_key(graphs[1])
+    assert k0 != k1
+    assert k0 == graph_key(graphs[0])  # deterministic
+    import dataclasses
+
+    bumped = dataclasses.replace(graphs[0], x=graphs[0].x + 1.0)
+    assert graph_key(bumped) != k0
+
+
+def pytest_cache_corrupt_entry_is_a_miss(tmp_path, graphs):
+    cache = PredictionCache(str(tmp_path / "pc"))
+    cache.put(graphs[0], _result())
+    key = graph_key(graphs[0])
+    path = cache._path(key)
+    with open(path, "r+b") as fh:  # tear the zip container
+        fh.seek(0)
+        fh.write(b"\xff\xff\xff\xff")
+    assert cache.get(graphs[0]) is None  # unreadable -> miss, not a raise
+    assert cache.stats()["misses"] >= 1
+
+    # a VALID npz whose stored digest disagrees with its arrays (the
+    # corruption the zip CRC cannot catch) is dropped and evicted
+    cache.put(graphs[1], _result(seed=1))
+    path2 = cache._path(graph_key(graphs[1]))
+    np.savez(path2.replace(".npz", ""),
+             graph_s=np.zeros((1, 1), np.float32),
+             __digest__=np.asarray("0" * 64))
+    assert cache.get(graphs[1]) is None
+    assert not os.path.exists(path2)  # digest-mismatch entries are evicted
+    assert cache.stats()["corrupt"] >= 1
+
+
+def pytest_cache_write_is_atomic(tmp_path, graphs):
+    cache = PredictionCache(str(tmp_path / "pc"))
+    cache.put(graphs[0], _result())
+    shard_root = str(tmp_path / "pc")
+    leftovers = [
+        f for _, _, files in os.walk(shard_root) for f in files
+        if ".tmp." in f
+    ]
+    assert leftovers == []  # tmp+rename leaves no partials behind
+
+
+def pytest_router_cache_hits_skip_the_fleet(graphs):
+    a = StubReplica("a")
+
+    class MemCache(PredictionCache):
+        pass
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        r = FleetRouter({"a": a}, cfg=_cfg(), cache=MemCache(d))
+        out1 = r.predict(graphs[0])
+        out2 = r.predict(graphs[0])
+        assert a.calls == 1  # second answer came from the cache
+        for k in out1:
+            assert out1[k].tobytes() == out2[k].tobytes()
+        st = r.stats()
+        assert st["cache_hits"] == 1 and st["cache_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# wire codec
+# ---------------------------------------------------------------------------
+
+
+def pytest_wire_graph_round_trip_exact(graphs):
+    g = graphs[0]
+    back = wire.decode_graph(wire.loads(wire.dumps(wire.encode_graph(g))))
+    for name in ("x", "pos", "senders", "receivers", "z"):
+        a, b = np.asarray(getattr(g, name)), np.asarray(getattr(back, name))
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert a.tobytes() == b.tobytes()
+    assert graph_key(back) == graph_key(g)
+
+
+def pytest_wire_prediction_round_trip_exact():
+    pred = {"graph_s": np.arange(6, dtype=np.float64).reshape(2, 3) / 7.0,
+            "node_e": np.float32([[1e-20], [3.0]])}
+    back = wire.decode_prediction(
+        wire.loads(wire.dumps(wire.encode_prediction(pred)))
+    )
+    for k, a in pred.items():
+        assert back[k].dtype == a.dtype
+        assert back[k].tobytes() == a.tobytes()
+
+
+def pytest_wire_malformed_and_truncated_reject():
+    with pytest.raises(InvalidRequestError):
+        wire.loads(b"not json")
+    with pytest.raises(InvalidRequestError):
+        wire.decode_graph({"v": 1})  # missing required fields
+    arr = wire.encode_array(np.arange(8, dtype=np.float32))
+    arr["b64"] = arr["b64"][: len(arr["b64"]) // 2]
+    with pytest.raises(InvalidRequestError):
+        wire.decode_array(arr)
+
+
+def pytest_wire_error_round_trip_typed():
+    err = wire.decode_error(wire.encode_error(
+        ReplicaUnavailableError("conn refused")
+    ))
+    assert isinstance(err, ReplicaUnavailableError)
+    assert "conn refused" in str(err)
+    unknown = wire.decode_error(
+        {"v": 1, "error": {"code": "code_from_the_future", "message": "x"}}
+    )
+    assert isinstance(unknown, ServeError)
+
+
+# ---------------------------------------------------------------------------
+# error-code table / config / fault specs
+# ---------------------------------------------------------------------------
+
+
+def pytest_error_code_table_is_stable():
+    # append-only contract: these codes are on the wire — renaming or
+    # removing any of them breaks deployed routers
+    for code in ("serve_error", "request_error", "invalid_request",
+                 "queue_full", "shed", "deadline_exceeded", "draining",
+                 "closed", "wedged_step", "replica_unavailable",
+                 "breaker_open", "no_replicas"):
+        assert code in ERROR_CODES, code
+        assert ERROR_CODES[code].code == code
+    assert "shed" not in RETRYABLE_CODES  # backpressure is not a fault
+    assert "invalid_request" not in RETRYABLE_CODES
+    assert "replica_unavailable" in RETRYABLE_CODES
+    e = error_from_code("queue_full", "full")
+    assert type(e).__name__ == "QueueFullError"
+
+
+@pytest.mark.parametrize("bad", [
+    {"fleet_ready_floor": 1.5},
+    {"reload_error_spike": -0.1},
+    {"router_hedge_factor": 0.5},
+    {"router_retries": -1},
+    {"fleet_restart_backoff_s": -1.0},
+    {"prediction_cache": ""},
+    {"prediction_cache": 3},
+])
+def pytest_serve_config_rejects_bad_fleet_keys(bad):
+    with pytest.raises((ValueError, TypeError)):
+        ServeConfig(**bad)
+
+
+def pytest_serve_config_fleet_defaults_validate():
+    cfg = ServeConfig(fleet_replicas=4, prediction_cache=True,
+                      router_hedge_factor=2.0)
+    assert cfg.fleet_replicas == 4 and cfg.prediction_cache is True
+
+
+def pytest_replica_fault_specs_scope_by_replica(monkeypatch):
+    # one env on the whole fleet arms exactly one replica
+    monkeypatch.setenv("HYDRAGNN_FAULT_REPLICA_SLOW", "2:0.001")
+    faultinject.configure()
+    t0 = time.perf_counter()
+    faultinject.maybe_replica_slow(1)  # not replica 2: no-op
+    assert time.perf_counter() - t0 < 0.05
+    faultinject.maybe_replica_slow(2)  # armed replica sleeps
+    monkeypatch.setenv("HYDRAGNN_FAULT_REPLICA_WEDGE", "1:0:0.001")
+    faultinject.configure()
+    faultinject.maybe_replica_wedge(2, 0)  # other replica: no-op
+    t0 = time.perf_counter()
+    faultinject.maybe_replica_wedge(1, 0)  # replica 1, request 0 wedges
+    assert time.perf_counter() - t0 >= 0.0005
+    # KILL spec parsing only (actually dying would kill pytest)
+    monkeypatch.setenv("HYDRAGNN_FAULT_REPLICA_KILL", "3:5")
+    faultinject.configure()
+    faultinject.maybe_replica_kill(1, 5)  # not replica 3: survives
+    faultinject.maybe_replica_kill(3, 4)  # request 4 != 5: survives
+
+
+# ---------------------------------------------------------------------------
+# doctor: fleet-aggregated saturation rules
+# ---------------------------------------------------------------------------
+
+
+def _fleet_record(**kw):
+    rec = {
+        "v": 1, "ts": 1.0, "kind": "fleet_serve", "host": 0,
+        "replicas": 3, "ready": 3, "benched": 0,
+        "queue_depth_mean": 0.0, "queue_depth_max": 0.0,
+        "shed_total": 0.0, "queue_full_total": 0.0,
+        "completed_total": 10.0,
+        "per_replica": {"1": {"queue_depth": 0.0, "shed": 0.0,
+                              "queue_full": 0.0, "ready": 1.0}},
+    }
+    rec.update(kw)
+    return rec
+
+
+def pytest_doctor_fleet_shed_spiral_is_one_finding():
+    from hydragnn_tpu.obs import doctor as doc
+
+    shed_ev = {"ts": 1.0, "kind": "serve_shed", "severity": "warn"}
+    s = doc.RunStreams(
+        target="t", source="run_dir",
+        metrics=[_fleet_record(shed_total=40.0, per_replica={
+            "1": {"shed": 38.0}, "2": {"shed": 2.0}})],
+        events=[dict(shed_ev) for _ in range(12)],
+    )
+    finds = doc.r_shed_spiral(s, doc.DoctorConfig())
+    assert len(finds) == 1  # fleet-aggregated: one finding, not per host
+    assert finds[0].kind == doc.F_SHED_SPIRAL
+    assert finds[0].data["per_replica"]["replica1"] == 38.0
+    # below threshold: the fleet record gates the event fallback out
+    quiet = doc.RunStreams(
+        target="t", source="run_dir",
+        metrics=[_fleet_record(shed_total=1.0)],
+        events=[dict(shed_ev) for _ in range(12)],
+    )
+    assert doc.r_shed_spiral(quiet, doc.DoctorConfig()) == []
+
+
+def pytest_doctor_fleet_queue_saturation_uses_aggregate():
+    from hydragnn_tpu.obs import doctor as doc
+
+    s = doc.RunStreams(
+        target="t", source="run_dir",
+        metrics=[_fleet_record(queue_full_total=9.0, queue_depth_mean=7.5,
+                               queue_depth_max=16.0)],
+    )
+    finds = doc.r_queue_saturation(s, doc.DoctorConfig())
+    assert len(finds) == 1
+    assert finds[0].kind == doc.F_QUEUE_SATURATION
+    assert finds[0].data["queue_full"] == 9
+
+
+def pytest_doctor_replica_flap_and_rollback_rules():
+    from hydragnn_tpu.obs import doctor as doc
+
+    s = doc.RunStreams(
+        target="t", source="run_dir",
+        events=[
+            {"ts": 1.0, "kind": "replica_exit", "severity": "warn",
+             "replica": 2},
+            {"ts": 2.0, "kind": "replica_benched", "severity": "error",
+             "replica": 2, "deaths_in_window": 5},
+        ],
+    )
+    finds = doc.r_replica_flap(s, doc.DoctorConfig())
+    assert len(finds) == 1 and finds[0].severity == "error"
+    assert finds[0].data["benched"] == [2]
+
+    s2 = doc.RunStreams(
+        target="t", source="run_dir",
+        events=[{"ts": 3.0, "kind": "reload_rollback", "severity": "error",
+                 "replica": 1, "error_rate": 0.75,
+                 "rolled_back_to": "ckpt-a", "regressed": "ckpt-b"}],
+    )
+    finds2 = doc.r_reload_rollback(s2, doc.DoctorConfig())
+    assert len(finds2) == 1 and finds2[0].kind == doc.F_RELOAD_ROLLBACK
+
+    s3 = doc.RunStreams(
+        target="t", source="run_dir",
+        events=[{"ts": 1.0, "kind": "breaker_open", "severity": "warn",
+                 "replica": "a"}],
+    )
+    finds3 = doc.r_breaker_open(s3, doc.DoctorConfig())
+    assert len(finds3) == 1 and finds3[0].data["still_open"] is True
+
+
+def pytest_fleet_serve_schema_validates():
+    from hydragnn_tpu.obs.schema import validate_metrics_record
+
+    assert validate_metrics_record(_fleet_record()) == []
+    bad = _fleet_record()
+    bad.pop("per_replica")
+    assert validate_metrics_record(bad)
