@@ -344,6 +344,25 @@ class FleetCollector:
         self._c_pushes.inc(host=str(host))
         return {"ok": True, "v": FLEET_SCHEMA_VERSION, "commands": commands}
 
+    def forget(self, host: int) -> None:
+        """Drop one host's state entirely — the serving-fleet supervisor
+        calls this when it respawns a replica, so the dead incarnation's
+        heartbeat entry (which would go stale within seconds) can never be
+        mistaken for the new process. Staleness on the slot resumes only
+        after the new incarnation's first push recreates the entry. The
+        host's per-host gauges are retired with it; its contributions to
+        the min/mean/max aggregates leave at the next publish."""
+        host = int(host)
+        with self._lock:
+            if self._hosts.pop(host, None) is None:
+                return
+            label = str(host)
+            self._g_step.remove(host=label)
+            self._g_lag.remove(host=label)
+            self._g_step_time.remove(host=label)
+            self._g_stale.remove(host=label)
+            self._publish_locked()
+
     def sweep(self, now: Optional[float] = None) -> None:
         """Staleness pass without a push (tests; a timer would also fit
         here — in production every push sweeps, and a fleet with zero

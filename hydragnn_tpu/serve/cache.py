@@ -5,8 +5,15 @@ repo's proven on-disk memoization scheme, applied to inference results:
 
 - the key is a sha256 over the graph's *input content* — every inference
   input array's name, dtype, shape, and raw bytes, plus ``dataset_id`` —
-  so two bit-identical graphs share an entry and any single-bit input
-  difference misses;
+  mixed with the cache ``context``: everything BESIDES the graph that
+  determines a prediction (the installed checkpoint's digest and the
+  prediction-affecting serve config, e.g. ``weights_dtype``). Two
+  bit-identical graphs share an entry, any single-bit input difference
+  misses, and a hot-reloaded checkpoint changes the context so entries
+  computed by the old weights can never be served as hits for the new
+  ones. A context of ``None`` disables the cache entirely (``key_for``
+  returns None) — the fleet manager parks it there while replicas
+  disagree mid-rollout;
 - entries are ``.npz`` files sharded by the first two hex digits
   (``cache_dir/ab/abcdef....npz``) to keep directory fan-out flat;
 - stores are atomic: write to ``<path>.tmp.<pid>`` then ``os.replace`` —
@@ -83,23 +90,61 @@ class PredictionCache:
     stores atomically and never raises on I/O failure — the cache is an
     accelerator, not a dependency. ``stats()`` exposes hit/miss/store/
     corrupt counters for the fleet gauges and bench cells.
+
+    ``context`` namespaces every key with the non-graph prediction inputs
+    (checkpoint digest + serve config). The default ``""`` keys on graph
+    content alone (standalone/bench use where the weights never change);
+    ``None`` disables the cache until ``set_context`` supplies an
+    identity — the fleet manager's mid-rollout state, where replicas
+    serve different checkpoints and no shared entry is safe.
     """
 
-    def __init__(self, cache_dir: str):
+    def __init__(self, cache_dir: str, context: Optional[str] = ""):
         self.cache_dir = cache_dir
         os.makedirs(cache_dir, exist_ok=True)
         self._lock = threading.Lock()
+        self._context = context
         self.hits = 0
         self.misses = 0
         self.stores = 0
         self.corrupt = 0
+
+    @property
+    def context(self) -> Optional[str]:
+        with self._lock:
+            return self._context
+
+    def set_context(self, context: Optional[str]) -> None:
+        """Swap the non-graph key component (checkpoint digest + config).
+        Existing entries stay on disk under their old context — they are
+        simply unreachable until the same context returns (a rollback
+        re-hits them), so no eviction pass is needed for correctness."""
+        with self._lock:
+            self._context = context
+
+    def key_for(self, graph: Graph, base: Optional[str] = None
+                ) -> Optional[str]:
+        """The effective cache key for ``graph`` under the current
+        context, or ``None`` while the cache is disabled (context None).
+        ``base`` short-circuits the graph hash when the caller already
+        computed ``graph_key(graph)``."""
+        with self._lock:
+            ctx = self._context
+        if ctx is None:
+            return None
+        base = base if base is not None else graph_key(graph)
+        if not ctx:
+            return base
+        return hashlib.sha256(f"{base}|ctx={ctx}".encode()).hexdigest()
 
     def _path(self, key: str) -> str:
         return os.path.join(self.cache_dir, key[:2], key + ".npz")
 
     def get(self, graph: Graph, key: Optional[str] = None
             ) -> Optional[Dict[str, np.ndarray]]:
-        key = key or graph_key(graph)
+        key = key if key is not None else self.key_for(graph)
+        if key is None:
+            return None
         path = self._path(key)
         try:
             with np.load(path, allow_pickle=False) as z:
@@ -127,7 +172,9 @@ class PredictionCache:
 
     def put(self, graph: Graph, result: Dict[str, np.ndarray],
             key: Optional[str] = None) -> Optional[str]:
-        key = key or graph_key(graph)
+        key = key if key is not None else self.key_for(graph)
+        if key is None:
+            return None
         path = self._path(key)
         arrays = {n: np.asarray(v) for n, v in result.items()}
         payload = dict(arrays)

@@ -58,15 +58,19 @@ from typing import Any, Dict, List, Optional
 from ..data.graph import Graph
 from .cache import PredictionCache
 from .config import ServeConfig
-from .errors import ServeError
 from .router import FleetRouter, HTTPReplicaClient
 
 _SUPERVISE_TICK_S = 0.2
 _METRICS_PERIOD_S = 1.0
 _SPAWN_READY_TIMEOUT_S = 600.0
-# a replica gets this long after (re)start before heartbeat staleness can
-# judge it wedged — warm-up legitimately pushes nothing for a while
+# floor on how soon after (re)start wedge detection may judge a replica.
+# The real gate is per-incarnation: _spawn() forgets the collector's host
+# entry, so staleness can only be measured against heartbeats the NEW
+# process pushed (warm-up may legitimately push nothing for minutes).
 _WEDGE_GRACE_S = 10.0
+# how often the manager re-derives the prediction-cache context (installed
+# checkpoint digest x serve config) from replica /stats
+_CACHE_CTX_REFRESH_S = 5.0
 # replicas heartbeat ~1/s, so a 5 s silence is a wedge, not jitter (the
 # collector's adaptive threshold still stretches this for slow pushers)
 _STALE_AFTER_S = 5.0
@@ -170,6 +174,8 @@ class ReplicaManager:
         self._metrics_fh = None
         self._last_metrics = 0.0
         self._supervisor: Optional[threading.Thread] = None
+        self._ctx_thread: Optional[threading.Thread] = None
+        self._reloading = False
         self._closed = False
 
         # collector + push endpoint: the manager is fleet host 0
@@ -228,6 +234,14 @@ class ReplicaManager:
             os.remove(rv)
         except OSError:
             pass
+        # same for the heartbeat state: the dead incarnation's collector
+        # entry goes stale within seconds, and the new process does not
+        # push until its warm-up completes (up to _SPAWN_READY_TIMEOUT_S)
+        # — judged against the old entry, every restart would be SIGKILLed
+        # as "wedged" ~10s in and flap-benched after one real crash.
+        # Forgetting the entry means staleness is only ever measured
+        # against heartbeats this incarnation actually sent.
+        self.collector.forget(rep.index)
         if rep.log_fh is None:
             rep.log_fh = open(
                 os.path.join(self.run_dir, f"replica_{rep.index}.log"), "ab"
@@ -305,7 +319,12 @@ class ReplicaManager:
     def router(self) -> FleetRouter:
         """The fleet's front door (one per manager; cached). Wires the
         collector's per-replica queue-depth gauges in as the balancing
-        signal and the prediction cache when configured."""
+        signal and the prediction cache when configured. The cache starts
+        DISABLED (context None) and only serves once every reachable
+        replica agrees on its installed checkpoint — the context (that
+        checkpoint's digest x ``weights_dtype``) namespaces every key, so
+        a rolling reload can never surface a prior checkpoint's cached
+        prediction as a hit."""
         if self._router is None:
             cache = None
             pc = self.cfg.prediction_cache
@@ -314,12 +333,67 @@ class ReplicaManager:
                     pc if isinstance(pc, str)
                     else os.path.join(self.run_dir, "pred_cache")
                 )
-                self._cache = cache = PredictionCache(cache_dir)
+                self._cache = cache = PredictionCache(cache_dir, context=None)
+                self._refresh_cache_context()
+                self._ctx_thread = threading.Thread(
+                    target=self._cache_ctx_loop, daemon=True,
+                    name="fleet-cache-ctx",
+                )
+                self._ctx_thread.start()
             self._router = FleetRouter(
                 self.clients(), cfg=self.cfg, cache=cache,
                 depth_fn=self._depth_of,
             )
         return self._router
+
+    def _cache_context(self) -> Optional[str]:
+        """The non-graph component of a prediction-cache key, or ``None``
+        (cache disabled) while it cannot be pinned down: the sha256 of the
+        checkpoint every reachable replica currently serves (its sidecar
+        digest when present, the entry name otherwise) plus the
+        prediction-affecting serve config. Replicas disagreeing — a
+        rollout in flight, or a restart that restored a newer pointer —
+        means NO shared entry is safe, so the cache sits out."""
+        with self._lock:
+            reps = [
+                r for r in self._replicas.values()
+                if not r.benched and r.port is not None
+            ]
+        entries = set()
+        for rep in reps:
+            try:
+                entries.add(str(self._replica_stat(rep, "current_checkpoint")))
+            except Exception:  # noqa: BLE001 — unreachable: just excluded
+                continue
+        if len(entries) != 1:
+            return None
+        entry = entries.pop()
+        ident = entry
+        try:
+            # the checkpoint plane writes a sha256 sidecar next to every
+            # entry (train/checkpoint.py) — key on content, not filename
+            with open(os.path.join(self.run_dir, entry + ".sha256")) as f:
+                ident = f"{entry}:{f.read().strip()}"
+        except OSError:
+            pass
+        return f"ckpt={ident};weights_dtype={self.cfg.weights_dtype}"
+
+    def _refresh_cache_context(self) -> None:
+        if self._cache is None or self._reloading:
+            return
+        ctx = self._cache_context()
+        if not self._reloading:
+            self._cache.set_context(ctx)
+
+    def _cache_ctx_loop(self) -> None:
+        # off the supervisor thread: deriving the context blocks on
+        # replica /stats HTTP calls, and restarts/wedge checks must not
+        # wait behind a dead replica's connect timeout
+        while not self._stop.wait(_CACHE_CTX_REFRESH_S):
+            try:
+                self._refresh_cache_context()
+            except Exception:  # noqa: BLE001 — cache is an accelerator
+                pass
 
     def _depth_of(self, name: str) -> Optional[float]:
         try:
@@ -438,7 +512,10 @@ class ReplicaManager:
         """A live process whose heartbeat went stale is wedged (device
         hang, GIL-holding bug): SIGKILL it into the normal death path —
         the restart gets a fresh runner, and repeated wedges hit the flap
-        breaker like any other crash loop."""
+        breaker like any other crash loop. Staleness is judged strictly
+        per incarnation: ``_spawn`` forgets the collector's host entry,
+        so until THIS process heartbeats there is no entry to go stale
+        and a slow warm-up can never be mistaken for a wedge."""
         if now - rep.started_at < _WEDGE_GRACE_S:
             return
         # the collector only sweeps staleness inside absorb(); with every
@@ -564,8 +641,14 @@ class ReplicaManager:
                        timeout_s: float = 120.0) -> Dict[str, Any]:
         """Stagger checkpoint reloads across the fleet, one replica at a
         time, capacity-floor gated, with first-replica regression probing
-        and automatic rollback. Returns a status dict
-        (``{"status": "done"|"rolled_back"|"aborted", ...}``)."""
+        and automatic rollback. Always returns a status dict
+        (``{"status": "done"|"rolled_back"|"aborted", ...}``) — a replica
+        that becomes unreachable mid-roll is skipped with a warning, never
+        surfaced as a raw transport error, and a rollback whose POST fails
+        reports ``rollback_ok: False`` + ``rollback_error``. While the
+        rollout is in flight the prediction cache is disabled (mixed-fleet
+        window); it re-enables under the new checkpoint's context once the
+        fleet agrees again."""
         if not probe_graphs:
             raise ValueError(
                 "rolling_reload needs probe graphs to verify the first "
@@ -581,6 +664,24 @@ class ReplicaManager:
                 r for r in self._replicas.values()
                 if not r.benched and r.port is not None
             ]
+        # mid-rollout the fleet serves two checkpoints at once: no shared
+        # cache entry is safe, so the cache sits out until the rollout
+        # settles and the context is re-derived from the fleet's agreement
+        self._reloading = True
+        if self._cache is not None:
+            self._cache.set_context(None)
+        try:
+            return self._rolling_reload(
+                reps, probe_graphs, floor, deadline, installed,
+                first_probed, min_ready_seen,
+            )
+        finally:
+            self._reloading = False
+            self._refresh_cache_context()
+
+    def _rolling_reload(self, reps, probe_graphs, floor, deadline,
+                        installed, first_probed, min_ready_seen
+                        ) -> Dict[str, Any]:
         for rep in reps:
             # capacity gate: proceed only while the REST of the fleet
             # keeps aggregate ready capacity at/above the floor (the
@@ -601,10 +702,14 @@ class ReplicaManager:
                         "min_ready_seen": min_ready_seen,
                     }
                 time.sleep(0.2)
-            prior = self._replica_stat(rep, "current_checkpoint")
             try:
+                prior = self._replica_stat(rep, "current_checkpoint")
                 out = self._post_reload(rep, {"poll": True})
             except Exception as e:  # noqa: BLE001 — replica died mid-roll
+                # an unreachable replica is the supervisor's problem (it
+                # restarts on the LATEST pointer anyway); the rollout
+                # skips it instead of leaking a transport error to the
+                # caller in place of the documented status dict
                 warnings.warn(
                     f"rolling reload: replica {rep.index} unreachable "
                     f"({type(e).__name__}: {e}); skipping",
@@ -624,12 +729,28 @@ class ReplicaManager:
                 if verdict["error_rate"] >= float(
                     self.cfg.reload_error_spike
                 ):
-                    self._post_reload(rep, {"entry": prior})
+                    rollback_error = None
+                    try:
+                        self._post_reload(rep, {"entry": prior})
+                    except Exception as e:  # noqa: BLE001 — died mid-roll
+                        # the regressed checkpoint may still be installed
+                        # on this replica: report it, never swallow it —
+                        # the caller (and the doctor) must know the
+                        # rollback did not land
+                        rollback_error = f"{type(e).__name__}: {e}"
+                        warnings.warn(
+                            f"rolling reload: rollback POST to replica "
+                            f"{rep.index} failed ({rollback_error}); the "
+                            f"regressed checkpoint may still be serving "
+                            f"there until the supervisor restarts it",
+                            RuntimeWarning, stacklevel=2,
+                        )
                     _emit_event(
                         "reload_rollback", replica=rep.index,
                         rolled_back_to=prior, regressed=entry,
                         error_rate=verdict["error_rate"],
                         probes=verdict["probes"],
+                        rollback_error=rollback_error,
                     )
                     return {
                         "status": "rolled_back",
@@ -639,6 +760,8 @@ class ReplicaManager:
                         "error_rate": verdict["error_rate"],
                         "installed": installed,
                         "min_ready_seen": min_ready_seen,
+                        "rollback_ok": rollback_error is None,
+                        "rollback_error": rollback_error,
                     }
         return {
             "status": "done",
@@ -650,7 +773,17 @@ class ReplicaManager:
     def _wait_checkpoint_change(self, rep: _Replica, prior: Any,
                                 deadline: float) -> Any:
         while time.monotonic() < deadline:
-            cur = self._replica_stat(rep, "current_checkpoint")
+            try:
+                cur = self._replica_stat(rep, "current_checkpoint")
+            except Exception as e:  # noqa: BLE001 — replica died mid-swap
+                # do not stall the whole rollout polling a dead replica:
+                # the supervisor restarts it on the latest pointer anyway
+                warnings.warn(
+                    f"rolling reload: replica {rep.index} unreachable "
+                    f"while awaiting its swap ({type(e).__name__}: {e})",
+                    RuntimeWarning, stacklevel=2,
+                )
+                return prior
             if cur != prior:
                 return cur
             time.sleep(0.1)
@@ -667,7 +800,9 @@ class ReplicaManager:
             g = probe_graphs[k % len(probe_graphs)]
             try:
                 client.predict(g, timeout_s=30.0)
-            except ServeError:
+            except Exception:  # noqa: BLE001 — any failure counts against
+                # the canary (typed serve errors AND transport loss: a
+                # replica that died under probing is a regression signal)
                 errors += 1
         return {"probes": probes, "errors": errors,
                 "error_rate": errors / probes}
@@ -700,6 +835,8 @@ class ReplicaManager:
         self._stop.set()
         if self._supervisor is not None:
             self._supervisor.join(timeout=5.0)
+        if self._ctx_thread is not None:
+            self._ctx_thread.join(timeout=5.0)
         if self._router is not None:
             self._router.close()
         with self._lock:

@@ -30,7 +30,10 @@ single-replica failure mode:
   traffic yields capacity to interactive traffic first;
 - **prediction cache** — an optional content-addressed
   ``PredictionCache``; hits skip the fleet entirely and are bit-identical
-  to misses by construction.
+  to misses by construction. Keys mix the graph content with the cache's
+  *context* (installed checkpoint digest + prediction-affecting serve
+  config, maintained by the ReplicaManager), so a hot-reloaded fleet can
+  never serve a prior checkpoint's cached prediction as a hit.
 """
 
 from __future__ import annotations
@@ -172,8 +175,16 @@ class HTTPReplicaClient(ReplicaClient):
                 timeout_s: Optional[float] = None) -> Dict[str, np.ndarray]:
         from . import wire
 
-        body = self._post("/predict", wire.dumps(wire.encode_graph(graph)),
-                          timeout_s)
+        payload = wire.encode_graph(graph)
+        if timeout_s:
+            # server-side deadline: urllib's timeout is socket-inactivity
+            # only, and an abandoned request (router timeout, retry, lost
+            # hedge) would otherwise run handle.result(timeout=None) and
+            # park a replica HTTP thread forever. With deadline_s on the
+            # wire the replica bounds the request itself and frees the
+            # handler for work someone still wants.
+            payload["deadline_s"] = float(timeout_s)
+        body = self._post("/predict", wire.dumps(payload), timeout_s)
         obj = wire.loads(body)
         if wire.is_error(obj):
             raise wire.decode_error(obj)
@@ -529,14 +540,20 @@ class FleetRouter:
             timeout_s if timeout_s is not None else self.cfg.router_timeout_s
         )
         key = None
+        gk = None
         if self.cache is not None:
-            key = graph_key(graph)
-            hit = self.cache.get(graph, key=key)
-            if hit is not None:
-                self._bump("cache_hits")
-                self._bump("succeeded")
-                return hit
-            self._bump("cache_misses")
+            # key = graph content x cache context (checkpoint digest +
+            # serve config); key_for returns None while the context is
+            # unknown/mixed (mid-rollout) and the cache sits out entirely
+            gk = graph_key(graph)
+            key = self.cache.key_for(graph, base=gk)
+            if key is not None:
+                hit = self.cache.get(graph, key=key)
+                if hit is not None:
+                    self._bump("cache_hits")
+                    self._bump("succeeded")
+                    return hit
+                self._bump("cache_misses")
 
         deadline = time.monotonic() + timeout_s
         tried: set = set()
@@ -574,7 +591,12 @@ class FleetRouter:
             )
             if status == "ok":
                 self._bump("succeeded")
-                if self.cache is not None:
+                if self.cache is not None and key is not None and (
+                    # the context may have moved while the request was in
+                    # flight (a reload finished): a prediction keyed under
+                    # the old checkpoint must not land under the new one
+                    self.cache.key_for(graph, base=gk) == key
+                ):
                     self.cache.put(graph, payload, key=key)
                 return payload
             last_err = payload

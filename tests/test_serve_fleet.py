@@ -360,6 +360,178 @@ def pytest_router_cache_hits_skip_the_fleet(graphs):
         assert st["cache_hits"] == 1 and st["cache_misses"] == 1
 
 
+def pytest_cache_context_namespaces_keys(tmp_path, graphs):
+    # the non-graph key component: a reloaded checkpoint must never serve
+    # the old checkpoint's cached prediction as a hit
+    cache = PredictionCache(str(tmp_path / "pc"), context="ckpt-a")
+    res = _result(seed=5)
+    cache.put(graphs[0], res)
+    assert cache.get(graphs[0]) is not None
+    cache.set_context("ckpt-b")
+    assert cache.get(graphs[0]) is None  # same graph, new weights: miss
+    cache.set_context("ckpt-a")
+    assert cache.get(graphs[0]) is not None  # rollback re-hits old entries
+    # context None disables the cache outright (mid-rollout mixed fleet)
+    cache.set_context(None)
+    assert cache.key_for(graphs[0]) is None
+    assert cache.get(graphs[0]) is None
+    assert cache.put(graphs[0], res) is None
+    # the default "" context keys on graph content alone (bench/standalone)
+    plain = PredictionCache(str(tmp_path / "pc2"))
+    assert plain.key_for(graphs[0]) == graph_key(graphs[0])
+
+
+def pytest_router_cache_sits_out_without_context(graphs):
+    import tempfile
+
+    a = StubReplica("a")
+    with tempfile.TemporaryDirectory() as d:
+        cache = PredictionCache(d, context=None)
+        r = FleetRouter({"a": a}, cfg=_cfg(), cache=cache)
+        r.predict(graphs[0])
+        r.predict(graphs[0])
+        assert a.calls == 2  # disabled cache: every request hits the fleet
+        assert r.stats()["cache_hits"] == 0
+        cache.set_context("ckpt-a")
+        r.predict(graphs[0])  # miss + store under the new context
+        r.predict(graphs[0])  # hit
+        assert a.calls == 3
+        assert r.stats()["cache_hits"] == 1
+
+
+class _FakeProc:
+    def __init__(self):
+        self.killed = 0
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        self.killed += 1
+
+
+def pytest_wedge_detection_waits_for_new_incarnation_heartbeat():
+    # regression (REVIEW): after a respawn, the dead incarnation's stale
+    # collector entry must not judge the new process — a replica whose
+    # warm-up outlives the grace window was SIGKILLed repeatedly and
+    # flap-benched after a single real crash
+    from hydragnn_tpu.obs.fleet import FleetCollector
+    from hydragnn_tpu.serve.fleet import ReplicaManager, _Replica
+
+    col = FleetCollector(stale_after_s=2.0)
+    now = time.monotonic()
+    # the OLD incarnation heartbeated long ago (entry is stale by now)
+    col.absorb({"host": 1, "samples": []}, now=now - 60.0)
+    m = ReplicaManager.__new__(ReplicaManager)
+    m.collector = col
+    rep = _Replica(1)
+    rep.proc = _FakeProc()
+    rep.started_at = now - 30.0  # well past the fixed grace window
+    # _spawn forgets the old entry: with no heartbeat from THIS process
+    # there is nothing to go stale, so warm-up is never "wedged"
+    col.forget(1)
+    assert 1 not in col.hosts()
+    ReplicaManager._check_wedged(m, rep, now)
+    assert rep.proc.killed == 0
+    # once the new incarnation heartbeats and THEN goes silent, the wedge
+    # path fires as designed
+    col.absorb({"host": 1, "samples": []}, now=now - 10.0)
+    ReplicaManager._check_wedged(m, rep, now)
+    assert rep.proc.killed == 1
+
+
+def _fake_manager(n, ready=None):
+    from hydragnn_tpu.serve.fleet import ReplicaManager, _Replica
+
+    m = ReplicaManager.__new__(ReplicaManager)
+    m.cfg = _cfg(fleet_ready_floor=0.0)
+    m.n = n
+    m._lock = threading.Lock()
+    m._cache = None
+    m._reloading = False
+    m._replicas = {}
+    for i in range(1, n + 1):
+        rep = _Replica(i)
+        rep.port = 10000 + i
+        m._replicas[i] = rep
+    m.ready_count = lambda: ready if ready is not None else n
+    return m
+
+
+def pytest_rolling_reload_skips_unreachable_replica(graphs):
+    # regression (REVIEW): a replica crashing between the rollout snapshot
+    # and its stat/reload calls must yield the documented skip, not a raw
+    # urllib/OSError out of rolling_reload
+    m = _fake_manager(2)
+    posted = []
+
+    def stat(rep, field):
+        if rep.index == 1:
+            raise OSError("connection refused")
+        return "ckpt-old"
+
+    m._replica_stat = stat
+    m._post_reload = lambda rep, body: (
+        posted.append((rep.index, dict(body))) or {"status": "installed"}
+    )
+    m._wait_checkpoint_change = lambda rep, prior, deadline: "ckpt-new"
+    m._probe_first = lambda rep, pg: {
+        "probes": 4, "errors": 0, "error_rate": 0.0,
+    }
+    with pytest.warns(RuntimeWarning, match="unreachable"):
+        res = m.rolling_reload(list(graphs[:2]), timeout_s=5.0)
+    assert res["status"] == "done"
+    assert res["installed"] == 1
+    assert [idx for idx, _ in posted] == [2]  # replica 1 skipped entirely
+
+
+def pytest_rolling_reload_reports_failed_rollback(graphs):
+    # regression (REVIEW): a rollback POST to a replica that died under
+    # probing must be reported in the status dict, not silently lost
+    m = _fake_manager(1)
+    m._replica_stat = lambda rep, field: "ckpt-old"
+
+    def post(rep, body):
+        if "entry" in body:
+            raise OSError("replica died")
+        return {"status": "installed"}
+
+    m._post_reload = post
+    m._wait_checkpoint_change = lambda rep, prior, deadline: "ckpt-new"
+    m._probe_first = lambda rep, pg: {
+        "probes": 4, "errors": 4, "error_rate": 1.0,
+    }
+    with pytest.warns(RuntimeWarning, match="rollback POST"):
+        res = m.rolling_reload(list(graphs[:1]), timeout_s=5.0)
+    assert res["status"] == "rolled_back"
+    assert res["rollback_ok"] is False
+    assert "OSError" in res["rollback_error"]
+    assert res["prior"] == "ckpt-old" and res["regressed"] == "ckpt-new"
+
+
+def pytest_http_client_sends_deadline_on_the_wire(graphs):
+    # regression (REVIEW): without deadline_s in the /predict body the
+    # replica runs handle.result(timeout=None) and parks an HTTP thread
+    # forever on requests the router already timed out or hedged away
+    from hydragnn_tpu.serve import HTTPReplicaClient
+
+    c = HTTPReplicaClient("http://127.0.0.1:9", name="a")
+    seen = {}
+
+    def fake_post(path, payload, timeout_s):
+        seen["obj"] = json.loads(payload.decode("utf-8"))
+        return wire.dumps(wire.encode_prediction(_result()))
+
+    c._post = fake_post
+    out = c.predict(graphs[0], timeout_s=2.5)
+    assert set(out) == {"graph_s", "node_e"}
+    assert seen["obj"]["deadline_s"] == 2.5
+    # the payload stays a valid wire graph with the deadline attached
+    wire.decode_graph(seen["obj"])
+    c.predict(graphs[0])  # no client timeout: server default applies
+    assert "deadline_s" not in seen["obj"]
+
+
 # ---------------------------------------------------------------------------
 # wire codec
 # ---------------------------------------------------------------------------
