@@ -1418,6 +1418,89 @@ def main_serve():
         "device_kind": _device_kind(),
         "ok": True,
     }))
+
+    # ---- int8 quantized cells (ISSUE 20 tentpole; docs/SERVING.md
+    # "Quantization"): weight-only and w8a8 serving on the same workload —
+    # closed-loop capacity, open-loop p50/p99 at half that capacity, HBM
+    # weight bytes vs the fp32 tree, and the accuracy gate's certified
+    # relative max error. The speed columns are recorded (int8 wins are a
+    # TPU memory-bandwidth/MXU effect; CPU emulation may show none), the
+    # error column is gated lower-is-better round-over-round.
+    import jax as _jax
+
+    f32_weight_bytes = sum(
+        int(a.size) * int(a.dtype.itemsize)
+        for a in _jax.tree_util.tree_leaves(state.params)
+    )
+    quant_max_err = float(os.getenv("BENCH_SERVE_QUANT_MAX_ERR", "0.1"))
+    int8_cells = {}
+    for mode in ("weight_only", "w8a8"):
+        server = GraphServer(
+            model, state, ladder,
+            ServeConfig(
+                micro_batch_graphs=int(os.getenv("BENCH_SERVE_BATCH", "8")),
+                batch_window_s=0.002, retrace_policy="error",
+                max_queue_requests=1024, weights_dtype="int8",
+                quantization={"mode": mode, "calibration_batches": 2,
+                              "max_error": quant_max_err},
+            ),
+            template_graphs=graphs,
+        ).start()
+        try:
+            assert server.wait_ready(600), (mode, server.failed)
+            t0 = time.perf_counter()
+            out = server.predict(
+                [graphs[j % len(graphs)] for j in range(n_cal)], timeout=120
+            )
+            assert all(isinstance(o, dict) for o in out), (mode, "failed")
+            int8_capacity = n_cal / (time.perf_counter() - t0)
+            cell = _serve_load_cell(
+                server, graphs, max(int8_capacity * 0.5, 1.0), duration
+            )
+            q_report = server.stats().get("quantization") or {}
+            int8_weight_bytes = server._state.weight_nbytes()
+        finally:
+            server.close(drain=False)
+        cell.update(
+            variant=f"int8_{mode}",
+            metric="serve int8 quantized cell (Serving.weights_dtype: "
+                   "int8, accuracy-gated)",
+            unit="graphs/sec",
+            value=cell["achieved_gps"],
+            capacity_gps=round(int8_capacity, 1),
+            weight_bytes_int8=int(int8_weight_bytes),
+            weight_bytes_f32=int(f32_weight_bytes),
+            weight_bytes_ratio=round(
+                int8_weight_bytes / max(f32_weight_bytes, 1), 3
+            ),
+            # NOTE "quant_rel_error", not *max_error*: only the combined
+            # gate record below may carry bench_gate-matching key names —
+            # the mix gate compares the newest two matching records, so a
+            # second matching record per invocation would derail it
+            quant_rel_error=q_report.get("max_error"),
+            quant_mode=mode,
+            quant_source=q_report.get("source"),
+            device_kind=_device_kind(),
+        )
+        int8_cells[mode] = cell
+        _bank(json.dumps(cell))
+    # round-over-round gate keys, merged into the single gate record the
+    # fleet section banks (bench_gate.py --mix-cells on serve_cells.jsonl):
+    # capacity must not collapse (higher-is-better *graphs_per_sec*), the
+    # certified quantization error must not grow (lower-is-better
+    # *max_error*)
+    int8_gate_keys = {
+        **{
+            f"int8_{m}_graphs_per_sec": c["capacity_gps"]
+            for m, c in int8_cells.items()
+        },
+        **{
+            f"int8_{m}_quant_max_error": c["quant_rel_error"]
+            for m, c in int8_cells.items()
+            if c["quant_rel_error"] is not None
+        },
+    }
+
     # ---- fleet cells (ISSUE 19 tentpole; docs/SERVING.md "Fleet"): the
     # failover router fronting {1, 2, 4} replicas — aggregate closed-loop
     # graphs/sec and client-side p99 vs replica count, plus the
@@ -1553,11 +1636,12 @@ def main_serve():
     # round-over-round gate record (bench_gate.py --mix-cells on
     # logs/serve_cells.jsonl): *_graphs_per_sec keys must not collapse
     _bank(json.dumps({
-        "metric": "serve fleet scaling (router aggregate, gate record)",
+        "metric": "serve fleet scaling + int8 quantization (gate record)",
         **{
             f"fleet_r{r}_graphs_per_sec": c["aggregate_gps"]
             for r, c in fleet_cells.items()
         },
+        **int8_gate_keys,
         "fleet_cache_hit_rate": cache_cell["cache_hit_rate"],
         "ok": True,
     }))

@@ -1329,6 +1329,10 @@ def _(config: dict, datasets=None, install_sigterm: bool = False):
         sort_edges=bool(arch.get("use_sorted_aggregation", False)),
         log_name=log_name,
         checkpoint_label=entry,
+        # int8 plane: locates pre-quantized snapshot artifacts beside the
+        # checkpoints (serve/quantize.py) — a replica that finds one skips
+        # re-quantization and calibration entirely
+        checkpoint_dir="./logs",
         tracer=tracer,
         flight_recorder=flight,
     )

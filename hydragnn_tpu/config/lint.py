@@ -43,6 +43,9 @@ _OPAQUE = {
     # the resolved rule table api.py records for restore replay
     # (parallel/rules.py table_from_recorded)
     "Parallel.resolved_rules",
+    # int8 quantization sub-dict — schema'd strictly by
+    # serve/config.py QuantizationSpec.resolve (unknown keys FAIL there)
+    "Serving.quantization",
 }
 
 # exact key paths this framework consumes (config/config.py completion,
@@ -199,6 +202,7 @@ _HANDLED = {
     "Serving.breaker_failures",
     "Serving.breaker_cooldown_s",
     "Serving.prediction_cache",
+    "Serving.quantization",
     "Serving.reload_error_spike",
     "Serving.reload_probe_requests",
     "Telemetry.enabled",

@@ -61,6 +61,7 @@ from .events import (
     EV_NUMERICS_PROVENANCE,
     EV_BREAKER_CLOSE,
     EV_BREAKER_OPEN,
+    EV_QUANT_DRIFT,
     EV_QUEUE_FULL,
     EV_RELOAD_ROLLBACK,
     EV_REPLICA_BENCHED,
@@ -106,6 +107,8 @@ F_ELASTIC_GROW = "elastic_grow"          # fleet re-grew to more hosts
 F_REPLICA_FLAP = "replica_flap"          # serving replica crash-looped
 F_BREAKER_OPEN = "breaker_open"          # router circuit breaker tripped
 F_RELOAD_ROLLBACK = "reload_rollback"    # rolling reload auto-rolled back
+F_QUANT_DRIFT = "quant_drift"            # int8 accuracy gate refused a state
+F_CACHE_INEFFECTIVE = "cache_ineffective"  # prediction cache barely hitting
 
 FINDING_KINDS = (
     F_INPUT_BOUND, F_RETRACE_STORM, F_PADDING_WASTE, F_NAN_DIVERGENCE,
@@ -114,6 +117,7 @@ FINDING_KINDS = (
     F_QUARANTINE_ROT, F_LOADER_STALL, F_WEDGED_STEP, F_COLD_START,
     F_UNTUNED_KERNEL, F_CRASH, F_ELASTIC_SHRINK, F_ELASTIC_GROW,
     F_REPLICA_FLAP, F_BREAKER_OPEN, F_RELOAD_ROLLBACK,
+    F_QUANT_DRIFT, F_CACHE_INEFFECTIVE,
 )
 
 _EVIDENCE_CAP = 16  # per finding; a shed spiral does not need 300 records
@@ -150,6 +154,12 @@ class DoctorConfig:
     replica_flap_min_restarts: int = 3
     # rollbacks: 1 recovers, this many is a loop
     rollback_loop_min: int = 2
+    # prediction cache: judge efficacy only after this many lookups (a
+    # fleet that barely ran has no verdict), and call it ineffective when
+    # the hit rate sits below the floor — a cache-enabled fleet paying
+    # key-hash + disk probes per request for almost no reuse
+    cache_min_lookups: int = 100
+    cache_hit_rate_min: float = 0.05
     # diff mode: time_to_first_step growth beyond this factor with fresh
     # cache misses is a cold-start regression
     cold_start_factor: float = 1.5
@@ -1110,6 +1120,75 @@ def r_reload_rollback(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
         "divergence, wrong export) before re-publishing the pointer",
         evidence=evs,
         data={"rollbacks": len(evs), "last": last},
+    )]
+
+
+@rule
+def r_quant_drift(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    """Every quant_drift event is a refused install: the int8 accuracy
+    gate (serve/quantize.py) caught a quantized state whose predictions
+    drifted past the configured bound. One refusal is already a finding —
+    a candidate that would have served wrong answers reached the gate."""
+    evs = s.events_of(EV_QUANT_DRIFT)
+    if not evs:
+        return []
+    last = evs[-1]
+    candidates = sorted(
+        {str(e.get("candidate")) for e in evs if e.get("candidate")}
+    )
+    return [Finding(
+        F_QUANT_DRIFT, "error",
+        f"int8 accuracy gate refused {len(evs)} quantized state(s) "
+        f"(mode {last.get('mode')!r}): relative max error "
+        f"{last.get('max_error')} crossed the "
+        f"Serving.quantization.max_error={last.get('limit')} bound "
+        f"(worst heads: {last.get('per_head')}); the previous weights "
+        "kept serving",
+        "the checkpoint's weight distribution no longer quantizes within "
+        "the bound: widen Serving.quantization.max_error only if the "
+        "drift is acceptable, exclude the worst layers via "
+        "Serving.quantization.exclude, drop Serving.quantization.mode "
+        "from w8a8 to weight_only, or serve this run at "
+        "weights_dtype bfloat16",
+        evidence=evs,
+        data={"refusals": len(evs), "candidates": candidates,
+              "last": last},
+    )]
+
+
+@rule
+def r_cache_ineffective(s: RunStreams, cfg: DoctorConfig) -> List[Finding]:
+    """A cache-enabled fleet whose hit rate stays on the floor: every
+    request pays the content hash + disk probe and almost none reuse an
+    entry. Judged from the manager's aggregated fleet_serve window
+    (counters are cumulative — the last record is the fleet total)."""
+    fleet = _fleet_serve_latest(s)
+    if fleet is None or not fleet.get("cache_enabled"):
+        return []
+    hits = int(fleet.get("cache_hits", 0))
+    misses = int(fleet.get("cache_misses", 0))
+    lookups = hits + misses
+    if lookups < cfg.cache_min_lookups:
+        return []
+    rate = hits / lookups
+    if rate >= cfg.cache_hit_rate_min:
+        return []
+    return [Finding(
+        F_CACHE_INEFFECTIVE, "warn",
+        f"prediction cache is ineffective: {hits} hit(s) in {lookups} "
+        f"lookups ({rate:.1%}, floor {cfg.cache_hit_rate_min:.0%}) across "
+        f"{fleet.get('replicas')} replica(s) — the traffic's graphs "
+        "almost never repeat bit-identically under the current cache "
+        "context",
+        "disable Serving.prediction_cache for this traffic (the cache "
+        "only pays off on repeated identical inputs), or check for a "
+        "context churn source: every checkpoint swap and weights_dtype/"
+        "quantization change namespaces the keys, so a flapping rollout "
+        "orphans all prior entries",
+        evidence=[fleet],
+        data={"hits": hits, "misses": misses, "hit_rate": round(rate, 4),
+              "entries": int(fleet.get("cache_entries", 0)),
+              "bytes": int(fleet.get("cache_bytes", 0))},
     )]
 
 

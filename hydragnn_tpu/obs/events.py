@@ -65,6 +65,7 @@ EV_REPLICA_BENCHED = "replica_benched"    # flap breaker benched a replica
 EV_BREAKER_OPEN = "breaker_open"          # router circuit breaker opened
 EV_BREAKER_CLOSE = "breaker_close"        # half-open probe reclosed a breaker
 EV_RELOAD_ROLLBACK = "reload_rollback"    # rolling reload rolled back a regression
+EV_QUANT_DRIFT = "quant_drift"            # int8 accuracy gate refused a state
 
 EVENT_KINDS = (
     EV_GUARD_SKIP, EV_GUARD_ROLLBACK, EV_GUARD_FATAL, EV_DATA_SKIP,
@@ -78,6 +79,7 @@ EVENT_KINDS = (
     EV_ELASTIC_SHRINK, EV_ELASTIC_GROW,
     EV_REPLICA_EXIT, EV_REPLICA_RESTART, EV_REPLICA_BENCHED,
     EV_BREAKER_OPEN, EV_BREAKER_CLOSE, EV_RELOAD_ROLLBACK,
+    EV_QUANT_DRIFT,
 )
 
 SEVERITIES = ("info", "warn", "error", "fatal")
@@ -127,6 +129,9 @@ DEFAULT_SEVERITY: Dict[str, str] = {
     EV_BREAKER_OPEN: "warn",
     EV_BREAKER_CLOSE: "info",
     EV_RELOAD_ROLLBACK: "error",
+    # a refused quantized state means a candidate would have served wrong
+    # answers — the gate caught it, but the rollout it rode is dead
+    EV_QUANT_DRIFT: "error",
 }
 
 

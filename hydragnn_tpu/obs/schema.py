@@ -123,6 +123,16 @@ METRICS_KINDS: Dict[str, FieldSpec] = {
         "queue_full_total": (_NUM, True, False),
         "completed_total": (_NUM, True, False),
         "per_replica": (_DICT, True, False),
+        # prediction-cache efficacy (serve/cache.py stats; optional so
+        # pre-cache fleet streams stay schema-valid): cumulative lookup
+        # counters + current entry census — the doctor's
+        # cache_ineffective rule reads these
+        "cache_enabled": (_BOOL, False, False),
+        "cache_hits": (_NUM, False, False),
+        "cache_misses": (_NUM, False, False),
+        "cache_stores": (_NUM, False, False),
+        "cache_entries": (_NUM, False, False),
+        "cache_bytes": (_NUM, False, False),
     },
 }
 

@@ -1,0 +1,119 @@
+"""Int8 quantization primitives (docs/SERVING.md "Quantization").
+
+Per-channel symmetric int8 for inference weights: each output channel of a
+dense kernel gets its own fp32 scale (``amax / 127`` over the input axis),
+so the quantization error of one wide-ranged channel never bleeds into its
+neighbors — the standard post-training recipe (Jacob et al. 2018). Symmetric
+(no zero point) keeps the integer matmul a plain ``lax.dot_general`` with an
+int32 accumulator and the dequant a single fused multiply.
+
+Two consumers (serve/quantize.py):
+
+- weight-only: kernels live in HBM as int8 + a ``[1, out]`` scale;
+  ``dequantize`` runs inside the jitted predict, where XLA fuses the
+  convert+scale into the matmul's operand read — activations stay f32;
+- w8a8: activations are quantized against a *static* calibrated scale
+  (max-abs over template batches / 127 — no per-batch reduction in the
+  serving path), then ``int8_matmul`` accumulates int8 x int8 in int32 and
+  one ``a_scale * w_scale`` multiply rescales the product.
+
+The block-plan surface (``normalize_tiles`` + the ``int8_dot`` entry in
+tune/plans.py) keys int8 executions as their own axis of the tuned table:
+an int8 plan can never be confused with (or silently reuse) an f32/bf16
+entry for the same shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+#: bumping this invalidates tuned-table entries for the int8_dot plan
+#: (tune/plans.py kernel_version contract)
+KERNEL_VERSION = 1
+
+#: symmetric int8 range: +-127 (the -128 slot is unused so negation is
+#: closed and the scale math stays symmetric)
+INT8_MAX = 127.0
+
+
+def normalize_tiles(rows: int, cols: int, k: int, block_m: int,
+                    block_n: int, block_k: int) -> Tuple[int, int, int]:
+    """Clamp an int8_dot block plan to the operand extents (lane-padded to
+    the 128 MXU lane width), the same normalize-before-key contract as the
+    Pallas kernels: equivalent plans collapse to one tuned-table entry."""
+
+    def _clamp(block: int, extent: int) -> int:
+        block = max(int(block), 8)
+        if extent > 0:
+            block = min(block, max(-(-int(extent) // 128) * 128, 8))
+        return block
+
+    return (_clamp(block_m, rows), _clamp(block_n, cols), _clamp(block_k, k))
+
+
+def quantize_per_channel(w) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-output-channel int8 quantization of a dense kernel.
+
+    ``w`` is ``[in, out]`` (or branch-banked ``[B, in, out]``); the scale
+    reduces over the input axis (``-2``) with keepdims, giving ``[1, out]``
+    (``[B, 1, out]``) so ``q * scale`` broadcasts back to the kernel shape.
+    All-zero channels get scale 1.0 — they quantize to 0 and dequantize to
+    0 exactly, without a 0/0 in the round."""
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w), axis=-2, keepdims=True).astype(jnp.float32)
+    scale = jnp.where(amax > 0.0, amax / INT8_MAX, 1.0)
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale, dtype=jnp.float32):
+    """``q * scale`` in ``dtype`` — inside a jitted predict XLA keeps the
+    int8 array resident and fuses the convert into the consuming matmul."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def quantize_activations(x, act_scale):
+    """Quantize activations against a static calibrated scale (w8a8).
+    Out-of-range activations saturate at +-127 — the max-abs calibration
+    over the warmed template batches makes saturation the tail case."""
+    return jnp.clip(
+        jnp.round(x / act_scale), -INT8_MAX, INT8_MAX
+    ).astype(jnp.int8)
+
+
+def int8_matmul(x_q, w_q) -> jnp.ndarray:
+    """int8 x int8 contraction with an int32 accumulator: contracts the
+    last axis of ``x_q`` against the first of ``w_q`` (the dense-layer
+    layout). ``preferred_element_type=int32`` is the whole point — an int8
+    accumulator would overflow at K > ~2, and f32 accumulation would
+    forfeit the integer MXU path this mode exists for.
+
+    Consults the ``int8_dot`` tile plan (tune/runtime.py) at trace time so
+    int8 executions are announced and tuned under their own dtype axis;
+    the plan is advisory for the XLA lowering but is the tuned-table key
+    a Pallas int8 kernel will consume verbatim."""
+    try:  # keying/announcement only — never allowed to fail the matmul
+        from ..tune.runtime import tile_plan
+
+        tile_plan(
+            "int8_dot",
+            {
+                "rows": int(x_q.shape[0]) if x_q.ndim > 1 else 1,
+                "cols": int(w_q.shape[-1]),
+                "k": int(w_q.shape[0]),
+            },
+            dtype="int8",
+        )
+    except Exception:  # noqa: BLE001 — advisory plane
+        pass
+    return lax.dot_general(
+        x_q,
+        w_q,
+        (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )

@@ -6,7 +6,7 @@ a ``FleetRouter`` with retries, hedging, circuit breakers, and an optional
 content-addressed ``PredictionCache``)."""
 
 from .cache import PredictionCache, graph_key
-from .config import ServeConfig
+from .config import QuantizationSpec, ServeConfig
 from .errors import (
     ERROR_CODES,
     RETRYABLE_CODES,
@@ -36,12 +36,18 @@ from .server import GraphServer, PredictionHandle
 
 
 def __getattr__(name):
-    # ReplicaManager imports api machinery transitively; keep it lazy so
+    # ReplicaManager imports api machinery transitively, and the
+    # quantization plane pulls flax/jax numerics; keep both lazy so
     # `from hydragnn_tpu.serve import ServeConfig` stays light.
     if name == "ReplicaManager":
         from .fleet import ReplicaManager
 
         return ReplicaManager
+    if name in ("QuantizationDriftError", "QuantizedInferenceState",
+                "quantize_state", "quantize_weights"):
+        from . import quantize
+
+        return getattr(quantize, name)
     raise AttributeError(name)
 
 
@@ -59,6 +65,9 @@ __all__ = [
     "NoReplicasError",
     "PredictionCache",
     "PredictionHandle",
+    "QuantizationDriftError",
+    "QuantizationSpec",
+    "QuantizedInferenceState",
     "QueueFullError",
     "ReplicaClient",
     "ReplicaManager",
@@ -73,4 +82,6 @@ __all__ = [
     "WedgedStepError",
     "error_from_code",
     "graph_key",
+    "quantize_state",
+    "quantize_weights",
 ]

@@ -89,7 +89,10 @@ class PredictionCache:
     ``None`` on any miss (absent, unreadable, digest mismatch); ``put``
     stores atomically and never raises on I/O failure — the cache is an
     accelerator, not a dependency. ``stats()`` exposes hit/miss/store/
-    corrupt counters for the fleet gauges and bench cells.
+    corrupt counters plus the on-disk entry/byte census for the fleet
+    gauges and bench cells; the same numbers land in the process registry
+    as ``hydragnn_serve_cache_{hits,misses,entries,bytes}``, so /metrics
+    scrapes see cache efficacy live.
 
     ``context`` namespaces every key with the non-graph prediction inputs
     (checkpoint digest + serve config). The default ``""`` keys on graph
@@ -108,6 +111,62 @@ class PredictionCache:
         self.misses = 0
         self.stores = 0
         self.corrupt = 0
+        # entry census, seeded from disk so a restarted fleet reports the
+        # cache it inherited, then maintained incrementally by put/removal
+        self.entries, self.bytes = self._scan()
+        # telemetry plane: counters absorb the lookup tallies (set_total —
+        # idempotent, so N replicas sharing one process never double
+        # count), gauges carry the census; /metrics and the fleet's
+        # metrics.jsonl window both render from these
+        from ..obs.registry import registry as _obs_registry
+
+        _reg = _obs_registry()
+        self._m_hits = _reg.counter(
+            "hydragnn_serve_cache_hits",
+            "Prediction-cache lookups answered from a verified entry",
+        )
+        self._m_misses = _reg.counter(
+            "hydragnn_serve_cache_misses",
+            "Prediction-cache lookups that fell through to the model "
+            "(absent, unreadable, or digest-mismatched entry)",
+        )
+        self._m_entries = _reg.gauge(
+            "hydragnn_serve_cache_entries",
+            "Prediction-cache entries currently on disk",
+        )
+        self._m_bytes = _reg.gauge(
+            "hydragnn_serve_cache_bytes",
+            "Prediction-cache bytes currently on disk",
+        )
+        self._publish()
+
+    def _scan(self) -> "tuple[int, int]":
+        """Count the .npz entries (and their bytes) already in the shard
+        dirs — in-flight ``.tmp.<pid>`` files excluded."""
+        entries = 0
+        size = 0
+        try:
+            with os.scandir(self.cache_dir) as shards:
+                shard_names = [d.name for d in shards if d.is_dir()]
+            for shard in shard_names:
+                with os.scandir(os.path.join(self.cache_dir, shard)) as it:
+                    for f in it:
+                        if f.name.endswith(".npz") and f.is_file():
+                            entries += 1
+                            size += f.stat().st_size
+        except OSError:
+            pass
+        return entries, size
+
+    def _publish(self) -> None:
+        """Mirror the counters/census into the process registry. Callers
+        hold ``self._lock``-free state reads only — counter absorption is
+        max-merge and gauges are last-writer, so racing publishes are
+        harmless."""
+        self._m_hits.set_total(self.hits)
+        self._m_misses.set_total(self.misses)
+        self._m_entries.set(max(0, self.entries))
+        self._m_bytes.set(max(0, self.bytes))
 
     @property
     def context(self) -> Optional[str]:
@@ -155,6 +214,20 @@ class PredictionCache:
         except (OSError, ValueError, KeyError, zipfile.BadZipFile):
             with self._lock:
                 self.misses += 1
+            # an unreadable file that EXISTS will never become readable:
+            # evict it (and its census share) instead of re-missing on it
+            # forever; an absent file (the cold-miss case) raises on
+            # getsize and stays a plain miss
+            try:
+                size = os.path.getsize(path)
+                os.remove(path)
+                with self._lock:
+                    self.corrupt += 1
+                    self.entries -= 1
+                    self.bytes -= size
+            except OSError:
+                pass
+            self._publish()
             return None
         if _result_digest(result) != stored_digest:
             # Corrupt entry that survived the zip CRC: drop it and recompute.
@@ -162,12 +235,18 @@ class PredictionCache:
                 self.corrupt += 1
                 self.misses += 1
             try:
+                size = os.path.getsize(path)
                 os.remove(path)
+                with self._lock:
+                    self.entries -= 1
+                    self.bytes -= size
             except OSError:
                 pass
+            self._publish()
             return None
         with self._lock:
             self.hits += 1
+        self._publish()
         return result
 
     def put(self, graph: Graph, result: Dict[str, np.ndarray],
@@ -186,11 +265,22 @@ class PredictionCache:
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "wb") as f:
                 f.write(buf.getvalue())
+            # census delta: a replace of an existing entry (two replicas
+            # racing the same key) swaps bytes, not entries
+            try:
+                prior = os.path.getsize(path)
+                fresh = False
+            except OSError:
+                prior = 0
+                fresh = True
             os.replace(tmp, path)
         except OSError:
             return None
         with self._lock:
             self.stores += 1
+            self.entries += 1 if fresh else 0
+            self.bytes += len(buf.getvalue()) - prior
+        self._publish()
         return key
 
     def stats(self) -> Dict[str, int]:
@@ -200,4 +290,6 @@ class PredictionCache:
                 "misses": self.misses,
                 "stores": self.stores,
                 "corrupt": self.corrupt,
+                "entries": max(0, self.entries),
+                "bytes": max(0, self.bytes),
             }

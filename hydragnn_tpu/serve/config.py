@@ -11,7 +11,88 @@ from __future__ import annotations
 
 import dataclasses
 import warnings
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
+
+#: int8 quantization modes (docs/SERVING.md "Quantization"): weight_only
+#: keeps activations in the model's own precision and fuses the dequant
+#: into the matmul; w8a8 also quantizes activations against static scales
+#: calibrated from the numerics observatory's max-abs statistics.
+QUANT_MODES = ("weight_only", "w8a8")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizationSpec:
+    """Resolved ``Serving.quantization`` sub-config (only meaningful with
+    ``weights_dtype: int8``): the mode, how many warmed template batches
+    feed activation calibration, the accuracy gate's relative max-error
+    bound, and extra per-layer exclude substrings (head output layers and
+    norm parameters are excluded structurally either way)."""
+
+    mode: str = "weight_only"
+    calibration_batches: int = 2
+    max_error: float = 0.05
+    exclude: Tuple[str, ...] = ()
+
+    _KNOWN = ("mode", "calibration_batches", "max_error", "exclude")
+
+    def __post_init__(self):
+        if self.mode not in QUANT_MODES:
+            raise ValueError(
+                f"Serving.quantization.mode {self.mode!r} must be one of "
+                f"{QUANT_MODES}"
+            )
+        if int(self.calibration_batches) < 1:
+            raise ValueError(
+                f"Serving.quantization.calibration_batches must be >= 1, "
+                f"got {self.calibration_batches!r}"
+            )
+        if not (float(self.max_error) > 0.0):
+            raise ValueError(
+                f"Serving.quantization.max_error must be > 0 (relative max "
+                f"error the accuracy gate tolerates), got "
+                f"{self.max_error!r}"
+            )
+        if not isinstance(self.exclude, tuple) or not all(
+            isinstance(p, str) and p for p in self.exclude
+        ):
+            raise ValueError(
+                f"Serving.quantization.exclude must be a list of non-empty "
+                f"layer-path substrings, got {self.exclude!r}"
+            )
+
+    @staticmethod
+    def resolve(section: Any) -> "QuantizationSpec":
+        """Normalize the config's ``Serving.quantization`` value (None =
+        all defaults, a dict validates each key, a spec passes through).
+        Unknown keys FAIL here (unlike top-level Serving keys, which only
+        warn): a typo'd ``max_eror`` silently serving ungated int8 is
+        exactly the accident the gate exists to prevent."""
+        if section is None:
+            return QuantizationSpec()
+        if isinstance(section, QuantizationSpec):
+            return section
+        if not isinstance(section, dict):
+            raise ValueError(
+                f"Serving.quantization must be an object of "
+                f"{list(QuantizationSpec._KNOWN)}, got {section!r}"
+            )
+        unknown = sorted(set(section) - set(QuantizationSpec._KNOWN))
+        if unknown:
+            raise ValueError(
+                f"Serving.quantization keys {unknown} are unknown (known: "
+                f"{list(QuantizationSpec._KNOWN)})"
+            )
+        kw = dict(section)
+        if "calibration_batches" in kw:
+            kw["calibration_batches"] = int(kw["calibration_batches"])
+        if "max_error" in kw:
+            kw["max_error"] = float(kw["max_error"])
+        if "exclude" in kw:
+            ex = kw["exclude"]
+            kw["exclude"] = tuple(
+                str(p) for p in (ex if isinstance(ex, (list, tuple)) else [ex])
+            )
+        return QuantizationSpec(**kw)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,9 +142,16 @@ class ServeConfig:
     http_host: str = "127.0.0.1"
     # reduced-precision serving (docs/SERVING.md): "bfloat16" casts the
     # restored InferenceState's floating params once at install (halved
-    # weight HBM + bf16 MXU streams); batch stats stay f32. Applied to hot
-    # reloads too. Default keeps the checkpoint's own precision.
+    # weight HBM + bf16 MXU streams); batch stats stay f32. "int8" routes
+    # through the quantization plane (serve/quantize.py): per-channel
+    # symmetric int8 kernels + fp32 scales, gated at every install by the
+    # quantization.max_error accuracy check. Applied to hot reloads too.
+    # Default keeps the checkpoint's own precision.
     weights_dtype: str = "float32"
+    # int8 sub-config (QuantizationSpec; only consulted when weights_dtype
+    # is "int8"): mode weight_only|w8a8, calibration batch count, accuracy
+    # gate bound, per-layer exclude substrings. None = spec defaults.
+    quantization: Any = None
     # drain ordering (docs/SERVING.md "Drain"): on SIGTERM /readyz flips
     # not-ready immediately, but admissions stay open for drain_grace_s so
     # a load balancer observes the flip and stops routing *before* clients
@@ -124,6 +212,7 @@ class ServeConfig:
         "http_port",
         "http_host",
         "weights_dtype",
+        "quantization",
         "drain_grace_s",
         "fleet_replicas",
         "fleet_restart_backoff_s",
@@ -143,7 +232,7 @@ class ServeConfig:
         "reload_probe_requests",
     )
 
-    WEIGHTS_DTYPES = ("float32", "bfloat16")
+    WEIGHTS_DTYPES = ("float32", "bfloat16", "int8")
 
     def __post_init__(self):
         from ..train.compile_plane import RETRACE_POLICIES
@@ -215,6 +304,13 @@ class ServeConfig:
             raise ValueError(
                 f"Serving.weights_dtype {self.weights_dtype!r} must be one "
                 f"of {ServeConfig.WEIGHTS_DTYPES}"
+            )
+        if self.quantization is not None or self.weights_dtype == "int8":
+            # normalize once here so every consumer (server, fleet, bench)
+            # reads a validated QuantizationSpec, never a raw dict
+            object.__setattr__(
+                self, "quantization",
+                QuantizationSpec.resolve(self.quantization),
             )
 
     @staticmethod

@@ -376,7 +376,12 @@ class ReplicaManager:
                 ident = f"{entry}:{f.read().strip()}"
         except OSError:
             pass
-        return f"ckpt={ident};weights_dtype={self.cfg.weights_dtype}"
+        ctx = f"ckpt={ident};weights_dtype={self.cfg.weights_dtype}"
+        if self.cfg.weights_dtype == "int8" and self.cfg.quantization:
+            # int8 predictions depend on the quantization recipe too — a
+            # weight_only fleet and a w8a8 fleet must never share entries
+            ctx += f";quant={self.cfg.quantization.mode}"
+        return ctx
 
     def _refresh_cache_context(self) -> None:
         if self._cache is None or self._reloading:
@@ -602,6 +607,17 @@ class ReplicaManager:
             "completed_total": completed,
             "per_replica": per_replica,
         }
+        cache = getattr(self, "_cache", None)
+        if cache is not None:
+            # prediction-cache efficacy ride-along (optional schema
+            # fields): the doctor's cache_ineffective rule reads these
+            cs = cache.stats()
+            rec["cache_enabled"] = cache.context is not None
+            rec["cache_hits"] = cs["hits"]
+            rec["cache_misses"] = cs["misses"]
+            rec["cache_stores"] = cs["stores"]
+            rec["cache_entries"] = cs["entries"]
+            rec["cache_bytes"] = cs["bytes"]
         try:
             if self._metrics_fh is None:
                 self._metrics_fh = open(

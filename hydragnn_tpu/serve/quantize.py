@@ -1,0 +1,565 @@
+"""Int8 quantized inference plane (docs/SERVING.md "Quantization").
+
+Post-training int8 for the serving stack, built from three planes this repo
+already trusts:
+
+- **weights**: per-channel symmetric int8 with fp32 scales (ops/quant.py) —
+  every dense kernel becomes an int8 array + a ``[1, out]`` scale. In
+  ``weight_only`` mode the dequant runs inside the jitted predict where XLA
+  fuses it into the matmul, so the kernels stay int8 in HBM (4x smaller than
+  f32) and the model code is untouched;
+- **activations** (``w8a8``): static activation scales calibrated from the
+  numerics observatory's max-abs statistics (obs/numerics.py probes) over
+  ``Serving.quantization.calibration_batches`` warmed template batches.
+  Serving intercepts ``nn.Dense.__call__`` (flax ``intercept_methods``) for
+  the calibrated layers and runs int8 x int8 ``lax.dot_general`` with an
+  int32 accumulator; layers the calibration never observed (branch-banked
+  vmapped heads, fused-kernel paths that read params directly) fall back to
+  weight-only dequant — quantization must never change which code path a
+  layer executes;
+- **the gate**: every state-install point (server warm-up, CheckpointWatcher
+  swap, rolling-reload canary) compares quantized vs full-precision
+  predictions on the warmed ladder's template batches and REFUSES the swap
+  when the relative max error crosses ``Serving.quantization.max_error`` —
+  a typed :class:`QuantizationDriftError` plus a ``quant_drift`` event the
+  doctor maps to a finding. A drifted candidate keeps the previous weights
+  serving, exactly like a corrupt checkpoint.
+
+Exclusions: only ``kernel`` leaves quantize, so LayerNorm/BatchNorm scales,
+biases, and running statistics stay f32 structurally; each head's output
+layer (the highest-indexed Dense under a ``heads*`` scope) is excluded by
+default, and ``Serving.quantization.exclude`` adds substring patterns.
+
+Snapshot artifact: ``<entry>.quant-<mode>.npz`` beside the checkpoint, with
+the checkpoint plane's atomic-write + sha256-sidecar discipline — N fleet
+replicas load int8 directly (no per-process re-quantization or calibration)
+and a torn/corrupt snapshot falls back to quantizing from the checkpoint.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from flax import struct
+from flax.traverse_util import flatten_dict, unflatten_dict
+
+from ..ops.quant import (
+    INT8_MAX,
+    dequantize,
+    int8_matmul,
+    quantize_activations,
+    quantize_per_channel,
+)
+from .errors import ServeError
+
+#: bump on any change to the snapshot layout — a loader seeing a different
+#: version treats the artifact as absent and re-quantizes from the checkpoint
+SNAPSHOT_FORMAT_VERSION = 1
+
+MODES = ("weight_only", "w8a8")
+
+
+class QuantizationDriftError(ServeError):
+    """The accuracy gate refused a quantized state: its predictions drifted
+    past ``Serving.quantization.max_error`` relative to full precision on
+    the template batches. Raised at install time — the current weights keep
+    serving, the candidate never reaches traffic."""
+
+    code = "quant_drift"
+
+    def __init__(self, message: str, max_error: float = 0.0,
+                 limit: float = 0.0,
+                 per_head: Optional[Dict[str, float]] = None):
+        super().__init__(message)
+        self.max_error = float(max_error)
+        self.limit = float(limit)
+        self.per_head = dict(per_head or {})
+
+
+@struct.dataclass
+class QuantizedInferenceState:
+    """An ``InferenceState`` whose dense kernels are int8.
+
+    ``params`` mirrors the original tree with int8 arrays at quantized
+    kernel leaves; ``scales`` maps each quantized leaf's ``/``-joined path
+    to its fp32 per-channel scale; ``quant`` is the side ``"quant"``
+    variables collection for w8a8 (per intercepted Dense scope:
+    ``kernel_scale`` + calibrated ``act_scale``) — empty in weight-only
+    mode. ``w8a8`` (static) names the intercepted scopes: their kernels
+    stay int8 through ``variables()`` and the serve-side interceptor
+    consumes them; every other quantized kernel is dequantized at trace
+    time so model code that reads params directly always sees floats."""
+
+    params: Any
+    scales: Dict[str, Any]
+    quant: Dict[str, Any]
+    batch_stats: Any
+    step: Any = 0
+    mode: str = struct.field(pytree_node=False, default="weight_only")
+    w8a8: Tuple[str, ...] = struct.field(pytree_node=False, default=())
+
+    def variables(self) -> Dict[str, Any]:
+        flat = flatten_dict(self.params)
+        keep = set(self.w8a8)
+        out = {}
+        for key, leaf in flat.items():
+            path = "/".join(key)
+            if path in self.scales and "/".join(key[:-1]) not in keep:
+                out[key] = dequantize(leaf, self.scales[path])
+            else:
+                out[key] = leaf
+        v: Dict[str, Any] = {"params": unflatten_dict(out)}
+        if self.batch_stats:
+            v["batch_stats"] = self.batch_stats
+        if self.quant:
+            v["quant"] = self.quant
+        return v
+
+    def weight_nbytes(self) -> int:
+        """Resident weight bytes (params + scales) — the BENCH_SERVE HBM
+        cell; int8 kernels count 1 byte/element."""
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(
+            {"p": self.params, "s": self.scales, "q": self.quant}
+        ):
+            total += int(getattr(leaf, "nbytes", 0) or 0)
+        return total
+
+
+# ---------------------------------------------------------------------------
+# kernel selection
+# ---------------------------------------------------------------------------
+
+
+def _head_output_paths(flat_params) -> set:
+    """The highest-indexed ``Dense_k`` kernel under each top-level
+    ``heads*`` scope — the per-head output layer, excluded by default
+    (its error lands directly on the prediction with no later layer to
+    absorb it)."""
+    best: Dict[str, Tuple[int, Tuple[str, ...]]] = {}
+    for key in flat_params:
+        if len(key) < 3 or key[-1] != "kernel":
+            continue
+        if not str(key[0]).startswith("heads"):
+            continue
+        parent = str(key[-2])
+        if not parent.startswith("Dense_"):
+            continue
+        try:
+            idx = int(parent.split("_")[-1])
+        except ValueError:
+            continue
+        scope = "/".join(key[:-2])
+        if scope not in best or idx > best[scope][0]:
+            best[scope] = (idx, key)
+    return {key for _, key in best.values()}
+
+
+def quantizable_paths(params, exclude: Sequence[str] = ()
+                      ) -> List[Tuple[str, ...]]:
+    """Param-tree paths of the kernels the quantizer touches: floating
+    ``kernel`` leaves of rank >= 2, minus the per-head output layers and
+    any path matching an ``exclude`` substring. Norm scales/biases and
+    running statistics are structurally excluded (they are not named
+    ``kernel``)."""
+    flat = flatten_dict(params)
+    head_out = _head_output_paths(flat)
+    out = []
+    for key, leaf in sorted(flat.items()):
+        if key[-1] != "kernel":
+            continue
+        if getattr(leaf, "ndim", 0) < 2:
+            continue
+        if not jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            continue
+        if key in head_out:
+            continue
+        path = "/".join(key)
+        if any(pat and pat in path for pat in exclude):
+            continue
+        out.append(key)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# quantization + calibration
+# ---------------------------------------------------------------------------
+
+
+def quantize_weights(state, exclude: Sequence[str] = ()
+                     ) -> QuantizedInferenceState:
+    """Weight-only quantization of an ``InferenceState``/``TrainState``:
+    pure tree transform, no model or data needed (``cast_inference_weights
+    (state, "int8")`` lands here). Calibration/gating are the serving
+    layer's job (:func:`quantize_state`)."""
+    flat = dict(flatten_dict(state.params))
+    scales: Dict[str, Any] = {}
+    for key in quantizable_paths(state.params, exclude):
+        q, scale = quantize_per_channel(flat[key])
+        flat[key] = q
+        scales["/".join(key)] = scale
+    return QuantizedInferenceState(
+        params=unflatten_dict(flat),
+        scales=scales,
+        quant={},
+        batch_stats=getattr(state, "batch_stats", {}) or {},
+        step=getattr(state, "step", 0),
+        mode="weight_only",
+        w8a8=(),
+    )
+
+
+def _calibration_interceptor(record):
+    """Probe every eagerly-visible ``nn.Dense`` input through the numerics
+    observatory (obs/numerics probe/collecting). Inputs that are tracers
+    (the lifted-vmap branch heads batch-trace even in eager mode) are
+    skipped — those layers cannot be intercepted at serve time either, so
+    skipping them here is exactly what makes the observed-scope set the
+    authoritative w8a8 eligibility set."""
+    from ..obs.numerics import probe
+
+    observed = set()
+
+    def interceptor(next_fun, args, kwargs, context):
+        mod = context.module
+        if (
+            context.method_name == "__call__"
+            and isinstance(mod, nn.Dense)
+            and args
+            and not isinstance(args[0], jax.core.Tracer)
+        ):
+            scope = "/".join(str(p) for p in mod.path)
+            observed.add(scope)
+            probe(f"quant_calib/{scope}", args[0])
+        return next_fun(*args, **kwargs)
+
+    return interceptor, observed
+
+
+def calibrate_activations(model, state, batches: Sequence[Any]
+                          ) -> Tuple[Dict[str, float], set]:
+    """Eager forward passes over the template batches with a probing
+    interceptor: per-Dense-scope max-abs input statistics -> static
+    activation scales (``max_abs / 127``). Returns (scales by scope,
+    observed scope set). Eager on purpose — jitting would both hide the
+    per-layer values behind tracers and burn a compile for a one-shot
+    pass."""
+    from ..obs.numerics import STAT_FIELDS, ProbeRecord, collecting
+
+    maxabs_col = STAT_FIELDS.index("max_abs")
+    variables = state.variables()
+    record = ProbeRecord()
+    interceptor, observed = _calibration_interceptor(record)
+    with collecting(record):
+        with nn.intercept_methods(interceptor):
+            for batch in batches:
+                model.apply(variables, batch, train=False)
+    names, stats = record.stack()
+    stats = np.asarray(stats)
+    peaks: Dict[str, float] = {}
+    for name, row in zip(names, stats):
+        base = name.split("#")[0]
+        if not base.startswith("quant_calib/"):
+            continue
+        scope = base[len("quant_calib/"):]
+        peaks[scope] = max(peaks.get(scope, 0.0), float(row[maxabs_col]))
+    scales = {
+        scope: (peak / INT8_MAX if peak > 0.0 else 1.0)
+        for scope, peak in peaks.items()
+    }
+    return scales, observed
+
+
+def quantize_state(model, state, batches: Sequence[Any], mode: str,
+                   exclude: Sequence[str] = ()) -> QuantizedInferenceState:
+    """The full serving-side pipeline: weight-only quantize, then (w8a8)
+    calibrate activation scales and promote every calibrated 2D-kernel
+    Dense to int8 x int8 execution via the side ``quant`` collection."""
+    if mode not in MODES:
+        raise ValueError(f"quantization mode {mode!r} must be one of {MODES}")
+    q = quantize_weights(state, exclude)
+    if mode != "w8a8":
+        return q
+    act_scales, observed = calibrate_activations(model, state, batches)
+    flat = flatten_dict(q.params)
+    quant_flat: Dict[Tuple[str, ...], Any] = {}
+    w8a8: List[str] = []
+    for path, scale in q.scales.items():
+        key = tuple(path.split("/"))
+        scope = "/".join(key[:-1])
+        if scope not in act_scales:
+            continue
+        if flat[key].ndim != 2:
+            # branch-banked (vmapped) kernels keep weight-only dequant:
+            # the lifted transform won't carry the side collection
+            continue
+        quant_flat[key[:-1] + ("kernel_scale",)] = scale
+        quant_flat[key[:-1] + ("act_scale",)] = jnp.asarray(
+            act_scales[scope], jnp.float32
+        )
+        w8a8.append(scope)
+    return q.replace(
+        quant=unflatten_dict(quant_flat) if quant_flat else {},
+        mode="w8a8",
+        w8a8=tuple(sorted(w8a8)),
+    )
+
+
+# ---------------------------------------------------------------------------
+# w8a8 execution
+# ---------------------------------------------------------------------------
+
+
+def w8a8_interceptor(next_fun, args, kwargs, context):
+    """Serve-time ``nn.Dense.__call__`` interceptor: layers carrying a
+    ``quant`` collection entry run int8 x int8 with the calibrated static
+    activation scale; every other call falls through untouched."""
+    mod = context.module
+    if (
+        context.method_name != "__call__"
+        or not isinstance(mod, nn.Dense)
+        or not args
+        or not mod.has_variable("quant", "kernel_scale")
+    ):
+        return next_fun(*args, **kwargs)
+    kernel = mod.get_variable("params", "kernel")
+    if kernel.dtype != jnp.int8:
+        return next_fun(*args, **kwargs)
+    x = args[0]
+    w_scale = mod.get_variable("quant", "kernel_scale")  # [1, out]
+    a_scale = mod.get_variable("quant", "act_scale")  # scalar
+    x_q = quantize_activations(x, a_scale)
+    y = int8_matmul(x_q, kernel).astype(jnp.float32) * (a_scale * w_scale)
+    if mod.use_bias:
+        y = y + mod.get_variable("params", "bias")
+    return y
+
+
+def apply_quantized(model, state, batch):
+    """``model.apply`` for any inference state, quantized or not —
+    w8a8 states run under the interceptor. This is the one call the gate,
+    the warm-up and the jitted predict share, so gated accuracy is
+    measured on exactly the program that serves."""
+    variables = state.variables() if hasattr(state, "variables") else state
+    if getattr(state, "mode", None) == "w8a8" and getattr(state, "w8a8", ()):
+        with nn.intercept_methods(w8a8_interceptor):
+            return model.apply(variables, batch, train=False)
+    return model.apply(variables, batch, train=False)
+
+
+# ---------------------------------------------------------------------------
+# accuracy gate
+# ---------------------------------------------------------------------------
+
+
+def _as_output_dict(out) -> Dict[str, Any]:
+    if isinstance(out, dict):
+        return out
+    if isinstance(out, (list, tuple)):
+        return {f"head_{i}": o for i, o in enumerate(out)}
+    return {"output": out}
+
+
+def accuracy_report(model, fp_state, q_state,
+                    batches: Sequence[Any]) -> Dict[str, Any]:
+    """Relative max error of quantized vs full-precision predictions over
+    the template batches, per head and overall — the gate's evidence,
+    also banked into BENCH_SERVE int8 cells and ``stats()``."""
+    per_head: Dict[str, float] = {}
+    for batch in batches:
+        fp_out = _as_output_dict(apply_quantized(model, fp_state, batch))
+        q_out = _as_output_dict(apply_quantized(model, q_state, batch))
+        for name, ref in fp_out.items():
+            ref = np.asarray(ref, np.float32)
+            got = np.asarray(q_out[name], np.float32)
+            denom = float(np.max(np.abs(ref))) + 1e-8
+            err = float(np.max(np.abs(got - ref))) / denom
+            per_head[str(name)] = max(per_head.get(str(name), 0.0), err)
+    max_error = max(per_head.values()) if per_head else 0.0
+    return {
+        "max_error": round(max_error, 8),
+        "per_head": {k: round(v, 8) for k, v in per_head.items()},
+        "batches": len(batches),
+    }
+
+
+def gate_or_raise(model, fp_state, q_state, batches: Sequence[Any],
+                  max_error: float, *, run: str = "",
+                  entry: Optional[str] = None) -> Dict[str, Any]:
+    """Run the accuracy gate; past ``max_error`` emit the typed
+    ``quant_drift`` event and raise :class:`QuantizationDriftError` —
+    install points let it propagate, so a drifted candidate can never
+    reach traffic through warm-up, a watcher swap, or a rolling reload."""
+    report = dict(accuracy_report(model, fp_state, q_state, batches))
+    report["limit"] = float(max_error)
+    report["mode"] = getattr(q_state, "mode", "weight_only")
+    if report["max_error"] > float(max_error):
+        try:
+            from ..obs.events import EV_QUANT_DRIFT, emit
+
+            emit(
+                EV_QUANT_DRIFT,
+                run=run,
+                candidate=entry or "",
+                mode=report["mode"],
+                max_error=report["max_error"],
+                limit=float(max_error),
+                per_head=report["per_head"],
+            )
+        except Exception:  # noqa: BLE001 — observability must not mask
+            pass
+        raise QuantizationDriftError(
+            f"quantized predictions drifted {report['max_error']:.4g} "
+            f"(relative max error) past Serving.quantization.max_error="
+            f"{float(max_error):.4g} on {report['batches']} template "
+            f"batch(es); refusing the swap (per head: {report['per_head']})",
+            max_error=report["max_error"],
+            limit=float(max_error),
+            per_head=report["per_head"],
+        )
+    return report
+
+
+def apply_scale_drift(q_state: QuantizedInferenceState,
+                      factor: float) -> QuantizedInferenceState:
+    """Distort every weight scale by ``factor`` — the deterministic
+    drifted-candidate drill (utils/faultinject.py maybe_quant_drift): the
+    dequantized weights all shift by ``factor``, so the gate must refuse.
+    Test/chaos surface only; never called on the healthy path."""
+    scales = {k: v * float(factor) for k, v in q_state.scales.items()}
+    quant = jax.tree_util.tree_map(lambda x: x, q_state.quant)
+    if quant:
+        flat = {
+            k: (v * float(factor) if k[-1] == "kernel_scale" else v)
+            for k, v in flatten_dict(quant).items()
+        }
+        quant = unflatten_dict(flat)
+    return q_state.replace(scales=scales, quant=quant)
+
+
+# ---------------------------------------------------------------------------
+# snapshot artifact
+# ---------------------------------------------------------------------------
+
+_SECTION_PREFIXES = ("params", "scales", "quant", "batch_stats")
+
+
+def snapshot_name(entry: str, mode: str) -> str:
+    return f"{entry}.quant-{mode}.npz"
+
+
+def snapshot_path(log_name: str, entry: str, mode: str,
+                  path: str = "./logs") -> str:
+    """The pre-quantized artifact's location: beside the checkpoint entry
+    it was quantized from, keyed by entry AND mode so a w8a8 fleet never
+    loads a weight-only artifact (or vice versa)."""
+    return os.path.join(path, log_name, snapshot_name(entry, mode))
+
+
+def save_snapshot(q_state: QuantizedInferenceState,
+                  report: Dict[str, Any], log_name: str, entry: str,
+                  path: str = "./logs") -> str:
+    """Write the int8 artifact with the checkpoint plane's durability
+    discipline: single atomic replace + a sha256 sidecar, so a replica
+    racing the writer sees either nothing or a verified-complete file.
+    Concurrent writers (N replicas quantizing the same entry) are safe:
+    quantization is deterministic, so last-replace-wins is idempotent."""
+    from ..train.checkpoint import _sha256_path, atomic_write
+
+    payload: Dict[str, Any] = {}
+    tree = {
+        "params": q_state.params,
+        "scales": q_state.scales,
+        "quant": q_state.quant,
+        "batch_stats": q_state.batch_stats or {},
+    }
+    for section in _SECTION_PREFIXES:
+        sub = tree[section]
+        if not sub:
+            continue
+        for key, leaf in flatten_dict(sub).items():
+            payload[f"{section}:{'/'.join(key)}"] = np.asarray(leaf)
+    payload["__manifest__"] = np.asarray(json.dumps({
+        "format_version": SNAPSHOT_FORMAT_VERSION,
+        "mode": q_state.mode,
+        "w8a8": list(q_state.w8a8),
+        "step": int(np.asarray(q_state.step)),
+        "entry": entry,
+        "report": report,
+    }))
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    blob = buf.getvalue()
+    full = snapshot_path(log_name, entry, q_state.mode, path)
+    os.makedirs(os.path.dirname(full), exist_ok=True)
+    atomic_write(full, blob)
+    atomic_write(
+        _sha256_path(full), hashlib.sha256(blob).hexdigest().encode()
+    )
+    return full
+
+
+def load_snapshot(log_name: str, entry: str, mode: str, path: str = "./logs"
+                  ) -> Optional[Tuple[QuantizedInferenceState,
+                                      Dict[str, Any]]]:
+    """Load a pre-quantized artifact, digest-verified. Returns ``(state,
+    banked gate report)`` or ``None`` on ANY trouble (absent, torn,
+    sidecar mismatch, wrong mode/format) — the caller falls back to
+    quantizing from the checkpoint; a broken snapshot costs startup time,
+    never correctness."""
+    full = snapshot_path(log_name, entry, mode, path)
+    if not os.path.exists(full):
+        return None
+    tried: List[str] = []
+    try:
+        from ..train.checkpoint import _verified_read
+
+        blob = _verified_read(full, tried)
+        if blob is None:
+            return None
+        with np.load(io.BytesIO(blob), allow_pickle=False) as z:
+            manifest = json.loads(str(z["__manifest__"]))
+            if int(manifest.get("format_version", -1)) != \
+                    SNAPSHOT_FORMAT_VERSION:
+                return None
+            if manifest.get("mode") != mode or manifest.get("entry") != entry:
+                return None
+            sections: Dict[str, Dict[Tuple[str, ...], Any]] = {
+                s: {} for s in _SECTION_PREFIXES
+            }
+            for name in z.files:
+                if name == "__manifest__":
+                    continue
+                section, _, flat_key = name.partition(":")
+                if section not in sections:
+                    return None
+                sections[section][tuple(flat_key.split("/"))] = jnp.asarray(
+                    z[name]
+                )
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return None
+    state = QuantizedInferenceState(
+        params=unflatten_dict(sections["params"]),
+        scales={
+            "/".join(k): v for k, v in sections["scales"].items()
+        },
+        quant=(
+            unflatten_dict(sections["quant"]) if sections["quant"] else {}
+        ),
+        batch_stats=(
+            unflatten_dict(sections["batch_stats"])
+            if sections["batch_stats"] else {}
+        ),
+        step=int(manifest.get("step", 0)),
+        mode=str(manifest.get("mode", "weight_only")),
+        w8a8=tuple(manifest.get("w8a8", ())),
+    )
+    return state, dict(manifest.get("report", {}))

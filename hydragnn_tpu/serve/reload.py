@@ -70,8 +70,13 @@ class CheckpointWatcher:
         # so re-trying it every poll would just spam the log
         self._last_entry = entry
         try:
+            # restore into the PRE-cast template: a bf16/int8-cast (or
+            # quantized) serving state's tree cannot template a msgpack
+            # restore; _install_state re-applies the precision gate
             state, loaded_from = load_inference_state(
-                self.server._state, self.log_name, self.path
+                getattr(self.server, "restore_template", None)
+                or self.server._state,
+                self.log_name, self.path,
             )
         except Exception as e:  # noqa: BLE001 — keep serving current weights
             self.rejected += 1
@@ -100,7 +105,26 @@ class CheckpointWatcher:
                 stacklevel=2,
             )
             return "rejected"
-        if not self.server._install_state(state, entry):
+        try:
+            installed = self.server._install_state(state, entry)
+        except Exception as e:  # noqa: BLE001 — gate refusals keep serving
+            # the install-time precision gate refused the candidate (int8
+            # accuracy drift past Serving.quantization.max_error): keep
+            # the current weights, same verdict as a corrupt candidate.
+            # The gate already emitted its own typed quant_drift event.
+            self.rejected += 1
+            self._emit_event(
+                "reject", entry, detail=f"{type(e).__name__}: {e}"
+            )
+            warnings.warn(
+                f"hot reload: candidate {entry!r} refused at install "
+                f"({type(e).__name__}: {e}); keeping the current weights "
+                f"({self.server.current_checkpoint})",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return "rejected"
+        if not installed:
             # the server refused the stage: it is draining/closing and the
             # serve loop will never take another swap. Count a rejection
             # (not an install — nothing was staged) and let the standby

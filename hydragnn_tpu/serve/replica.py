@@ -151,12 +151,23 @@ class ReplicaApp:
 
         try:
             state = load_inference_entry(
-                self.server._state, self.server.log_name, entry
+                getattr(self.server, "restore_template", None)
+                or self.server._state,
+                self.server.log_name, entry,
             )
         except (FileNotFoundError, ValueError) as e:
             return 409, {"error": {"code": "serve_error",
                                    "message": str(e)}}
-        if not self.server._install_state(state, entry):
+        try:
+            installed = self.server._install_state(state, entry)
+        except Exception as e:  # noqa: BLE001 — typed gate refusal
+            # int8 accuracy gate refused the entry (QuantizationDriftError
+            # et al): answer "rejected", keep the current weights serving
+            return 409, {"status": "rejected", "error": {
+                "code": getattr(e, "code", "serve_error"),
+                "message": f"{type(e).__name__}: {e}",
+            }}
+        if not installed:
             return 503, {"error": {
                 "code": ServerDrainingError.code,
                 "message": "server draining/closed; reload refused",

@@ -251,6 +251,8 @@ class GraphServer:
         sort_edges: bool = False,
         log_name: str = "serve",
         checkpoint_label: Optional[str] = None,
+        checkpoint_dir: Optional[str] = None,
+        restore_template=None,
         tracer=None,
         flight_recorder=None,
     ):
@@ -268,7 +270,6 @@ class GraphServer:
         self.mixed_precision = mixed_precision
         self.sort_edges = sort_edges
         self.current_checkpoint = checkpoint_label
-        self._state = self._cast_weights(state)
         templates = [_strip_targets(g) for g in template_graphs]
         clean = [g for g in templates if validate_graph(g) is None]
         if not clean:
@@ -278,6 +279,20 @@ class GraphServer:
             )
         self._template_graphs = clean
         self._channel_sig = _channel_signature(clean[0])
+        # int8 plane wiring (serve/quantize.py): checkpoint_dir locates the
+        # pre-quantized snapshot artifacts beside the run's checkpoints;
+        # restore_template keeps the PRE-cast state tree — hot reload
+        # restores msgpack subtrees into it (a quantized state's structure
+        # cannot template a checkpoint restore); _quant_report is the
+        # accuracy-gate verdict stats() exposes.
+        self._checkpoint_dir = checkpoint_dir
+        self.restore_template = (
+            restore_template if restore_template is not None else state
+        )
+        self._quant_report: Optional[Dict[str, Any]] = None
+        # cast AFTER the template/ladder fields above: int8 quantization
+        # calibrates and gates on the warmed ladder's template batches
+        self._state = self._cast_weights(state, entry=checkpoint_label)
         self._worst = ladder.specs[-1]
         # real-graph slots are bounded by the worst spec too (n_graphs
         # includes the +1 dummy slot): a Serving.micro_batch_graphs above
@@ -390,7 +405,20 @@ class GraphServer:
         from ..train.loop import mp_cast_eval
 
         model = self.model
-        mixed_precision = self.mixed_precision
+        quantized = self.cfg.weights_dtype == "int8"
+        w8a8 = bool(
+            quantized
+            and self.cfg.quantization is not None
+            and self.cfg.quantization.mode == "w8a8"
+        )
+        # int8 states define their own precision story: mp_cast_eval would
+        # cast the fp32 dequant scales (and the quant collection) to bf16,
+        # silently shifting exactly the values the accuracy gate certified
+        mixed_precision = self.mixed_precision and not quantized
+        if w8a8:
+            from flax import linen as nn
+
+            from .quantize import w8a8_interceptor
 
         @jax.jit
         def predict_step(state, batch):
@@ -399,6 +427,9 @@ class GraphServer:
             variables = state.variables()
             if mixed_precision:
                 variables, batch = mp_cast_eval(variables, batch, False)
+            if w8a8:
+                with nn.intercept_methods(w8a8_interceptor):
+                    return model.apply(variables, batch, train=False)
             return model.apply(variables, batch, train=False)
 
         return predict_step
@@ -1148,16 +1179,89 @@ class GraphServer:
                 return
             self._fail_request(req.handle, err)
 
-    def _cast_weights(self, state):
+    def _cast_weights(self, state, entry: Optional[str] = None):
         """Apply ``Serving.weights_dtype`` to an incoming state — the one
         precision gate for both the startup restore and every hot-reload
         swap, so a reloaded checkpoint cannot silently revert the server
-        to f32 weights."""
+        to f32 weights. ``int8`` routes through the quantization plane
+        (calibration + the accuracy gate; ``entry`` names the checkpoint
+        for snapshot lookup and drift attribution) and may raise
+        :class:`~hydragnn_tpu.serve.quantize.QuantizationDriftError`."""
         if self.cfg.weights_dtype == "float32":
             return state
+        if self.cfg.weights_dtype == "int8":
+            return self._quantize_state(state, entry)
         from ..train.state import cast_inference_weights
 
         return cast_inference_weights(state, self.cfg.weights_dtype)
+
+    def _quant_batches(self) -> list:
+        """The calibration/gate batches: the warmed ladder's template
+        batches (the exact shapes serving runs), capped at
+        ``Serving.quantization.calibration_batches``."""
+        from ..data.pipeline import spec_template_batches
+
+        templates = spec_template_batches(
+            self._template_graphs, self.ladder, sort_edges=self.sort_edges
+        )
+        batches = [b for _, b in templates]
+        if not batches:
+            raise ValueError(
+                "int8 quantization needs at least one template batch to "
+                "calibrate and gate on — the ladder does not describe the "
+                "template dataset"
+            )
+        cap = int(self.cfg.quantization.calibration_batches)
+        return batches[: max(1, cap)]
+
+    def _quantize_state(self, state, entry: Optional[str]):
+        """The int8 install pipeline: pre-quantized snapshot fast path
+        (no re-quantization, no calibration — the artifact banked its
+        gate report where it was produced), else quantize + calibrate +
+        gate, then publish the snapshot beside the checkpoint for the
+        rest of the fleet."""
+        from ..utils import faultinject
+        from . import quantize as qz
+
+        spec = self.cfg.quantization
+        if isinstance(state, qz.QuantizedInferenceState):
+            # already-quantized state handed in directly (embedding
+            # callers/tests): same trust story as the snapshot path
+            self._quant_report = {
+                "source": "prequantized", "mode": state.mode,
+            }
+            return state
+        if entry and self._checkpoint_dir:
+            loaded = qz.load_snapshot(
+                self.log_name, entry, spec.mode, self._checkpoint_dir
+            )
+            if loaded is not None:
+                qstate, report = loaded
+                self._quant_report = dict(
+                    report, source="snapshot", mode=qstate.mode,
+                )
+                return qstate
+        batches = self._quant_batches()
+        qstate = qz.quantize_state(
+            self.model, state, batches, spec.mode, spec.exclude
+        )
+        factor = faultinject.maybe_quant_drift(entry)
+        if factor:
+            qstate = qz.apply_scale_drift(qstate, factor)
+        report = qz.gate_or_raise(
+            self.model, state, qstate, batches, spec.max_error,
+            run=self.log_name, entry=entry,
+        )
+        self._quant_report = dict(report, source="calibrated")
+        if entry and self._checkpoint_dir:
+            try:
+                qz.save_snapshot(
+                    qstate, self._quant_report, self.log_name, entry,
+                    self._checkpoint_dir,
+                )
+            except OSError:
+                pass  # the artifact is an accelerator, not a dependency
+        return qstate
 
     def _install_state(self, state, label: Optional[str]) -> bool:
         """Stage a reloaded state; the serve loop swaps it in at the next
@@ -1165,11 +1269,19 @@ class GraphServer:
         with). Refused (returns False) on a draining/stopping/closed
         server: a CheckpointWatcher poll racing close() must neither swap
         a new state into a server that is winding down nor leak the
-        standby state past close()'s pending-state clear."""
+        standby state past close()'s pending-state clear.
+
+        The precision cast runs BEFORE the lock: int8 quantization
+        (eager calibration + the accuracy gate) takes seconds, and the
+        serve loop checks this lock at every batch boundary — staging
+        must never stall traffic. A gate refusal
+        (QuantizationDriftError) propagates to the caller; nothing was
+        staged."""
+        prepared = self._cast_weights(state, entry=label)
         with self._swap_lock:
             if self._closed or self._stop.is_set() or self._draining.is_set():
                 return False
-            self._pending_state = (self._cast_weights(state), label)
+            self._pending_state = (prepared, label)
             return True
 
     def _bump(self, key: str, by: int = 1) -> None:
@@ -1197,5 +1309,8 @@ class GraphServer:
             ),
             current_checkpoint=self.current_checkpoint,
             http_port=self.http_port,
+            weights_dtype=self.cfg.weights_dtype,
         )
+        if self._quant_report is not None:
+            out["quantization"] = dict(self._quant_report)
         return out

@@ -92,7 +92,17 @@ def cast_inference_weights(state, dtype):
     a rounding error of the params' footprint). Integer/bool leaves pass
     through. Works on ``InferenceState`` and ``TrainState`` alike (the
     orbax restore path serves a full TrainState; its optimizer moments
-    are dead at inference either way)."""
+    are dead at inference either way).
+
+    ``dtype="int8"`` is not a cast but a quantization: it dispatches to
+    the serving quantization plane's weight-only transform (per-channel
+    symmetric int8 kernels + fp32 scales, serve/quantize.py) and returns
+    a ``QuantizedInferenceState``. The serving layer adds calibration and
+    the accuracy gate on top; this path is the ungated building block."""
+    if str(dtype) == "int8":
+        from ..serve.quantize import quantize_weights
+
+        return quantize_weights(state)
     dt = jax.numpy.dtype(dtype)
 
     def _cast(x):

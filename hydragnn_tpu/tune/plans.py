@@ -37,6 +37,7 @@ SEGMENT = "segment_sum"
 FUSED_EDGE = "fused_edge"
 MULTI_AGG = "multi_agg"
 FLASH = "flash_attention"
+INT8_DOT = "int8_dot"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -99,6 +100,19 @@ KERNELS: Dict[str, KernelSpec] = {
             "block_k": (128, 256, 512),
         },
     ),
+    # int8 inference matmul (ops/quant.py int8_matmul): its own table axis
+    # keyed under dtype="int8" so quantized executables are tuned and
+    # looked up separately from the f32/bf16 plans for the same shapes
+    INT8_DOT: KernelSpec(
+        kernel=INT8_DOT,
+        params=("block_m", "block_n", "block_k"),
+        defaults={"block_m": 128, "block_n": 128, "block_k": 128},
+        grid={
+            "block_m": (64, 128, 256),
+            "block_n": (128, 256),
+            "block_k": (128, 256, 512),
+        },
+    ),
 }
 
 
@@ -113,6 +127,8 @@ def kernel_version(kernel: str) -> int:
         from ..ops import pallas_multi_agg as m
     elif kernel == FLASH:
         from ..ops import pallas_flash_attention as m
+    elif kernel == INT8_DOT:
+        from ..ops import quant as m
     else:
         raise KeyError(f"unknown kernel {kernel!r}")
     return int(m.KERNEL_VERSION)
@@ -164,6 +180,15 @@ def normalize(kernel: str, plan: Dict[str, int],
 
         bq, bk = normalize_tiles(p["block_q"], p["block_k"])
         return {"block_q": bq, "block_k": bk}
+    if kernel == INT8_DOT:
+        from ..ops.quant import normalize_tiles
+
+        bm, bn, bk = normalize_tiles(
+            int(shapes.get("rows", 0)), int(shapes.get("cols", 0)),
+            int(shapes.get("k", 0)),
+            p["block_m"], p["block_n"], p["block_k"],
+        )
+        return {"block_m": bm, "block_n": bn, "block_k": bk}
     raise KeyError(f"unknown kernel {kernel!r}")
 
 

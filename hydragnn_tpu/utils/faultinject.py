@@ -82,6 +82,12 @@ replica (the manager passes its environment through to every worker);
   ``maybe_replica_slow`` sleeps EVERY /predict on replica r — the
   slow-replica model the router's tail hedging must beat (duplicate to a
   fast replica past the hedge deadline, first answer wins).
+- ``HYDRAGNN_FAULT_QUANT_DRIFT`` (``"<entry_substring>:<factor>"``, factor
+  default 4.0; empty substring arms every entry): ``maybe_quant_drift``
+  hands the serving quantizer (serve/quantize.py) a scale-distortion
+  factor when the checkpoint entry being quantized matches — the
+  drifted-candidate model the int8 accuracy gate must refuse with a typed
+  ``quant_drift`` event while the prior weights keep serving.
 
 Fleet-plane points (docs/OBSERVABILITY.md "Fleet"):
 
@@ -444,6 +450,29 @@ def maybe_replica_slow(replica_index: int) -> None:
     import time
 
     time.sleep(float(rest) if rest else 0.2)
+
+
+def maybe_quant_drift(entry: Optional[str]) -> Optional[float]:
+    """Drifted-quantization drill (HYDRAGNN_FAULT_QUANT_DRIFT =
+    ``"<entry_substring>:<factor>"``; empty substring arms every entry,
+    factor defaults to 4.0): returns the scale-distortion factor when the
+    checkpoint entry being quantized matches, else None. The serving
+    quantizer multiplies every weight scale by it, so the accuracy gate
+    must refuse the candidate (typed quant_drift event) while entries
+    outside the match keep quantizing cleanly — the deterministic
+    bad-candidate model for the fleet smoke's rolling-reload leg."""
+    spec = _get("HYDRAGNN_FAULT_QUANT_DRIFT")
+    if spec is None:
+        return None
+    sub, sep, factor_s = spec.rpartition(":")
+    if not sep:
+        sub, factor_s = spec, ""
+    if sub and (entry is None or sub not in str(entry)):
+        return None
+    try:
+        return float(factor_s) if factor_s else 4.0
+    except ValueError:
+        return 4.0
 
 
 def maybe_straggle(step_index: int) -> None:

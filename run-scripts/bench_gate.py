@@ -27,10 +27,13 @@ trajectory with one:
 3. **mixture cells** (opt-in: ``--mix-cells logs/mix_cells.jsonl``) — the
    newest ``BENCH_MIX`` record (bench.py main_mix) vs the previous one:
    every ``*graphs_per_sec*`` key is higher-is-better (same threshold as
-   the bench cells), every ``*drift*`` key is LOWER-is-better (a
-   per-branch loss-drift maximum that grows past the threshold means a
-   branch is starving under the mixture weights). Fewer than two records
-   is "nothing to compare" (fails only under ``--strict``).
+   the bench cells), every ``*drift*`` / ``*max_error*`` key is
+   LOWER-is-better (a per-branch loss-drift maximum that grows past the
+   threshold means a branch is starving under the mixture weights; an
+   int8 ``quant_max_error`` that grows means a quantization change spent
+   accuracy — BENCH_SERVE banks those in its serve_cells.jsonl gate
+   record). Fewer than two records is "nothing to compare" (fails only
+   under ``--strict``).
 
 Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/IO error.
 ``--strict`` additionally fails (exit 1) when there is nothing comparable
@@ -198,7 +201,7 @@ def gate_bench(
 # ---------------------------------------------------------------------------
 
 MIX_HIGHER_RE = re.compile(r"graphs_per_sec")
-MIX_LOWER_RE = re.compile(r"drift")
+MIX_LOWER_RE = re.compile(r"drift|max_error")
 
 
 def load_mix_records(path: str) -> List[Dict[str, float]]:

@@ -26,6 +26,14 @@ the deterministic replica drills of utils/faultinject.py:
 5. Teardown: the manager's aggregated ``fleet_serve`` metrics records and
    the typed replica_exit/replica_restart/breaker events are on disk for
    the run doctor, and the fleet drains cleanly.
+6. QUANT: a second fleet comes up with ``Serving.weights_dtype: int8`` on
+   a pre-quantized snapshot (both replicas report ``source=snapshot`` —
+   no per-replica re-calibration) and agrees with the fp32 fleet's
+   predictions; a clean rolling reload re-quantizes + canaries + swaps a
+   new checkpoint fleet-wide; a drifted candidate (scales inflated by
+   HYDRAGNN_FAULT_QUANT_DRIFT) is refused by the accuracy gate on every
+   replica — ``installed == 0``, the fleet stays on the certified
+   checkpoint, and the typed ``quant_drift`` event is on disk.
 
 Exit 0 = fleet healthy; nonzero with a diagnostic otherwise.
 """
@@ -288,6 +296,128 @@ try:
 finally:
     manager.close()
 print("FLEET_CLEAN_EXIT", flush=True)
+
+# ---- 6. QUANT: int8 fleet from a pre-quantized snapshot, canary-gated
+# rolling reload, and a fault-injected drifted candidate refused ---------
+import glob
+
+from hydragnn_tpu.data.graph import SpecLadder
+from hydragnn_tpu.data.pipeline import spec_template_batches
+from hydragnn_tpu.models import create_model, init_model
+from hydragnn_tpu.serve import quantize as qz
+from hydragnn_tpu.train.checkpoint import load_inference_entry
+from hydragnn_tpu.train.state import InferenceState
+
+# pre-quantize the latest entry beside the checkpoint (what a producing
+# server would have published) so BOTH replicas take the snapshot fast
+# path — serving int8 without re-quantizing or re-calibrating
+entry_q = latest_checkpoint_entry(run)
+model = create_model(done)
+ladder = SpecLadder.for_dataset(ready_graphs, 4, num_buckets=2)
+tmpl = spec_template_batches(ready_graphs, ladder)[0][1]
+fpstate = load_inference_entry(
+    InferenceState.create(init_model(model, tmpl, seed=0)), run, entry_q
+)
+qbatches = [b for _, b in spec_template_batches(ready_graphs, ladder)][:2]
+qstate = qz.quantize_state(model, fpstate, qbatches, mode="weight_only")
+qreport = qz.gate_or_raise(
+    model, fpstate, qstate, qbatches, 0.05, run=run, entry=entry_q
+)
+qz.save_snapshot(
+    qstate, dict(qreport, source="calibrated"), run, entry_q, "./logs"
+)
+print("QUANT_SNAPSHOT_OK entry=%s max_error=%.6f"
+      % (entry_q, qreport["max_error"]), flush=True)
+
+# disarm the replica chaos drills; arm the quantization-drift fault for
+# the FUTURE epoch+3 entry only (children inherit environ at spawn, so
+# this must be set before the int8 fleet comes up)
+for k in ("HYDRAGNN_FAULT_REPLICA_WEDGE", "HYDRAGNN_FAULT_REPLICA_KILL",
+          "HYDRAGNN_FAULT_REPLICA_SLOW"):
+    os.environ.pop(k, None)
+os.environ["HYDRAGNN_FAULT_QUANT_DRIFT"] = "epoch%d.:6.0" % (ep + 3)
+
+cfg_q = json.loads(json.dumps(cfg))
+cfg_q["Serving"]["weights_dtype"] = "int8"
+cfg_q["Serving"]["quantization"] = {{
+    "mode": "weight_only", "calibration_batches": 2, "max_error": 0.05,
+}}
+# replica-side event streams (events-h<i>.jsonl): the gate's quant_drift
+# events fire inside the replica processes
+cfg_q["Telemetry"] = {{"enabled": True}}
+
+manager2 = hydragnn_tpu.run_server_fleet(cfg_q, wait_ready_s=600)
+try:
+    router2 = manager2.router()
+
+    def rstats2(idx):
+        port = manager2.replica_state()[idx]["port"]
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/stats" % port, data=b"{{}}",
+            headers={{"Content-Type": "application/json"}}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10.0) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    for i in (1, 2):
+        st = rstats2(i)
+        assert st.get("weights_dtype") == "int8", st
+        q = st.get("quantization") or {{}}
+        assert q.get("source") == "snapshot", (
+            "replica %d did not load the pre-quantized snapshot: %r"
+            % (i, q))
+    # int8 predictions agree with the fp32 fleet's on the same graph
+    out_q = np.asarray(router2.predict(gq, timeout_s=30.0)["s"])
+    denom = float(np.max(np.abs(np.asarray(new)))) + 1e-8
+    rel = float(np.max(np.abs(out_q - np.asarray(new)))) / denom
+    assert rel <= 0.05, "int8 fleet drifted from fp32: rel=%.5f" % rel
+    print("QUANT_FLEET_OK source=snapshot rel_err=%.5f" % rel, flush=True)
+
+    # clean rolling reload: a NEW checkpoint is re-quantized, canaried,
+    # and swapped fleet-wide (gate green)
+    scaled3 = jax.tree_util.tree_map(
+        lambda p: np.asarray(p) * 3.0, rawckpt["params"]
+    )
+    ts2 = TrainState.create(
+        {{"params": scaled3, "batch_stats": rawckpt.get("batch_stats", {{}})}},
+        make_optimizer({{"type": "AdamW", "learning_rate": 0.01}}),
+    )
+    save_model(ts2, run, epoch=ep + 2)
+    res2 = manager2.rolling_reload(ready_graphs[:4], timeout_s=300.0)
+    assert res2["status"] == "done" and res2["installed"] == 2, res2
+    want2 = "%s_epoch%d.msgpack" % (run, ep + 2)
+    st1 = rstats2(1)
+    assert st1["current_checkpoint"] == want2, st1
+    assert (st1.get("quantization") or {{}}).get("source") in (
+        "calibrated", "snapshot"), st1
+    moved = np.asarray(router2.predict(gq, timeout_s=30.0)["s"])
+    assert not np.allclose(out_q, moved), "int8 reload did not move preds"
+    print("QUANT_RELOAD_OK installed=%d source=%s"
+          % (res2["installed"], st1["quantization"]["source"]), flush=True)
+
+    # drifted candidate: the armed fault inflates the scales of the
+    # epoch+3 entry after calibration — the gate must refuse it on every
+    # replica and the fleet must stay on the prior checkpoint
+    ts3 = TrainState.create(
+        {{"params": scaled3, "batch_stats": rawckpt.get("batch_stats", {{}})}},
+        make_optimizer({{"type": "AdamW", "learning_rate": 0.01}}),
+    )
+    save_model(ts3, run, epoch=ep + 3)
+    res3 = manager2.rolling_reload(ready_graphs[:4], timeout_s=300.0)
+    assert res3["status"] == "done" and res3["installed"] == 0, res3
+    for i in (1, 2):
+        st = rstats2(i)
+        assert st["current_checkpoint"] == want2, (
+            "replica %d left the certified checkpoint: %r" % (i, st))
+    ev_text = ""
+    for p in glob.glob(os.path.join("./logs", run, "events*.jsonl")):
+        with open(p) as f:
+            ev_text += f.read()
+    assert "quant_drift" in ev_text, "no quant_drift event on disk"
+    print("QUANT_GATE_OK refused installed=0", flush=True)
+finally:
+    manager2.close()
+print("QUANT_CLEAN_EXIT", flush=True)
 """
 
 
@@ -298,6 +428,11 @@ _MARKERS = (
     "KILL_OK",
     "RELOAD_OK",
     "FLEET_CLEAN_EXIT",
+    "QUANT_SNAPSHOT_OK",
+    "QUANT_FLEET_OK",
+    "QUANT_RELOAD_OK",
+    "QUANT_GATE_OK",
+    "QUANT_CLEAN_EXIT",
 )
 
 
@@ -312,7 +447,7 @@ def main() -> int:
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
     )
     lines = []
-    deadline = time.time() + 1200
+    deadline = time.time() + 1800
     while time.time() < deadline:
         line = proc.stdout.readline()
         if line == "" and proc.poll() is not None:
@@ -338,7 +473,9 @@ def main() -> int:
         "serve_fleet OK: wedged replica absorbed (breaker opened + reclosed, "
         "hedges won), prediction cache hit bit-identical, SIGKILL mid-load "
         "retried to zero client-visible failures with supervisor restart, "
-        "rolling reload under load held the ready floor and moved predictions"
+        "rolling reload under load held the ready floor and moved "
+        "predictions, int8 fleet served from the pre-quantized snapshot and "
+        "the accuracy gate refused the drifted candidate"
     )
     return 0
 

@@ -1,0 +1,458 @@
+"""Int8 quantized inference plane (docs/SERVING.md "Quantization"):
+per-channel symmetric quantization math, the weight-only / w8a8 serving
+pipelines, the accuracy gate (green within tolerance, drifted candidates
+refused with the typed error), the pre-quantized snapshot artifact
+(round-trip + corrupt fallback), the GraphServer int8 install paths
+(calibrated -> snapshot fast path, fault-injected drift refused at
+construction), the prediction-cache entry census + gauges, and the run
+doctor's ``quant_drift`` / ``cache_ineffective`` rules."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from hydragnn_tpu.config import update_config, voi_from_config
+from hydragnn_tpu.data import deterministic_graph_dataset, split_dataset
+from hydragnn_tpu.data.graph import SpecLadder, batch_graphs
+from hydragnn_tpu.data.pipeline import extract_variables, spec_template_batches
+from hydragnn_tpu.models.create import create_model, init_model
+from hydragnn_tpu.ops import quant as opsq
+from hydragnn_tpu.serve import GraphServer, ServeConfig
+from hydragnn_tpu.serve import quantize as qz
+from hydragnn_tpu.serve.config import QuantizationSpec
+from hydragnn_tpu.train.state import InferenceState, cast_inference_weights
+from hydragnn_tpu.utils import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.reset()
+    yield
+    faultinject.reset()
+
+
+# ---------------------------------------------------------------------------
+# ops/quant.py: the integer primitives
+# ---------------------------------------------------------------------------
+
+
+def pytest_per_channel_roundtrip_bounds_error():
+    """Each output channel quantizes against its OWN scale: the round-trip
+    error is bounded by scale/2 per element, a wide channel never bleeds
+    into a narrow one, and all-zero channels round-trip exactly."""
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(16, 6)).astype(np.float32)
+    w[:, 1] *= 100.0  # wide channel
+    w[:, 4] = 0.0  # all-zero channel
+    q, scale = opsq.quantize_per_channel(w)
+    assert np.asarray(q).dtype == np.int8
+    assert scale.shape == (1, 6)
+    back = np.asarray(opsq.dequantize(q, scale))
+    err = np.abs(back - w)
+    assert np.all(err <= np.asarray(scale) / 2.0 + 1e-7)
+    assert np.all(back[:, 4] == 0.0)
+    assert float(np.asarray(scale)[0, 4]) == 1.0  # zero-guard, no 0/0
+    # the narrow channels' absolute error is far below the wide channel's
+    assert float(err[:, 0].max()) < float(np.abs(w[:, 1]).max()) / 254.0
+
+
+def pytest_int8_matmul_accumulates_in_int32():
+    """int8 x int8 contraction must carry an int32 accumulator: K=512 of
+    saturated products (127*127*512 ~ 8.2M) overflows int16 by 250x."""
+    k = 512
+    x = np.full((2, k), 127, dtype=np.int8)
+    w = np.full((k, 3), 127, dtype=np.int8)
+    out = np.asarray(opsq.int8_matmul(x, w))
+    assert out.dtype == np.int32
+    assert np.all(out == 127 * 127 * k)
+
+
+def pytest_quantize_activations_saturates():
+    x = np.array([0.0, 1.0, -1.0, 1000.0, -1000.0], dtype=np.float32)
+    q = np.asarray(opsq.quantize_activations(x, np.float32(1.0 / 127.0)))
+    assert q.dtype == np.int8
+    assert q[3] == 127 and q[4] == -127  # out-of-range clips, never wraps
+    assert q[0] == 0
+
+
+# ---------------------------------------------------------------------------
+# serving pipeline world (the test_serve.py recipe)
+# ---------------------------------------------------------------------------
+
+
+def _config():
+    return {
+        "Verbosity": {"level": 0},
+        "Dataset": {
+            "name": "quant_test",
+            "format": "synthetic",
+            "synthetic": {"number_configurations": 60},
+            "node_features": {"name": ["x", "x2", "x3"], "dim": [1, 1, 1]},
+            "graph_features": {"name": ["s"], "dim": [1]},
+        },
+        "NeuralNetwork": {
+            "Architecture": {
+                "mpnn_type": "GIN",
+                "radius": 2.0,
+                "max_neighbours": 100,
+                "hidden_dim": 8,
+                "num_conv_layers": 2,
+                "task_weights": [1.0],
+                "output_heads": {
+                    "graph": {
+                        "num_sharedlayers": 1,
+                        "dim_sharedlayers": 8,
+                        "num_headlayers": 2,
+                        "dim_headlayers": [8, 8],
+                    }
+                },
+            },
+            "Variables_of_interest": {
+                "input_node_features": [0],
+                "output_names": ["s"],
+                "output_index": [0],
+                "type": ["graph"],
+                "denormalize_output": False,
+            },
+            "Training": {
+                "num_epoch": 1,
+                "batch_size": 8,
+                "Optimizer": {"type": "AdamW", "learning_rate": 0.01},
+            },
+        },
+    }
+
+
+@pytest.fixture(scope="module")
+def quant_world():
+    raw = deterministic_graph_dataset(
+        60, seed=7, radius=2.0, max_neighbours=100
+    )
+    cfg = _config()
+    tr, va, te = split_dataset(raw, 0.7, seed=0)
+    cfg = update_config(cfg, tr, va, te)
+    voi = voi_from_config(cfg)
+    ready = [extract_variables(g, voi) for g in raw]
+    ladder = SpecLadder.for_dataset(ready, 8, num_buckets=2)
+    model = create_model(cfg)
+    tmpl = spec_template_batches(ready, ladder)[0][1]
+    state = InferenceState.create(init_model(model, tmpl, seed=0))
+    batches = [b for _, b in spec_template_batches(ready, ladder)][:2]
+    return cfg, model, state, ladder, ready, batches
+
+
+def pytest_cast_preserves_aux_leaves(quant_world):
+    """``Serving.weights_dtype`` casts: bf16 touches ONLY floating params
+    (batch stats and integer leaves survive in their own dtypes), and
+    ``int8`` dispatches to the quantization plane instead of casting."""
+    _, model, state, _, _, batches = quant_world
+    aug = state.replace(
+        batch_stats={"bn": {"mean": np.zeros(4, dtype=np.float32)}}
+    )
+    cast = cast_inference_weights(aug, "bfloat16")
+    for leaf in jax.tree_util.tree_leaves(cast.params):
+        if np.issubdtype(np.asarray(leaf).dtype, np.floating):
+            assert np.asarray(leaf).dtype == jax.numpy.bfloat16
+    assert cast.batch_stats["bn"]["mean"].dtype == np.float32
+    q = cast_inference_weights(state, "int8")
+    assert isinstance(q, qz.QuantizedInferenceState)
+    # the cast state still serves: bf16 predictions track f32 within bf16's
+    # ~3-decimal-digit mantissa on this head (clean state — the synthetic
+    # batch_stats above are census props the GIN model has no modules for)
+    clean = cast_inference_weights(state, "bfloat16")
+    fp = jax.device_get(
+        model.apply(state.variables(), batches[0], train=False)
+    )["s"]
+    bf = jax.device_get(
+        model.apply(clean.variables(), batches[0], train=False)
+    )["s"]
+    denom = float(np.max(np.abs(fp))) + 1e-8
+    assert float(np.max(np.abs(np.asarray(bf, np.float32) - fp))) / denom < 0.1
+
+
+def pytest_weight_only_gate_green_and_smaller(quant_world):
+    """The weight-only pipeline: head output layers and 1D leaves stay
+    f32, ``variables()`` hands model code floats, the accuracy gate passes
+    within tolerance, and the resident weight bytes shrink."""
+    _, model, state, _, _, batches = quant_world
+    q = qz.quantize_state(model, state, batches, mode="weight_only")
+    assert q.scales and not q.w8a8 and not q.quant
+    for leaf in jax.tree_util.tree_leaves(q.variables()["params"]):
+        assert not np.issubdtype(np.asarray(leaf).dtype, np.signedinteger)
+    report = qz.gate_or_raise(model, state, q, batches, 0.05)
+    assert report["mode"] == "weight_only"
+    assert 0.0 <= report["max_error"] <= 0.05
+    assert report["per_head"] and "s" in report["per_head"]
+    fp_bytes = sum(
+        int(leaf.nbytes)
+        for leaf in jax.tree_util.tree_leaves(state.params)
+    )
+    assert q.weight_nbytes() < fp_bytes
+
+
+def pytest_w8a8_promotes_calibrated_scopes(quant_world):
+    """w8a8: calibration observes real template activations, promotes the
+    matching Dense scopes to int8 x int8 with static act scales, and the
+    quantized predictions still track f32 within the default gate bound."""
+    _, model, state, _, _, batches = quant_world
+    q = qz.quantize_state(model, state, batches, mode="w8a8")
+    assert q.mode == "w8a8" and q.w8a8
+    assert q.quant, "w8a8 produced no quant collection"
+    report = qz.accuracy_report(model, state, q, batches)
+    assert report["max_error"] <= QuantizationSpec().max_error
+    # promoted kernels stay int8 through variables() (the interceptor
+    # consumes them); unpromoted quantized kernels are dequantized
+    v = q.variables()
+    assert "quant" in v
+    int8_leaves = [
+        leaf
+        for leaf in jax.tree_util.tree_leaves(v["params"])
+        if np.asarray(leaf).dtype == np.int8
+    ]
+    assert int8_leaves, "no kernel stayed int8 for the w8a8 scopes"
+
+
+def pytest_gate_refuses_drifted_candidate(quant_world):
+    """A scale-distorted candidate (the faultinject drill's transform)
+    must be refused with the typed error carrying the evidence."""
+    _, model, state, _, _, batches = quant_world
+    q = qz.quantize_state(model, state, batches, mode="weight_only")
+    bad = qz.apply_scale_drift(q, 8.0)
+    with pytest.raises(qz.QuantizationDriftError) as exc:
+        qz.gate_or_raise(model, state, bad, batches, 0.05)
+    err = exc.value
+    assert err.code == "quant_drift"
+    assert err.max_error > err.limit == 0.05
+    assert err.per_head
+
+
+def pytest_snapshot_roundtrip_and_corrupt_fallback(quant_world, tmp_path):
+    """The pre-quantized artifact: digest-verified round trip restores the
+    exact int8 state + banked report; mode mismatch and torn files load as
+    None (callers fall back to quantizing) — never a wrong answer."""
+    _, model, state, _, _, batches = quant_world
+    q = qz.quantize_state(model, state, batches, mode="weight_only")
+    report = qz.gate_or_raise(
+        model, state, q, batches, 0.05, run="snaptest", entry="e1"
+    )
+    full = qz.save_snapshot(
+        q, dict(report, source="calibrated"), "snaptest", "e1",
+        str(tmp_path),
+    )
+    assert os.path.exists(full) and os.path.exists(full + ".sha256")
+    loaded = qz.load_snapshot("snaptest", "e1", "weight_only", str(tmp_path))
+    assert loaded is not None
+    q2, banked = loaded
+    assert q2.mode == "weight_only"
+    assert banked["max_error"] == pytest.approx(report["max_error"])
+    for a, b in zip(
+        jax.tree_util.tree_leaves(q.params),
+        jax.tree_util.tree_leaves(q2.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert set(q2.scales) == set(q.scales)
+    # a w8a8 fleet must never load a weight-only artifact
+    assert qz.load_snapshot("snaptest", "e1", "w8a8", str(tmp_path)) is None
+    with open(full, "r+b") as f:
+        f.write(b"\x00" * 64)
+    assert (
+        qz.load_snapshot("snaptest", "e1", "weight_only", str(tmp_path))
+        is None
+    )
+
+
+# ---------------------------------------------------------------------------
+# GraphServer install paths
+# ---------------------------------------------------------------------------
+
+
+def _int8_server(quant_world, tmp_path, **kw):
+    cfg, model, state, ladder, ready, _ = quant_world
+    return GraphServer(
+        model,
+        state,
+        ladder,
+        ServeConfig(
+            micro_batch_graphs=8,
+            batch_window_s=0.005,
+            step_timeout_s=20.0,
+            weights_dtype="int8",
+            quantization={
+                "mode": "weight_only",
+                "calibration_batches": 2,
+                "max_error": 0.05,
+            },
+        ),
+        template_graphs=ready,
+        log_name="quant_srv",
+        checkpoint_dir=str(tmp_path),
+        **kw,
+    )
+
+
+def pytest_server_int8_calibrates_then_snapshot_fast_path(
+    quant_world, tmp_path
+):
+    """First int8 server quantizes + calibrates + gates and publishes the
+    snapshot; a second server on the same entry loads it (source
+    ``snapshot`` — no re-calibration) and serves identical predictions
+    that track the f32 direct eval."""
+    cfg, model, state, ladder, ready, _ = quant_world
+    entry = "quant_srv_epoch0.msgpack"
+    s1 = _int8_server(quant_world, tmp_path, checkpoint_label=entry).start()
+    try:
+        assert s1.wait_ready(180), f"warm-up failed: {s1.failed}"
+        rep1 = s1.stats()["quantization"]
+        assert rep1["source"] == "calibrated"
+        assert rep1["max_error"] <= 0.05
+        assert s1.stats()["weights_dtype"] == "int8"
+        g = ready[3]
+        got = s1.submit(g).result(30)["s"]
+    finally:
+        s1.close(drain=False)
+    assert os.path.exists(
+        qz.snapshot_path("quant_srv", entry, "weight_only", str(tmp_path))
+    )
+    spec = ladder.select_for([g])
+    batch = batch_graphs(
+        [
+            dataclasses.replace(
+                g, graph_targets=None, node_targets=None, graph_y=None
+            )
+        ],
+        spec,
+    )
+    direct = jax.device_get(
+        model.apply(state.variables(), batch, train=False)
+    )["s"]
+    denom = float(np.max(np.abs(direct))) + 1e-8
+    assert float(np.max(np.abs(got - np.asarray(direct)[0]))) / denom <= 0.05
+    s2 = _int8_server(quant_world, tmp_path, checkpoint_label=entry).start()
+    try:
+        assert s2.wait_ready(180), f"warm-up failed: {s2.failed}"
+        rep2 = s2.stats()["quantization"]
+        assert rep2["source"] == "snapshot"
+        again = s2.submit(g).result(30)["s"]
+        np.testing.assert_array_equal(got, again)
+    finally:
+        s2.close(drain=False)
+
+
+def pytest_server_refuses_drifted_install(quant_world, tmp_path, monkeypatch):
+    """The armed drift drill distorts the scales post-calibration; the
+    accuracy gate must refuse the install (typed error at construction),
+    and an entry OUTSIDE the armed substring quantizes cleanly."""
+    monkeypatch.setenv("HYDRAGNN_FAULT_QUANT_DRIFT", "epoch9.:8.0")
+    with pytest.raises(qz.QuantizationDriftError):
+        _int8_server(
+            quant_world, tmp_path,
+            checkpoint_label="quant_srv_epoch9.msgpack",
+        )
+    server = _int8_server(
+        quant_world, tmp_path, checkpoint_label="quant_srv_epoch7.msgpack"
+    )
+    assert server._quant_report["source"] == "calibrated"
+    server.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# prediction-cache census + doctor rules (the observability satellites)
+# ---------------------------------------------------------------------------
+
+
+def pytest_cache_census_and_gauges(quant_world, tmp_path):
+    from hydragnn_tpu.obs.registry import registry
+    from hydragnn_tpu.serve.cache import PredictionCache
+
+    _, _, _, _, ready, _ = quant_world
+    cache = PredictionCache(str(tmp_path / "pc"), context="ctx")
+    r = {"s": np.ones((1, 1), dtype=np.float32)}
+    cache.put(ready[0], r)
+    cache.put(ready[1], r)
+    st = cache.stats()
+    assert st["entries"] == 2 and st["bytes"] > 0
+    cache.put(ready[0], r)  # same key: replaced, census unchanged
+    assert cache.stats()["entries"] == 2
+    # a restarted process inherits the on-disk census via the scan
+    cache2 = PredictionCache(str(tmp_path / "pc"), context="ctx")
+    st2 = cache2.stats()
+    assert st2["entries"] == 2 and st2["bytes"] == st["bytes"]
+    assert cache2.get(ready[0]) is not None
+    # corrupt entries: evicted on read AND decremented from the census
+    for root, _, files in os.walk(str(tmp_path / "pc")):
+        for name in files:
+            if name.endswith(".npz"):
+                with open(os.path.join(root, name), "wb") as f:
+                    f.write(b"junk")
+    assert cache2.get(ready[0]) is None
+    assert cache2.get(ready[1]) is None
+    assert cache2.stats()["entries"] == 0
+    assert cache2.stats()["corrupt"] == 2
+    g = registry().gauge(
+        "hydragnn_serve_cache_entries",
+        "Prediction-cache entries currently on disk",
+    )
+    assert g.value() == 0.0
+
+
+def pytest_doctor_quant_drift_and_cache_rules():
+    from hydragnn_tpu.obs.doctor import (
+        DoctorConfig,
+        RunStreams,
+        diagnose,
+    )
+
+    ev = {
+        "kind": "quant_drift",
+        "severity": "error",
+        "candidate": "run_epoch4.msgpack",
+        "mode": "weight_only",
+        "max_error": 0.31,
+        "limit": 0.05,
+        "per_head": {"s": 0.31},
+    }
+    fleet = {
+        "kind": "fleet_serve",
+        "replicas": 2,
+        "cache_enabled": True,
+        "cache_hits": 2,
+        "cache_misses": 198,
+        "cache_entries": 150,
+        "cache_bytes": 4096,
+    }
+    streams = RunStreams(
+        target="t", source="run_dir", events=[ev], metrics=[fleet]
+    )
+    findings, _ = diagnose(streams)
+    by_kind = {f.kind: f for f in findings}
+    assert "quant_drift" in by_kind
+    qd = by_kind["quant_drift"]
+    assert qd.severity == "error"
+    assert qd.data["refusals"] == 1
+    assert "run_epoch4.msgpack" in qd.data["candidates"]
+    assert "max_error" in qd.remediation
+    cr = by_kind["cache_ineffective"]
+    assert cr.severity == "warn"
+    assert cr.data["hit_rate"] == pytest.approx(0.01)
+    # below the lookup floor, or hitting well: the rule holds its fire
+    quiet = RunStreams(
+        target="t",
+        source="run_dir",
+        metrics=[dict(fleet, cache_hits=2, cache_misses=8)],
+    )
+    f2, _ = diagnose(quiet)
+    assert "cache_ineffective" not in {f.kind for f in f2}
+    healthy = RunStreams(
+        target="t",
+        source="run_dir",
+        metrics=[dict(fleet, cache_hits=100, cache_misses=100)],
+    )
+    f3, _ = diagnose(healthy)
+    assert "cache_ineffective" not in {f.kind for f in f3}
+    assert "quant_drift" not in {f.kind for f in f3}
+    cfg = DoctorConfig()
+    assert cfg.cache_min_lookups == 100
